@@ -1,0 +1,974 @@
+"""SSZ (SimpleSerialize) type system: basic uints/bool, ByteVector/ByteList,
+Vector/List, Bitvector/Bitlist, Container — serialization, strict
+deserialization, hash_tree_root, JSON presentation serde, defaults and
+generalized indices.
+
+This replaces the reference's `ssz_rs` dependency plus its local
+`ByteVector`/`ByteList` wrappers (ethereum-consensus/src/ssz/{mod,byte_vector,
+byte_list}.rs) with a single idiomatic Python layer. Values are plain Python
+objects (int, bool, bytes, list, Container instances); SSZ *types* are
+descriptor objects exposing serialize/deserialize/hash_tree_root.
+
+JSON convention follows the reference's serde layer
+(ethereum-consensus/src/serde.rs): u64-ish scalars render as decimal strings,
+byte types as 0x-hex.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .merkle import (
+    BYTES_PER_CHUNK,
+    merkleize_chunks,
+    mix_in_length,
+    next_pow_of_two,
+    pack_bytes,
+    zero_hash,
+)
+
+__all__ = [
+    "SSZType",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "uint128",
+    "uint256",
+    "boolean",
+    "Vector",
+    "List",
+    "Bitvector",
+    "Bitlist",
+    "ByteVector",
+    "ByteList",
+    "Container",
+    "Union",
+    "serialize",
+    "deserialize",
+    "hash_tree_root",
+    "get_generalized_index",
+    "DeserializeError",
+]
+
+OFFSET_SIZE = 4
+MAX_LENGTH = 2**32  # offsets are u32
+
+
+class DeserializeError(ValueError):
+    """Malformed SSZ input."""
+
+
+# ---------------------------------------------------------------------------
+# Type descriptor base
+# ---------------------------------------------------------------------------
+
+
+class SSZType:
+    """Base descriptor. Subclasses implement the SSZ type algebra."""
+
+    # -- size ---------------------------------------------------------------
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        raise NotImplementedError(f"{self} is variable-size")
+
+    # -- codec --------------------------------------------------------------
+    def serialize(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    # -- merkleization ------------------------------------------------------
+    def hash_tree_root(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def chunk_count(self) -> int:
+        """Number of chunks at this type's merkle layer (spec chunk_count)."""
+        raise NotImplementedError
+
+    # -- values -------------------------------------------------------------
+    def default(self) -> Any:
+        raise NotImplementedError
+
+    # -- presentation serde (reference serde.rs convention) -----------------
+    def to_json(self, value: Any) -> Any:
+        raise NotImplementedError
+
+    def from_json(self, obj: Any) -> Any:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.__class__.__name__
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+
+class _UintType(SSZType):
+    def __init__(self, byte_length: int):
+        self.byte_length = byte_length
+        self.bits = byte_length * 8
+        self.max = (1 << self.bits) - 1
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return self.byte_length
+
+    def serialize(self, value: int) -> bytes:
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise TypeError(f"expected int for uint{self.bits}, got {type(value)}")
+        if not 0 <= value <= self.max:
+            raise ValueError(f"value {value} out of range for uint{self.bits}")
+        return value.to_bytes(self.byte_length, "little")
+
+    def deserialize(self, data: bytes) -> int:
+        if len(data) != self.byte_length:
+            raise DeserializeError(
+                f"uint{self.bits}: expected {self.byte_length} bytes, got {len(data)}"
+            )
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value: int) -> bytes:
+        return self.serialize(value).ljust(BYTES_PER_CHUNK, b"\x00")
+
+    def chunk_count(self) -> int:
+        return 1
+
+    def default(self) -> int:
+        return 0
+
+    def to_json(self, value: int) -> str:
+        return str(value)
+
+    def from_json(self, obj: Any) -> int:
+        value = int(obj)
+        if not 0 <= value <= self.max:
+            raise ValueError(f"value {value} out of range for uint{self.bits}")
+        return value
+
+    def __repr__(self) -> str:
+        return f"uint{self.bits}"
+
+
+class _BooleanType(SSZType):
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return 1
+
+    def serialize(self, value: bool) -> bytes:
+        if not isinstance(value, (bool, int)) or value not in (0, 1):
+            raise ValueError(f"expected boolean, got {value!r}")
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes) -> bool:
+        if len(data) != 1 or data[0] not in (0, 1):
+            raise DeserializeError(f"invalid boolean encoding: {data!r}")
+        return data[0] == 1
+
+    def hash_tree_root(self, value: bool) -> bytes:
+        return self.serialize(value).ljust(BYTES_PER_CHUNK, b"\x00")
+
+    def chunk_count(self) -> int:
+        return 1
+
+    def default(self) -> bool:
+        return False
+
+    def to_json(self, value: bool) -> bool:
+        return bool(value)
+
+    def from_json(self, obj: Any) -> bool:
+        if isinstance(obj, bool):
+            return obj
+        raise ValueError(f"expected bool, got {obj!r}")
+
+    def __repr__(self) -> str:
+        return "boolean"
+
+
+uint8 = _UintType(1)
+uint16 = _UintType(2)
+uint32 = _UintType(4)
+uint64 = _UintType(8)
+uint128 = _UintType(16)
+uint256 = _UintType(32)
+boolean = _BooleanType()
+
+
+def _is_basic(typ: SSZType) -> bool:
+    return isinstance(typ, (_UintType, _BooleanType))
+
+
+# ---------------------------------------------------------------------------
+# Parametrized type factory plumbing
+# ---------------------------------------------------------------------------
+
+
+class _Parametrized:
+    """``Klass[args]`` returns a cached descriptor instance."""
+
+    _cache: dict[tuple, SSZType] = {}
+
+    def __class_getitem__(cls, params):
+        if not isinstance(params, tuple):
+            params = (params,)
+        key = (cls, *params)
+        inst = _Parametrized._cache.get(key)
+        if inst is None:
+            inst = cls(*params)  # type: ignore[call-arg]
+            _Parametrized._cache[key] = inst
+        return inst
+
+
+# ---------------------------------------------------------------------------
+# Byte types (hex-presented, bytes-valued)
+# ---------------------------------------------------------------------------
+
+
+class ByteVector(_Parametrized, SSZType):
+    """Fixed-length byte string; JSON as 0x-hex.
+    Parity: ethereum-consensus/src/ssz/byte_vector.rs."""
+
+    def __init__(self, length: int):
+        if length <= 0:
+            raise ValueError("ByteVector length must be positive")
+        self.length = length
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return self.length
+
+    def serialize(self, value: bytes) -> bytes:
+        value = bytes(value)
+        if len(value) != self.length:
+            raise ValueError(f"ByteVector[{self.length}]: got {len(value)} bytes")
+        return value
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) != self.length:
+            raise DeserializeError(f"ByteVector[{self.length}]: got {len(data)} bytes")
+        return bytes(data)
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        return merkleize_chunks(pack_bytes(self.serialize(value)))
+
+    def chunk_count(self) -> int:
+        return (self.length + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+
+    def default(self) -> bytes:
+        return b"\x00" * self.length
+
+    def to_json(self, value: bytes) -> str:
+        return "0x" + bytes(value).hex()
+
+    def from_json(self, obj: str) -> bytes:
+        data = _bytes_from_hex(obj)
+        if len(data) != self.length:
+            raise ValueError(f"ByteVector[{self.length}]: got {len(data)} bytes")
+        return data
+
+    def __repr__(self) -> str:
+        return f"ByteVector[{self.length}]"
+
+
+class ByteList(_Parametrized, SSZType):
+    """Bounded variable-length byte string; JSON as 0x-hex.
+    Parity: ethereum-consensus/src/ssz/byte_list.rs."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def serialize(self, value: bytes) -> bytes:
+        value = bytes(value)
+        if len(value) > self.limit:
+            raise ValueError(f"ByteList[{self.limit}]: got {len(value)} bytes")
+        return value
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) > self.limit:
+            raise DeserializeError(f"ByteList[{self.limit}]: got {len(data)} bytes")
+        return bytes(data)
+
+    def hash_tree_root(self, value: bytes) -> bytes:
+        value = self.serialize(value)
+        root = merkleize_chunks(pack_bytes(value), limit=self.chunk_count())
+        return mix_in_length(root, len(value))
+
+    def chunk_count(self) -> int:
+        return (self.limit + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+
+    def default(self) -> bytes:
+        return b""
+
+    def to_json(self, value: bytes) -> str:
+        return "0x" + bytes(value).hex()
+
+    def from_json(self, obj: str) -> bytes:
+        data = _bytes_from_hex(obj)
+        if len(data) > self.limit:
+            raise ValueError(f"ByteList[{self.limit}]: got {len(data)} bytes")
+        return data
+
+    def __repr__(self) -> str:
+        return f"ByteList[{self.limit}]"
+
+
+def _bytes_from_hex(obj: str) -> bytes:
+    if not isinstance(obj, str) or not obj.startswith("0x"):
+        raise ValueError(f"expected 0x-hex string, got {obj!r}")
+    return bytes.fromhex(obj[2:])
+
+
+# ---------------------------------------------------------------------------
+# Homogeneous collections
+# ---------------------------------------------------------------------------
+
+
+def _serialize_homogeneous(elem: SSZType, values: list) -> bytes:
+    if elem.is_fixed_size():
+        return b"".join(elem.serialize(v) for v in values)
+    parts = [elem.serialize(v) for v in values]
+    offset = OFFSET_SIZE * len(parts)
+    out = bytearray()
+    for part in parts:
+        out += offset.to_bytes(OFFSET_SIZE, "little")
+        offset += len(part)
+    for part in parts:
+        out += part
+    return bytes(out)
+
+
+def _deserialize_homogeneous(elem: SSZType, data: bytes, count: int | None) -> list:
+    """``count`` fixed for Vector, None for List (derive from data)."""
+    if elem.is_fixed_size():
+        size = elem.fixed_size()
+        if count is not None:
+            if len(data) != size * count:
+                raise DeserializeError(
+                    f"expected {size * count} bytes for {count} elements, got {len(data)}"
+                )
+            n = count
+        else:
+            if len(data) % size != 0:
+                raise DeserializeError(
+                    f"byte length {len(data)} not a multiple of element size {size}"
+                )
+            n = len(data) // size
+        return [elem.deserialize(data[i * size : (i + 1) * size]) for i in range(n)]
+
+    # variable-size elements: offset table
+    if len(data) == 0:
+        if count not in (None, 0):
+            raise DeserializeError("expected elements, got empty data")
+        return []
+    if len(data) < OFFSET_SIZE:
+        raise DeserializeError("truncated offset table")
+    first = int.from_bytes(data[:OFFSET_SIZE], "little")
+    if first % OFFSET_SIZE != 0 or first == 0:
+        raise DeserializeError(f"invalid first offset {first}")
+    n = first // OFFSET_SIZE
+    if count is not None and n != count:
+        raise DeserializeError(f"expected {count} elements, got {n}")
+    offsets = [
+        int.from_bytes(data[i * OFFSET_SIZE : (i + 1) * OFFSET_SIZE], "little")
+        for i in range(n)
+    ]
+    offsets.append(len(data))
+    values = []
+    for i in range(n):
+        if offsets[i] > offsets[i + 1]:
+            raise DeserializeError("offsets not monotonic")
+        values.append(elem.deserialize(data[offsets[i] : offsets[i + 1]]))
+    return values
+
+
+def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
+    if _is_basic(elem):
+        packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
+        limit = (limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return merkleize_chunks(packed, limit=limit)
+    chunks = b"".join(elem.hash_tree_root(v) for v in values)
+    return merkleize_chunks(chunks, limit=limit_elems)
+
+
+class Vector(_Parametrized, SSZType):
+    def __init__(self, elem: SSZType, length: int):
+        if length <= 0:
+            raise ValueError("Vector length must be positive")
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self) -> bool:
+        return self.elem.is_fixed_size()
+
+    def fixed_size(self) -> int:
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"{self!r}: expected {self.length} elements, got {len(value)}")
+        return _serialize_homogeneous(self.elem, value)
+
+    def deserialize(self, data: bytes) -> list:
+        return _deserialize_homogeneous(self.elem, data, self.length)
+
+    def hash_tree_root(self, value: list) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"{self!r}: expected {self.length} elements, got {len(value)}")
+        return _merkleize_homogeneous(self.elem, value, self.length)
+
+    def chunk_count(self) -> int:
+        if _is_basic(self.elem):
+            return (self.length * self.elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return self.length
+
+    def default(self) -> list:
+        return [self.elem.default() for _ in range(self.length)]
+
+    def to_json(self, value: list) -> list:
+        return [self.elem.to_json(v) for v in value]
+
+    def from_json(self, obj: list) -> list:
+        if len(obj) != self.length:
+            raise ValueError(f"{self!r}: expected {self.length} elements, got {len(obj)}")
+        return [self.elem.from_json(v) for v in obj]
+
+    def __repr__(self) -> str:
+        return f"Vector[{self.elem!r}, {self.length}]"
+
+
+class List(_Parametrized, SSZType):
+    def __init__(self, elem: SSZType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"{self!r}: {len(value)} elements exceeds limit")
+        return _serialize_homogeneous(self.elem, value)
+
+    def deserialize(self, data: bytes) -> list:
+        values = _deserialize_homogeneous(self.elem, data, None)
+        if len(values) > self.limit:
+            raise DeserializeError(f"{self!r}: {len(values)} elements exceeds limit")
+        return values
+
+    def hash_tree_root(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"{self!r}: {len(value)} elements exceeds limit")
+        root = _merkleize_homogeneous(self.elem, value, self.limit)
+        return mix_in_length(root, len(value))
+
+    def chunk_count(self) -> int:
+        if _is_basic(self.elem):
+            return (self.limit * self.elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return self.limit
+
+    def default(self) -> list:
+        return []
+
+    def to_json(self, value: list) -> list:
+        return [self.elem.to_json(v) for v in value]
+
+    def from_json(self, obj: list) -> list:
+        if len(obj) > self.limit:
+            raise ValueError(f"{self!r}: {len(obj)} elements exceeds limit")
+        return [self.elem.from_json(v) for v in obj]
+
+    def __repr__(self) -> str:
+        return f"List[{self.elem!r}, {self.limit}]"
+
+
+# ---------------------------------------------------------------------------
+# Bitfields (values are list[bool])
+# ---------------------------------------------------------------------------
+
+
+def _bits_to_bytes(bits: list, include_delimiter: bool) -> bytes:
+    n = len(bits)
+    total = n + 1 if include_delimiter else n
+    out = bytearray((total + 7) // 8) if total else bytearray(b"")
+    for i, bit in enumerate(bits):
+        if bit:
+            out[i // 8] |= 1 << (i % 8)
+    if include_delimiter:
+        out[n // 8] |= 1 << (n % 8)
+    return bytes(out)
+
+
+class Bitvector(_Parametrized, SSZType):
+    def __init__(self, length: int):
+        if length <= 0:
+            raise ValueError("Bitvector length must be positive")
+        self.length = length
+
+    def is_fixed_size(self) -> bool:
+        return True
+
+    def fixed_size(self) -> int:
+        return (self.length + 7) // 8
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) != self.length:
+            raise ValueError(f"Bitvector[{self.length}]: got {len(value)} bits")
+        return _bits_to_bytes(value, include_delimiter=False)
+
+    def deserialize(self, data: bytes) -> list:
+        if len(data) != self.fixed_size():
+            raise DeserializeError(f"Bitvector[{self.length}]: got {len(data)} bytes")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(self.length)]
+        # high bits beyond length must be zero
+        if self.length % 8 and data[-1] >> (self.length % 8):
+            raise DeserializeError("Bitvector has set padding bits")
+        return bits
+
+    def hash_tree_root(self, value: list) -> bytes:
+        return merkleize_chunks(
+            pack_bytes(self.serialize(value)), limit=self.chunk_count()
+        )
+
+    def chunk_count(self) -> int:
+        return (self.length + 255) // 256
+
+    def default(self) -> list:
+        return [False] * self.length
+
+    def to_json(self, value: list) -> str:
+        return "0x" + self.serialize(value).hex()
+
+    def from_json(self, obj: str) -> list:
+        return self.deserialize(_bytes_from_hex(obj))
+
+    def __repr__(self) -> str:
+        return f"Bitvector[{self.length}]"
+
+
+class Bitlist(_Parametrized, SSZType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def serialize(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {len(value)} bits")
+        return _bits_to_bytes(value, include_delimiter=True)
+
+    def deserialize(self, data: bytes) -> list:
+        if len(data) == 0:
+            raise DeserializeError("Bitlist must contain the delimiter bit")
+        if data[-1] == 0:
+            raise DeserializeError("Bitlist missing delimiter bit")
+        last = data[-1]
+        delimiter_pos = last.bit_length() - 1
+        n = (len(data) - 1) * 8 + delimiter_pos
+        if n > self.limit:
+            raise DeserializeError(f"Bitlist[{self.limit}]: got {n} bits")
+        return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(n)]
+
+    def hash_tree_root(self, value: list) -> bytes:
+        if len(value) > self.limit:
+            raise ValueError(f"Bitlist[{self.limit}]: got {len(value)} bits")
+        packed = pack_bytes(_bits_to_bytes(value, include_delimiter=False))
+        root = merkleize_chunks(packed, limit=self.chunk_count())
+        return mix_in_length(root, len(value))
+
+    def chunk_count(self) -> int:
+        return (self.limit + 255) // 256
+
+    def default(self) -> list:
+        return []
+
+    def to_json(self, value: list) -> str:
+        return "0x" + self.serialize(value).hex()
+
+    def from_json(self, obj: str) -> list:
+        return self.deserialize(_bytes_from_hex(obj))
+
+    def __repr__(self) -> str:
+        return f"Bitlist[{self.limit}]"
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class _ContainerMeta(type):
+    def __new__(mcls, name, bases, ns):
+        cls = super().__new__(mcls, name, bases, ns)
+        fields: dict[str, SSZType] = {}
+        for base in reversed(cls.__mro__[1:]):
+            fields.update(getattr(base, "__ssz_fields__", {}))
+        for key, val in ns.get("__annotations__", {}).items():
+            if isinstance(val, str):
+                # `from __future__ import annotations` stores strings; resolve
+                # against the defining module so fields aren't silently lost.
+                import sys as _sys
+
+                mod = _sys.modules.get(ns.get("__module__", ""), None)
+                mod_globals = getattr(mod, "__dict__", {})
+                try:
+                    val = eval(val, mod_globals, dict(ns))  # noqa: S307
+                except Exception as exc:
+                    raise TypeError(
+                        f"{name}.{key}: cannot resolve annotation {val!r}: {exc}"
+                    ) from exc
+            if isinstance(val, (SSZType, _ContainerMeta)):
+                fields[key] = val
+        cls.__ssz_fields__ = fields
+        return cls
+
+
+class Container(metaclass=_ContainerMeta):
+    """SSZ container. Declare fields as class annotations whose *values* are
+    SSZType descriptors::
+
+        class Checkpoint(Container):
+            epoch: uint64
+            root: ByteVector[32]
+
+    Instances are mutable attribute bags; missing constructor arguments get
+    type defaults. The class itself doubles as its own type descriptor (the
+    classmethods mirror the SSZType protocol)."""
+
+    __ssz_fields__: dict[str, SSZType] = {}
+
+    def __init__(self, **kwargs):
+        fields = type(self).__ssz_fields__
+        for key in kwargs:
+            if key not in fields:
+                raise TypeError(f"{type(self).__name__} has no field {key!r}")
+        for key, typ in fields.items():
+            object.__setattr__(
+                self, key, kwargs[key] if key in kwargs else typ.default()
+            )
+
+    # -- python niceties ----------------------------------------------------
+    def __eq__(self, other) -> bool:
+        if type(self) is not type(other):
+            return NotImplemented
+        return all(
+            getattr(self, k) == getattr(other, k) for k in type(self).__ssz_fields__
+        )
+
+    # Containers are mutable attribute bags: not hashable (use
+    # `.root()` explicitly when a stable digest is needed).
+    __hash__ = None
+
+    def __repr__(self) -> str:
+        inner = ", ".join(
+            f"{k}={getattr(self, k)!r}" for k in list(type(self).__ssz_fields__)[:4]
+        )
+        more = "" if len(type(self).__ssz_fields__) <= 4 else ", ..."
+        return f"{type(self).__name__}({inner}{more})"
+
+    def copy(self):
+        """Deep structural copy (lists copied, nested containers copied)."""
+        out = {}
+        for key, typ in type(self).__ssz_fields__.items():
+            out[key] = _copy_value(typ, getattr(self, key))
+        return type(self)(**out)
+
+    # -- SSZType protocol (classmethods) ------------------------------------
+    @classmethod
+    def fields(cls) -> dict[str, SSZType]:
+        return cls.__ssz_fields__
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return all(t.is_fixed_size() for t in cls.__ssz_fields__.values())
+
+    @classmethod
+    def fixed_size(cls) -> int:
+        if not cls.is_fixed_size():
+            raise NotImplementedError(f"{cls.__name__} is variable-size")
+        return sum(t.fixed_size() for t in cls.__ssz_fields__.values())
+
+    @classmethod
+    def serialize(cls, value: "Container") -> bytes:
+        fixed_parts: list[bytes | None] = []
+        variable_parts: list[bytes] = []
+        for key, typ in cls.__ssz_fields__.items():
+            v = getattr(value, key)
+            if typ.is_fixed_size():
+                fixed_parts.append(typ.serialize(v))
+            else:
+                fixed_parts.append(None)
+                variable_parts.append(typ.serialize(v))
+        fixed_len = sum(
+            len(p) if p is not None else OFFSET_SIZE for p in fixed_parts
+        )
+        offset = fixed_len
+        out = bytearray()
+        vlens = [len(p) for p in variable_parts]
+        vi = 0
+        for p in fixed_parts:
+            if p is not None:
+                out += p
+            else:
+                if offset + vlens[vi] >= MAX_LENGTH:
+                    raise ValueError(
+                        f"{cls.__name__}: serialized size exceeds u32 offset range"
+                    )
+                out += offset.to_bytes(OFFSET_SIZE, "little")
+                offset += vlens[vi]
+                vi += 1
+        for p in variable_parts:
+            out += p
+        return bytes(out)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Container":
+        fields = cls.__ssz_fields__
+        # pass 1: slice fixed region, collect offsets
+        pos = 0
+        offsets: list[int] = []
+        fixed_slices: dict[str, bytes] = {}
+        variable_keys: list[str] = []
+        for key, typ in fields.items():
+            if typ.is_fixed_size():
+                size = typ.fixed_size()
+                if pos + size > len(data):
+                    raise DeserializeError(f"{cls.__name__}: truncated at field {key}")
+                fixed_slices[key] = data[pos : pos + size]
+                pos += size
+            else:
+                if pos + OFFSET_SIZE > len(data):
+                    raise DeserializeError(f"{cls.__name__}: truncated offset at {key}")
+                offsets.append(int.from_bytes(data[pos : pos + OFFSET_SIZE], "little"))
+                variable_keys.append(key)
+                pos += OFFSET_SIZE
+        if offsets:
+            if offsets[0] != pos:
+                raise DeserializeError(
+                    f"{cls.__name__}: first offset {offsets[0]} != fixed size {pos}"
+                )
+        elif pos != len(data):
+            raise DeserializeError(
+                f"{cls.__name__}: {len(data) - pos} trailing bytes"
+            )
+        offsets.append(len(data))
+        for a, b in zip(offsets, offsets[1:]):
+            if a > b:
+                raise DeserializeError(f"{cls.__name__}: offsets not monotonic")
+        # pass 2: decode
+        kwargs = {}
+        vi = 0
+        for key, typ in fields.items():
+            if typ.is_fixed_size():
+                kwargs[key] = typ.deserialize(fixed_slices[key])
+            else:
+                kwargs[key] = typ.deserialize(data[offsets[vi] : offsets[vi + 1]])
+                vi += 1
+        return cls(**kwargs)
+
+    @classmethod
+    def hash_tree_root(cls, value: "Container") -> bytes:
+        chunks = b"".join(
+            typ.hash_tree_root(getattr(value, key))
+            for key, typ in cls.__ssz_fields__.items()
+        )
+        return merkleize_chunks(chunks)
+
+    @classmethod
+    def chunk_count(cls) -> int:
+        return len(cls.__ssz_fields__)
+
+    @classmethod
+    def default(cls) -> "Container":
+        return cls()
+
+    @classmethod
+    def to_json(cls, value: "Container") -> dict:
+        return {
+            key: typ.to_json(getattr(value, key))
+            for key, typ in cls.__ssz_fields__.items()
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Container":
+        # Missing fields are an error (serde-derive behavior in the
+        # reference); unknown keys are ignored (serde default).
+        kwargs = {}
+        for key, typ in cls.__ssz_fields__.items():
+            if key not in obj:
+                raise ValueError(f"{cls.__name__}: missing field {key!r} in JSON")
+            kwargs[key] = typ.from_json(obj[key])
+        return cls(**kwargs)
+
+    # instance conveniences
+    def encode(self) -> bytes:
+        return type(self).serialize(self)
+
+    def root(self) -> bytes:
+        return type(self).hash_tree_root(self)
+
+
+def _copy_value(typ: SSZType, value: Any):
+    if isinstance(value, Container):
+        return value.copy()
+    if isinstance(value, list):
+        elem = getattr(typ, "elem", None)
+        if elem is not None and not _is_basic(elem):
+            return [_copy_value(elem, v) for v in value]
+        return list(value)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Union (SSZ union; used by ssz_generic vectors and future forks)
+# ---------------------------------------------------------------------------
+
+
+class Union(_Parametrized, SSZType):
+    """SSZ Union[T0, T1, ...]; ``None`` as option 0 when T0 is None.
+    Values are ``(selector, value)`` tuples."""
+
+    def __init__(self, *options):
+        if not options or len(options) > 128:
+            raise ValueError("Union supports 1..128 options")
+        if options[0] is None and len(options) == 1:
+            raise ValueError("Union[None] is not allowed")
+        self.options = options
+
+    def is_fixed_size(self) -> bool:
+        return False
+
+    def serialize(self, value: tuple) -> bytes:
+        selector, inner = value
+        opt = self.options[selector]
+        if opt is None:
+            if inner is not None:
+                raise ValueError("Union None option carries no value")
+            return bytes([selector])
+        return bytes([selector]) + opt.serialize(inner)
+
+    def deserialize(self, data: bytes) -> tuple:
+        if not data:
+            raise DeserializeError("empty union encoding")
+        selector = data[0]
+        if selector >= len(self.options):
+            raise DeserializeError(f"union selector {selector} out of range")
+        opt = self.options[selector]
+        if opt is None:
+            if len(data) != 1:
+                raise DeserializeError("union None option carries no value")
+            return (0, None)
+        return (selector, opt.deserialize(data[1:]))
+
+    def hash_tree_root(self, value: tuple) -> bytes:
+        from .merkle import mix_in_selector
+
+        selector, inner = value
+        opt = self.options[selector]
+        root = zero_hash(0) if opt is None else opt.hash_tree_root(inner)
+        return mix_in_selector(root, selector)
+
+    def default(self) -> tuple:
+        opt = self.options[0]
+        return (0, None if opt is None else opt.default())
+
+    def to_json(self, value: tuple) -> dict:
+        selector, inner = value
+        opt = self.options[selector]
+        return {
+            "selector": selector,
+            "value": None if opt is None else opt.to_json(inner),
+        }
+
+    def from_json(self, obj: dict) -> tuple:
+        selector = int(obj["selector"])
+        opt = self.options[selector]
+        return (selector, None if opt is None else opt.from_json(obj["value"]))
+
+    def __repr__(self) -> str:
+        return f"Union[{', '.join(repr(o) for o in self.options)}]"
+
+
+# ---------------------------------------------------------------------------
+# Module-level conveniences
+# ---------------------------------------------------------------------------
+
+
+def serialize(typ, value=None) -> bytes:
+    if value is None and isinstance(typ, Container):
+        return type(typ).serialize(typ)
+    return typ.serialize(value)
+
+
+def deserialize(typ, data: bytes):
+    return typ.deserialize(data)
+
+
+def hash_tree_root(typ, value=None) -> bytes:
+    if value is None and isinstance(typ, Container):
+        return type(typ).hash_tree_root(typ)
+    return typ.hash_tree_root(value)
+
+
+# ---------------------------------------------------------------------------
+# Generalized indices over types (light-client proof support)
+# ---------------------------------------------------------------------------
+
+
+def _item_position(typ, index_or_name) -> tuple[int, int, SSZType]:
+    """(chunk_index, depth_extra_unused, elem_type) for a path step."""
+    if isinstance(typ, type) and issubclass(typ, Container):
+        keys = list(typ.__ssz_fields__)
+        pos = keys.index(index_or_name)
+        return pos, 0, typ.__ssz_fields__[index_or_name]
+    if isinstance(typ, (Vector, List)):
+        if _is_basic(typ.elem):
+            per_chunk = BYTES_PER_CHUNK // typ.elem.fixed_size()
+            return index_or_name // per_chunk, 0, typ.elem
+        return index_or_name, 0, typ.elem
+    if isinstance(typ, (Bitvector, Bitlist)):
+        return index_or_name // 256, 0, boolean
+    if isinstance(typ, (ByteVector, ByteList)):
+        return index_or_name // BYTES_PER_CHUNK, 0, uint8
+    raise TypeError(f"cannot index into {typ!r}")
+
+
+def _chunk_count_of(typ) -> int:
+    if isinstance(typ, type) and issubclass(typ, Container):
+        return typ.chunk_count()
+    return typ.chunk_count()
+
+
+def get_generalized_index(typ, *path) -> int:
+    """Spec `get_generalized_index`: walk ``path`` (field names / indices /
+    the literal string "__len__") from ``typ``, returning the generalized
+    index of the addressed subtree in the hash_tree_root of ``typ``."""
+    root = 1
+    for step in path:
+        if step == "__len__":
+            if not isinstance(typ, (List, Bitlist, ByteList)):
+                raise TypeError("__len__ only valid on lists")
+            root = root * 2 + 1
+            typ = uint64
+            continue
+        is_list = isinstance(typ, (List, Bitlist, ByteList))
+        pos, _, next_typ = _item_position(typ, step)
+        base = next_pow_of_two(_chunk_count_of(typ))
+        root = root * (2 if is_list else 1) * base + pos
+        typ = next_typ
+    return root

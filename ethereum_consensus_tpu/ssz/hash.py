@@ -1,0 +1,66 @@
+"""SHA-256 hashing backends for SSZ merkleization.
+
+The host backend uses hashlib; the device backend (registered lazily by
+``ethereum_consensus_tpu.ops.sha256``) runs a batched SHA-256 compression on
+TPU and is used by the merkleizer for large leaf counts.
+
+Reference parity: `crypto::hash` (ethereum-consensus/src/crypto/bls.rs:12-20)
+and the SHA-256 tree hash inside `ssz_rs::hash_tree_root`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable
+
+__all__ = [
+    "hash_bytes",
+    "hash_pair",
+    "hash_level_host",
+    "register_device_hasher",
+    "hash_level",
+    "DEVICE_MIN_NODES",
+]
+
+
+def hash_bytes(data: bytes) -> bytes:
+    """SHA-256 of arbitrary bytes (host)."""
+    return hashlib.sha256(data).digest()
+
+
+def hash_pair(left: bytes, right: bytes) -> bytes:
+    """SHA-256 of the 64-byte concatenation of two 32-byte nodes."""
+    return hashlib.sha256(left + right).digest()
+
+
+def hash_level_host(nodes: bytes) -> bytes:
+    """Hash one merkle level: ``nodes`` is ``2n`` 32-byte nodes concatenated;
+    returns ``n`` 32-byte parent nodes concatenated."""
+    out = bytearray(len(nodes) // 2)
+    for i in range(0, len(nodes), 64):
+        out[i // 2 : i // 2 + 32] = hashlib.sha256(nodes[i : i + 64]).digest()
+    return bytes(out)
+
+
+# -- device backend registry -------------------------------------------------
+
+# A device hasher has the same signature as hash_level_host. It is registered
+# by ops.sha256 at import time to avoid importing jax from the pure-host path.
+_device_hasher: Callable[[bytes], bytes] | None = None
+
+# Below this many parent nodes per level, host hashing wins (dispatch + copy
+# overhead dominates). Tuned conservatively; bench.py measures the crossover.
+DEVICE_MIN_NODES = 2048
+
+
+def register_device_hasher(fn: Callable[[bytes], bytes]) -> None:
+    global _device_hasher
+    _device_hasher = fn
+
+
+def hash_level(nodes: bytes) -> bytes:
+    """Hash one merkle level, routing to the device backend when registered
+    and the batch is large enough to amortize the transfer."""
+    if _device_hasher is not None and len(nodes) // 64 >= DEVICE_MIN_NODES:
+        return _device_hasher(nodes)
+    return hash_level_host(nodes)

@@ -1,0 +1,200 @@
+"""SSZ binary merkleization: merkleize, mix_in_length, zero-subtree cache,
+generalized indices and single-branch Merkle proofs.
+
+Reference parity: `ssz_rs`'s `hash_tree_root` / `prove` /
+`is_valid_merkle_branch_for_generalized_index` machinery (see SURVEY.md L0,
+ethereum-consensus/src/ssz/mod.rs:1-8 and
+spec-tests/runners/light_client.rs:10-13).
+"""
+
+from __future__ import annotations
+
+from .hash import hash_bytes, hash_level, hash_pair
+
+__all__ = [
+    "BYTES_PER_CHUNK",
+    "ZERO_CHUNK",
+    "zero_hash",
+    "merkleize",
+    "merkleize_chunks",
+    "mix_in_length",
+    "mix_in_selector",
+    "pack_bytes",
+    "next_pow_of_two",
+    "get_generalized_index_length",
+    "get_generalized_index_bit",
+    "concat_generalized_indices",
+    "compute_merkle_proof",
+    "is_valid_merkle_branch",
+    "is_valid_merkle_branch_for_generalized_index",
+]
+
+BYTES_PER_CHUNK = 32
+ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
+
+# zero_hash(i) = root of a fully-zero subtree of depth i.
+_ZERO_HASHES: list[bytes] = [ZERO_CHUNK]
+
+
+def zero_hash(depth: int) -> bytes:
+    while len(_ZERO_HASHES) <= depth:
+        h = _ZERO_HASHES[-1]
+        _ZERO_HASHES.append(hash_pair(h, h))
+    return _ZERO_HASHES[depth]
+
+
+def next_pow_of_two(value: int) -> int:
+    if value <= 1:
+        return 1
+    return 1 << (value - 1).bit_length()
+
+
+def pack_bytes(data: bytes) -> bytes:
+    """Right-pad serialized basic values to a whole number of chunks."""
+    rem = len(data) % BYTES_PER_CHUNK
+    if rem:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - rem)
+    return data
+
+
+def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
+    """Merkleize packed ``chunks`` (concatenated 32-byte chunks) into a root.
+
+    ``limit`` is the chunk-count bound (virtual tree width); ``None`` means
+    the tree width is the padded actual chunk count. Sparse padding uses the
+    zero-subtree cache, so a List[..., 2**40] bound costs only ~40 extra
+    hashes above the populated subtree.
+    """
+    if len(chunks) % BYTES_PER_CHUNK != 0:
+        raise ValueError(
+            f"chunks byte length {len(chunks)} is not a multiple of {BYTES_PER_CHUNK}; "
+            "pack inputs with pack_bytes() first"
+        )
+    count = len(chunks) // BYTES_PER_CHUNK
+    if limit is None:
+        width = next_pow_of_two(count)
+    else:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        width = next_pow_of_two(limit)
+    depth = (width - 1).bit_length()
+
+    if count == 0:
+        return zero_hash(depth)
+
+    nodes = chunks
+    for level in range(depth):
+        n = len(nodes) // BYTES_PER_CHUNK
+        if n % 2 == 1:
+            nodes = nodes + zero_hash(level)
+        nodes = hash_level(nodes)
+    return nodes
+
+
+def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
+    return merkleize_chunks(b"".join(chunks), limit)
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_pair(root, length.to_bytes(32, "little"))
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hash_pair(root, selector.to_bytes(32, "little"))
+
+
+# -- generalized indices -----------------------------------------------------
+
+
+def get_generalized_index_length(index: int) -> int:
+    """Depth of a generalized index (number of branch nodes in its proof)."""
+    return index.bit_length() - 1
+
+
+def get_generalized_index_bit(index: int, position: int) -> bool:
+    return (index >> position) & 1 == 1
+
+
+def _floor_pow_of_two(value: int) -> int:
+    return 1 << (value.bit_length() - 1)
+
+
+def concat_generalized_indices(*indices: int) -> int:
+    out = 1
+    for index in indices:
+        fp = _floor_pow_of_two(index)
+        out = out * fp + (index - fp)
+    return out
+
+
+def is_valid_merkle_branch(
+    leaf: bytes, branch: list[bytes], depth: int, index: int, root: bytes
+) -> bool:
+    """Spec `is_valid_merkle_branch` (phase0): verify a depth/index proof."""
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hash_pair(branch[i], value)
+        else:
+            value = hash_pair(value, branch[i])
+    return value == root
+
+
+def is_valid_merkle_branch_for_generalized_index(
+    leaf: bytes, branch: list[bytes], generalized_index: int, root: bytes
+) -> bool:
+    depth = get_generalized_index_length(generalized_index)
+    index = generalized_index - (1 << depth)
+    if len(branch) != depth:
+        return False
+    return is_valid_merkle_branch(leaf, branch, depth, index, root)
+
+
+# -- proof construction ------------------------------------------------------
+
+
+class Tree:
+    """A fully materialized binary merkle tree over padded chunks, used for
+    proof generation (``compute_merkle_proof``). Nodes are stored per level,
+    level 0 = leaves."""
+
+    def __init__(self, chunks: list[bytes], limit: int | None = None):
+        count = len(chunks)
+        width = next_pow_of_two(count if limit is None else limit)
+        self.depth = (width - 1).bit_length()
+        # Only materialize the populated region; zero-subtree roots fill the rest.
+        level = list(chunks)
+        self.levels: list[list[bytes]] = [level]
+        for d in range(self.depth):
+            nxt = []
+            if len(level) % 2 == 1:
+                level = level + [zero_hash(d)]
+            for i in range(0, len(level), 2):
+                nxt.append(hash_pair(level[i], level[i + 1]))
+            self.levels.append(nxt)
+            level = nxt
+
+    @property
+    def root(self) -> bytes:
+        if not self.levels[-1]:
+            return zero_hash(self.depth)
+        return self.levels[-1][0]
+
+    def node(self, depth_from_leaves: int, index: int) -> bytes:
+        level = self.levels[depth_from_leaves]
+        if index < len(level):
+            return level[index]
+        return zero_hash(depth_from_leaves)
+
+    def proof(self, leaf_index: int) -> list[bytes]:
+        """Sibling branch for ``leaf_index``, leaf-level first."""
+        branch = []
+        index = leaf_index
+        for d in range(self.depth):
+            branch.append(self.node(d, index ^ 1))
+            index >>= 1
+        return branch
+
+
+def compute_merkle_proof(chunks: list[bytes], leaf_index: int, limit: int | None = None) -> list[bytes]:
+    return Tree(chunks, limit).proof(leaf_index)
