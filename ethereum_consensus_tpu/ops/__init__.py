@@ -1,0 +1,24 @@
+"""Device kernels (JAX/XLA + Pallas TPU): SHA-256 merkleization, shuffling,
+epoch-processing sweeps.
+
+Import of this package pulls in jax; the pure-host layers (ssz/, models/)
+never import it directly — device acceleration is installed explicitly via
+``install()``.
+"""
+
+from .merkle import merkleize_chunks_device
+from .sha256 import install_device_hasher, sha256_64b_pallas, sha256_64b_xla
+
+
+def install() -> None:
+    """Install all device fast paths into the host layers."""
+    install_device_hasher()
+
+
+__all__ = [
+    "install",
+    "install_device_hasher",
+    "merkleize_chunks_device",
+    "sha256_64b_pallas",
+    "sha256_64b_xla",
+]

@@ -1,0 +1,87 @@
+"""Device-resident SSZ merkle tree reduction.
+
+Computes a full binary merkle root from packed 32-byte chunks entirely on
+device: every tree level is one batched SHA-256 call (see ops/sha256.py),
+traced into a single XLA program so intermediate levels never leave HBM.
+Virtual padding to huge SSZ limits (e.g. VALIDATOR_REGISTRY_LIMIT = 2^40)
+is applied by chaining host-precomputed zero-subtree hashes above the
+populated subtree — identical semantics to ssz/merkle.py's host merkleizer.
+
+Reference parity: `ssz_rs` hash_tree_root merkleization (SURVEY.md L0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ssz.merkle import BYTES_PER_CHUNK, next_pow_of_two, zero_hash
+from .sha256 import sha256_64b
+
+__all__ = ["merkle_root_words", "merkleize_chunks_device", "zero_hash_words"]
+
+_MAX_DEPTH = 64
+
+
+@functools.lru_cache(maxsize=1)
+def zero_hash_words() -> np.ndarray:
+    """(64, 8) uint32: zero-subtree root at each depth, as big-endian words."""
+    out = np.zeros((_MAX_DEPTH, 8), dtype=np.uint32)
+    for d in range(_MAX_DEPTH):
+        out[d] = np.frombuffer(zero_hash(d), dtype=">u4").astype(np.uint32)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def merkle_root_words(nodes: jax.Array, zero_words: jax.Array, depth: int) -> jax.Array:
+    """Reduce ``nodes`` (8, N) uint32 to the root of a depth-``depth`` tree.
+
+    Odd levels are padded with the precomputed ``zero_words`` (64, 8) sibling
+    for that level (the host merkleizer's strategy), so sparse trees never
+    hash into fully-zero subtrees. Levels above the populated region chain
+    zero-subtree siblings. Returns (8,) root words."""
+    n = nodes.shape[1]
+    level = 0
+    while n > 1:
+        if n % 2 == 1:
+            nodes = jnp.concatenate([nodes, zero_words[level][:, None]], axis=1)
+            n += 1
+        pairs = nodes.reshape(8, n // 2, 2)
+        msgs = jnp.concatenate([pairs[:, :, 0], pairs[:, :, 1]], axis=0)
+        nodes = sha256_64b(msgs)
+        n //= 2
+        level += 1
+    for d in range(level, depth):
+        msgs = jnp.concatenate([nodes, zero_words[d][:, None]], axis=0)
+        nodes = sha256_64b(msgs)
+    return nodes[:, 0]
+
+
+def merkleize_chunks_device(chunks: bytes, limit: int | None = None) -> bytes:
+    """Drop-in device equivalent of ssz.merkle.merkleize_chunks.
+
+    Bit-identical to the host merkleizer; intended for large chunk counts
+    (validator registries, balance lists, big leaf ranges)."""
+    if len(chunks) % BYTES_PER_CHUNK != 0:
+        raise ValueError("chunks must be a multiple of 32 bytes")
+    count = len(chunks) // BYTES_PER_CHUNK
+    if limit is None:
+        width = next_pow_of_two(count)
+    else:
+        if count > limit:
+            raise ValueError(f"chunk count {count} exceeds limit {limit}")
+        width = next_pow_of_two(limit)
+    depth = (width - 1).bit_length()
+    if count == 0:
+        return zero_hash(depth)
+
+    words = np.ascontiguousarray(
+        np.frombuffer(chunks, dtype=">u4").astype(np.uint32).reshape(count, 8).T
+    )
+    root = merkle_root_words(
+        jnp.asarray(words), jnp.asarray(zero_hash_words()), depth
+    )
+    return np.asarray(root).astype(">u4").tobytes()

@@ -49,8 +49,9 @@ def hash_level_host(nodes: bytes) -> bytes:
 _device_hasher: Callable[[bytes], bytes] | None = None
 
 # Below this many parent nodes per level, host hashing wins (dispatch + copy
-# overhead dominates). Tuned conservatively; bench.py measures the crossover.
-DEVICE_MIN_NODES = 2048
+# overhead dominates — measured ~4ms/dispatch through the axon tunnel, so a
+# level must carry >~100k hashes to beat hashlib's ~1.1 Mhash/s/core).
+DEVICE_MIN_NODES = 1 << 17
 
 
 def register_device_hasher(fn: Callable[[bytes], bytes]) -> None:

@@ -1,18 +1,48 @@
 """Test configuration.
 
-JAX runs on a virtual 8-device CPU mesh so multi-chip sharding paths are
-exercised without TPU hardware (flags must be set before jax imports).
+Tests run against whatever JAX backend the environment provides (the real
+TPU chip under axon; CPU elsewhere). Tests that need a multi-device mesh
+spawn a subprocess with a scrubbed environment forcing a virtual 8-device
+CPU platform — see ``cpu_mesh_env`` below — because the axon TPU plugin
+registers at interpreter startup and cannot be undone in-process.
 """
 
 import os
+import subprocess
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def cpu_mesh_env(n_devices: int = 8) -> dict:
+    """Environment for a subprocess with an n-device virtual CPU platform."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT  # drop the axon sitecustomize injection
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env
+
+
+def run_in_cpu_mesh(code: str, n_devices: int = 8, timeout: int = 600) -> str:
+    """Run ``code`` in a subprocess on the virtual CPU mesh; returns stdout."""
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=cpu_mesh_env(n_devices),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO_ROOT,
+    )
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"cpu-mesh subprocess failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )
+    return proc.stdout
+
+
+@pytest.fixture
+def cpu_mesh():
+    return run_in_cpu_mesh
