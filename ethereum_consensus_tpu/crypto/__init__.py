@@ -1,0 +1,20 @@
+"""Cryptography subsystem: BLS12-381 signatures + KZG/EIP-4844.
+
+Replaces the reference's blst (C/asm) and c-kzg (C) dependencies
+(ethereum-consensus/src/crypto/{mod,bls,kzg}.rs) with a from-scratch field/
+curve/pairing stack; batched device acceleration hooks in via ops/.
+"""
+
+from . import bls, curves, fields, hash_to_curve, pairing  # noqa: F401
+from .bls import (  # noqa: F401
+    PublicKey,
+    SecretKey,
+    Signature,
+    aggregate,
+    aggregate_verify,
+    eth_aggregate_public_keys,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    hash,
+    verify_signature,
+)

@@ -1,0 +1,239 @@
+"""Derive the 3-isogeny used by the G2 simplified-SWU map (RFC 9380 §8.8.2).
+
+The RFC publishes the isogeny's rational-map coefficients as constants
+(Appendix E.3); offline, we re-derive them from first principles:
+
+  1. The SSWU map targets the isogenous curve
+         E'': y² = x³ + A'x + B',  A' = 240u,  B' = 1012(1+u)  over Fq2.
+  2. A rational 3-isogeny φ: E'' → E' (the G2 twist, y² = x³ + 4(1+u))
+     has kernel {O, (x0, ±y0)} where x0 ∈ Fq2 is a root of the 3-division
+     polynomial ψ₃(x) = 3x⁴ + 6A'x² + 12B'x − A'².
+  3. Vélu's formulas give the rational maps and codomain; the root whose
+     codomain is exactly E' identifies the kernel the RFC chose.
+
+Run as a module to (re)generate ``g2_isogeny.py``; the test suite re-runs
+the derivation and checks the stored constants (and that mapped points land
+on E' and the map is a group homomorphism).
+"""
+
+from __future__ import annotations
+
+from .fields import Fq, Fq2, P
+
+# SSWU target curve E'' parameters (RFC 9380 §8.8.2).
+ISO_A = Fq2.from_ints(0, 240)
+ISO_B = Fq2.from_ints(1012, 1012)
+SSWU_Z = Fq2(Fq(P - 2), Fq(P - 1))  # -(2 + u)
+
+# E' (the G2 twist) coefficients.
+E2_A = Fq2.zero()
+E2_B = Fq2.from_ints(4, 4)
+
+
+# -- minimal polynomial arithmetic over Fq2 ---------------------------------
+# polynomials are coefficient lists, low degree first
+
+
+def _poly_trim(a: list[Fq2]) -> list[Fq2]:
+    while a and a[-1].is_zero():
+        a.pop()
+    return a
+
+
+def _poly_mul(a: list[Fq2], b: list[Fq2]) -> list[Fq2]:
+    out = [Fq2.zero()] * (len(a) + len(b) - 1)
+    for i, ai in enumerate(a):
+        if ai.is_zero():
+            continue
+        for j, bj in enumerate(b):
+            out[i + j] = out[i + j] + ai * bj
+    return _poly_trim(out)
+
+
+def _poly_mod(a: list[Fq2], m: list[Fq2]) -> list[Fq2]:
+    a = list(a)
+    inv_lead = m[-1].inverse()
+    while len(a) >= len(m):
+        coef = a[-1] * inv_lead
+        shift = len(a) - len(m)
+        for i, mi in enumerate(m):
+            a[shift + i] = a[shift + i] - coef * mi
+        _poly_trim(a)
+        if not a:
+            break
+    return a
+
+
+def _poly_pow_mod(base: list[Fq2], e: int, m: list[Fq2]) -> list[Fq2]:
+    result = [Fq2.one()]
+    base = _poly_mod(base, m)
+    while e:
+        if e & 1:
+            result = _poly_mod(_poly_mul(result, base), m)
+        base = _poly_mod(_poly_mul(base, base), m)
+        e >>= 1
+    return result
+
+
+def _poly_gcd(a: list[Fq2], b: list[Fq2]) -> list[Fq2]:
+    a, b = list(a), list(b)
+    while b:
+        a, b = b, _poly_mod(a, b)
+    # monic
+    inv = a[-1].inverse()
+    return [c * inv for c in a]
+
+
+def _poly_eval(a: list[Fq2], x: Fq2) -> Fq2:
+    acc = Fq2.zero()
+    for c in reversed(a):
+        acc = acc * x + c
+    return acc
+
+
+def _quartic_roots_in_fq2(poly: list[Fq2]) -> list[Fq2]:
+    """Roots of ``poly`` (≤ quartic) lying in Fq2, via gcd with x^(p²) − x."""
+    q = P * P
+    xq = _poly_pow_mod([Fq2.zero(), Fq2.one()], q, poly)  # x^q mod poly
+    xq_minus_x = _poly_trim(
+        [xq[i] if i != 1 else xq[i] - Fq2.one() for i in range(len(xq))]
+        if len(xq) > 1
+        else [xq[0] if xq else Fq2.zero(), -Fq2.one()]
+    )
+    split = _poly_gcd(poly, xq_minus_x)
+    # extract roots of the (low-degree) split factor by degree cases
+    roots: list[Fq2] = []
+    deg = len(split) - 1
+    if deg == 0:
+        return roots
+    if deg == 1:
+        roots.append(-(split[0] * split[1].inverse()))
+        return roots
+    if deg == 2:
+        c, b, a = split[0], split[1], split[2]
+        disc = b * b - Fq2.from_ints(4, 0) * a * c
+        s = disc.sqrt()
+        if s is not None:
+            inv2a = (a + a).inverse()
+            roots.append((-b + s) * inv2a)
+            roots.append((-b - s) * inv2a)
+        return roots
+    # deg 3/4: find one root by trying linear gcds with random shifts —
+    # fall back to exhaustive factor peeling via repeated quadratic solves
+    raise NotImplementedError(f"unexpected split degree {deg}")
+
+
+def derive() -> dict:
+    """Derive the isogeny kernel and the scaling onto E'.
+
+    Velu's codomain for the rational kernel root is y² = x³ + 2916(1+u) =
+    x³ + 4·3⁶(1+u); composing with the isomorphism (x,y) → (x/9, y/27)
+    (scaling c = 1/3, c⁴a = 0, c⁶b = b/729) lands exactly on E'. The
+    composed coefficients reproduce the RFC 9380 Appendix E.3 constants
+    (k_(1,0) = 0x5c759507…97d6·(1+u) etc.)."""
+    A, B = ISO_A, ISO_B
+    three = Fq2.from_ints(3, 0)
+    six = Fq2.from_ints(6, 0)
+    twelve = Fq2.from_ints(12, 0)
+    # ψ₃(x) = 3x⁴ + 6Ax² + 12Bx − A²
+    psi3 = _poly_trim([-(A * A), twelve * B, six * A, Fq2.zero(), three])
+    roots = _quartic_roots_in_fq2(psi3)
+    if not roots:
+        raise RuntimeError("no rational 3-torsion x-coordinate found")
+
+    nine = Fq2.from_ints(9, 0)
+    for x0 in roots:
+        # Vélu sums for the kernel {(x0, ±y0)} (one representative):
+        gx = three * x0 * x0 + A
+        t = gx + gx                       # 2(3x0² + A)
+        u4y2 = (x0 * x0 * x0 + A * x0 + B)
+        u = Fq2.from_ints(4, 0) * u4y2    # 4y0² (rational in x0)
+        w = u + x0 * t
+        a_new = A - Fq2.from_ints(5, 0) * t
+        b_new = B - Fq2.from_ints(7, 0) * w
+        # accept codomains reachable from E' by the scaling (x,y)→(c²x,c³y)
+        if a_new == E2_A and b_new == E2_B * nine * nine * nine:
+            # b_new = 729·b' → c = 1/3
+            return {"x0": x0, "t": t, "u": u}
+        if a_new == E2_A and b_new == E2_B:
+            return {"x0": x0, "t": t, "u": u}
+    raise RuntimeError(
+        "no kernel root maps E'' onto (a scaling of) E': "
+        + ", ".join(repr(r) for r in roots)
+    )
+
+
+def rational_maps(consts: dict):
+    """Composed rational maps (Vélu ∘ scaling) as coefficient lists
+    (low-first) over Fq2, in the RFC's monic-denominator normal form:
+
+        X(x) = x_num(x) / x_den(x),   x_den = (x − x0)²       (monic, deg 2)
+        Y(x,y) = y · y_num(x) / y_den(x),  y_den = (x − x0)³  (monic, deg 3)
+
+    Vélu: x_num = x(x−x0)² + t(x−x0) + u,  y_num = (x−x0)³ − t(x−x0) − 2u;
+    scaling c = −1/3 divides x_num by c² = 1/9 and y_num by c³ = −1/27.
+    (Both ±1/3 satisfy c⁶ = 1/729; the RFC's constants correspond to −1/3 —
+    with +1/3 every mapped point comes out negated, which is self-consistent
+    but not interoperable. Anchored by the k_(3,3) constant check in tests.)
+    """
+    x0, t, u = consts["x0"], consts["t"], consts["u"]
+    one = Fq2.one()
+    # (x - x0)^2 and ^3
+    d1 = [-x0, one]
+    d2 = _poly_mul(d1, d1)
+    d3 = _poly_mul(d2, d1)
+    # x_num = x·(x−x0)² + t·(x−x0) + u
+    x_num = [Fq2.zero()] + d2
+    x_num = [
+        x_num[0] + t * d1[0] + u,
+        x_num[1] + t * d1[1],
+        x_num[2],
+        x_num[3],
+    ]
+    y_num = [
+        d3[0] - t * d1[0] - (u + u),
+        d3[1] - t * d1[1],
+        d3[2],
+        d3[3],
+    ]
+    inv9 = Fq2.from_ints(9, 0).inverse()
+    neg_inv27 = -(Fq2.from_ints(27, 0).inverse())
+    x_num = [c * inv9 for c in x_num]
+    y_num = [c * neg_inv27 for c in y_num]
+    return {"x_num": x_num, "x_den": d2, "y_num": y_num, "y_den": d3}
+
+
+def _fq2_literal(v: Fq2) -> str:
+    return f"Fq2(Fq(0x{v.c0.n:x}), Fq(0x{v.c1.n:x}))"
+
+
+def generate_module() -> str:
+    consts = derive()
+    maps = rational_maps(consts)
+    lines = [
+        '"""G2 SSWU 3-isogeny constants — GENERATED by _isogeny_derive.py.',
+        "",
+        "Derived via Velu's formulas from the RFC 9380 §8.8.2 curve parameters;",
+        "the derivation is re-run and cross-checked by tests/test_bls.py.",
+        '"""',
+        "",
+        "from .fields import Fq, Fq2",
+        "",
+        f"KERNEL_X0 = {_fq2_literal(consts['x0'])}",
+        "",
+    ]
+    for name in ("x_num", "x_den", "y_num", "y_den"):
+        lines.append(f"{name.upper()} = [")
+        for c in maps[name]:
+            lines.append(f"    {_fq2_literal(c)},")
+        lines.append("]")
+        lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    import pathlib
+
+    out = pathlib.Path(__file__).parent / "g2_isogeny.py"
+    out.write_text(generate_module())
+    print(f"wrote {out}")

@@ -1,0 +1,268 @@
+"""BLS signatures over BLS12-381 (min_pk: public keys in G1, signatures in
+G2), with the Ethereum consensus-layer semantics.
+
+Reference parity: ethereum-consensus/src/crypto/bls.rs — SecretKey/PublicKey/
+Signature types, sign, verify_signature (:64-112), aggregate,
+aggregate_verify, fast_aggregate_verify (:114), eth_aggregate_public_keys
+(:135), eth_fast_aggregate_verify (:150, the infinity-signature rule), and
+the SHA-256 `hash` helper (:12). The reference wraps the blst C/assembly
+library; here the pure-Python oracle (fields/curves/pairing/hash_to_curve)
+provides exact semantics, and batched device paths hook in above the
+multi-pairing product.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from ..error import (
+    InvalidPublicKeyError,
+    InvalidSecretKeyError,
+    InvalidSignatureError,
+)
+from .curves import (
+    G1_GENERATOR,
+    G1Point,
+    G2Point,
+    InvalidPointError,
+)
+from .fields import R
+from .hash_to_curve import ETH_DST, hash_to_g2
+from .pairing import pairing_product_is_one
+
+__all__ = [
+    "SecretKey",
+    "PublicKey",
+    "Signature",
+    "hash",
+    "aggregate",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "eth_aggregate_public_keys",
+    "eth_fast_aggregate_verify",
+    "SECRET_KEY_SIZE",
+    "PUBLIC_KEY_SIZE",
+    "SIGNATURE_SIZE",
+]
+
+SECRET_KEY_SIZE = 32
+PUBLIC_KEY_SIZE = 48
+SIGNATURE_SIZE = 96
+
+
+def hash(data: bytes) -> bytes:  # noqa: A001 - mirrors crypto::hash
+    """SHA-256 (crypto/bls.rs:12-20)."""
+    return hashlib.sha256(data).digest()
+
+
+class SecretKey:
+    """Scalar in [1, r-1]. (bls.rs SecretKey)"""
+
+    __slots__ = ("_scalar",)
+
+    def __init__(self, scalar: int):
+        if not 0 < scalar < R:
+            raise InvalidSecretKeyError("secret key scalar out of range")
+        self._scalar = scalar
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "SecretKey":
+        if len(data) != SECRET_KEY_SIZE:
+            raise InvalidSecretKeyError(
+                f"secret key must be {SECRET_KEY_SIZE} bytes, got {len(data)}"
+            )
+        return cls(int.from_bytes(data, "big"))
+
+    @classmethod
+    def random(cls) -> "SecretKey":
+        # 384-bit draw reduced mod r: bias < 2^-129 (the RFC 9380
+        # hash_to_field approach), unlike a 255-bit draw which skews
+        # low scalars by 1.5x.
+        while True:
+            candidate = int.from_bytes(secrets.token_bytes(48), "big") % R
+            if candidate != 0:
+                return cls(candidate)
+
+    def to_bytes(self) -> bytes:
+        return self._scalar.to_bytes(SECRET_KEY_SIZE, "big")
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey(G1_GENERATOR * self._scalar)
+
+    def sign(self, message: bytes, dst: bytes = ETH_DST) -> "Signature":
+        return Signature(hash_to_g2(message, dst) * self._scalar)
+
+    def __repr__(self) -> str:
+        return "SecretKey(...)"  # never print key material
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, SecretKey) and self._scalar == other._scalar
+
+    __hash__ = None
+
+
+class PublicKey:
+    """G1 point, 48-byte compressed. Infinity is rejected (blst
+    key_validate semantics: a pubkey must be a valid non-identity subgroup
+    point)."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point: G1Point):
+        self.point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PublicKey":
+        try:
+            point = G1Point.deserialize(bytes(data))
+        except InvalidPointError as exc:
+            raise InvalidPublicKeyError(str(exc)) from exc
+        if point.is_infinity():
+            raise InvalidPublicKeyError("public key cannot be the identity")
+        return cls(point)
+
+    def to_bytes(self) -> bytes:
+        return self.point.serialize()
+
+    def validate(self) -> None:
+        if self.point.is_infinity():
+            raise InvalidPublicKeyError("public key cannot be the identity")
+        if not self.point.is_on_curve() or not self.point.in_subgroup():
+            raise InvalidPublicKeyError("public key not in G1 subgroup")
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PublicKey) and self.point == other.point
+
+    def __hash__(self):
+        # NB: bare `hash` in this module is the SHA-256 helper
+        return self.to_bytes().__hash__()
+
+    def __repr__(self) -> str:
+        return f"PublicKey(0x{self.to_bytes().hex()})"
+
+
+class Signature:
+    """G2 point, 96-byte compressed. The identity encoding is accepted at
+    parse time (it is needed for the eth_fast_aggregate_verify rule) but
+    never verifies against a real message/pubkey pair."""
+
+    __slots__ = ("point",)
+
+    def __init__(self, point: G2Point):
+        self.point = point
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Signature":
+        try:
+            return cls(G2Point.deserialize(bytes(data)))
+        except InvalidPointError as exc:
+            raise InvalidSignatureError(str(exc)) from exc
+
+    def to_bytes(self) -> bytes:
+        return self.point.serialize()
+
+    def is_infinity(self) -> bool:
+        return self.point.is_infinity()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Signature) and self.point == other.point
+
+    def __hash__(self):
+        # NB: bare `hash` in this module is the SHA-256 helper
+        return self.to_bytes().__hash__()
+
+    def __repr__(self) -> str:
+        return f"Signature(0x{self.to_bytes().hex()})"
+
+
+# ---------------------------------------------------------------------------
+# Verification primitives
+# ---------------------------------------------------------------------------
+
+
+def verify_signature(
+    public_key: PublicKey, message: bytes, signature: Signature, dst: bytes = ETH_DST
+) -> bool:
+    """e(pk, H(m)) == e(g1, sig)  (bls.rs verify_signature)."""
+    if signature.is_infinity() or public_key.point.is_infinity():
+        return False
+    h = hash_to_g2(message, dst)
+    return pairing_product_is_one(
+        [(public_key.point, h), (-G1_GENERATOR, signature.point)]
+    )
+
+
+def aggregate(signatures: list[Signature]) -> Signature:
+    """Sum of signature points; errors on empty input (bls.rs aggregate)."""
+    if not signatures:
+        raise InvalidSignatureError("cannot aggregate zero signatures")
+    acc = G2Point.infinity()
+    for sig in signatures:
+        acc = acc + sig.point
+    return Signature(acc)
+
+
+def aggregate_verify(
+    public_keys: list[PublicKey],
+    messages: list[bytes],
+    signature: Signature,
+    dst: bytes = ETH_DST,
+) -> bool:
+    """Π e(pk_i, H(m_i)) == e(g1, sig) (bls.rs aggregate_verify)."""
+    if len(public_keys) != len(messages) or not public_keys:
+        return False
+    if signature.is_infinity():
+        return False
+    if any(pk.point.is_infinity() for pk in public_keys):
+        return False
+    pairs: list[tuple[G1Point, G2Point]] = [
+        (pk.point, hash_to_g2(msg, dst))
+        for pk, msg in zip(public_keys, messages)
+    ]
+    pairs.append((-G1_GENERATOR, signature.point))
+    return pairing_product_is_one(pairs)
+
+
+def fast_aggregate_verify(
+    public_keys: list[PublicKey],
+    message: bytes,
+    signature: Signature,
+    dst: bytes = ETH_DST,
+) -> bool:
+    """All keys sign the same message: aggregate the pubkeys, verify once
+    (bls.rs fast_aggregate_verify:114)."""
+    if not public_keys:
+        return False
+    acc = G1Point.infinity()
+    for pk in public_keys:
+        acc = acc + pk.point
+    return verify_signature(PublicKey(acc), message, signature, dst)
+
+
+def eth_aggregate_public_keys(public_keys: list[PublicKey]) -> PublicKey:
+    """Spec `eth_aggregate_pubkeys` (bls.rs eth_aggregate_public_keys:135):
+    errors on empty input or invalid keys; the aggregate may legitimately be
+    used for sync-committee processing."""
+    if not public_keys:
+        raise InvalidPublicKeyError("cannot aggregate zero public keys")
+    acc = G1Point.infinity()
+    for pk in public_keys:
+        pk.validate()
+        acc = acc + pk.point
+    return PublicKey(acc)
+
+
+def eth_fast_aggregate_verify(
+    public_keys: list[PublicKey],
+    message: bytes,
+    signature: Signature,
+    dst: bytes = ETH_DST,
+) -> bool:
+    """Spec `eth_fast_aggregate_verify` (bls.rs:150): returns True for an
+    empty key list when the signature is the G2 identity encoding (the
+    sync-aggregate "no participants" rule), otherwise defers to
+    fast_aggregate_verify."""
+    if not public_keys and signature.is_infinity():
+        return True
+    return fast_aggregate_verify(public_keys, message, signature, dst)

@@ -1,0 +1,386 @@
+"""KZG polynomial commitments for EIP-4844 blobs (deneb).
+
+Reference parity: ethereum-consensus/src/crypto/kzg.rs — KzgSettings +
+trusted-setup loading (:39), blob_to_kzg_commitment (:60),
+compute_kzg_proof (:71), compute_blob_kzg_proof (:88), verify_kzg_proof
+(:101), verify_blob_kzg_proof (:124), verify_blob_kzg_proof_batch (:139).
+The reference wraps the c-kzg C library; here the polynomial math runs on
+the from-scratch BLS12-381 stack (fields/curves/pairing), in the evaluation
+(Lagrange, bit-reversal-permuted) form the EIP-4844 spec prescribes.
+
+Trusted setups:
+  - ``KzgSettings.from_json`` loads the standard c-kzg JSON layout
+    (``g1_lagrange``/``g2_monomial``, or legacy ``setup_G1_lagrange``/
+    ``setup_G2``) — use this with the published mainnet ceremony output.
+  - ``KzgSettings.insecure_dev_setup(tau, n)`` derives a mathematically
+    valid setup from a KNOWN secret — test-only by construction, and also
+    the only way to get a small-domain setup for fast tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..error import KzgError
+from .curves import G1Point, G2Point, G1_GENERATOR, G2_GENERATOR, InvalidPointError
+from .fields import Fr, R
+
+__all__ = [
+    "FIELD_ELEMENTS_PER_BLOB",
+    "BYTES_PER_FIELD_ELEMENT",
+    "BYTES_PER_BLOB",
+    "KzgCommitment",
+    "KzgProof",
+    "KzgSettings",
+    "blob_to_kzg_commitment",
+    "compute_kzg_proof",
+    "compute_blob_kzg_proof",
+    "verify_kzg_proof",
+    "verify_blob_kzg_proof",
+    "verify_blob_kzg_proof_batch",
+]
+
+FIELD_ELEMENTS_PER_BLOB = 4096
+BYTES_PER_FIELD_ELEMENT = 32
+BYTES_PER_BLOB = FIELD_ELEMENTS_PER_BLOB * BYTES_PER_FIELD_ELEMENT
+
+# Fiat-Shamir domains (EIP-4844 polynomial-commitments spec).
+FIAT_SHAMIR_PROTOCOL_DOMAIN = b"FSBLOBVERIFY_V1_"
+RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
+
+# Fr multiplicative generator and 2-adicity for roots of unity.
+_FR_GENERATOR = 7
+_FR_TWO_ADICITY = 32
+
+
+def _roots_of_unity(order: int) -> list[int]:
+    """The order-``order`` subgroup of Fr*, in natural order."""
+    if order & (order - 1):
+        raise KzgError("domain order must be a power of two")
+    if order > 1 << _FR_TWO_ADICITY:
+        raise KzgError("domain order exceeds Fr two-adicity")
+    root = pow(_FR_GENERATOR, (R - 1) // order, R)
+    out = [1]
+    for _ in range(order - 1):
+        out.append(out[-1] * root % R)
+    return out
+
+
+def _bit_reversal_permutation(values: list) -> list:
+    n = len(values)
+    bits = n.bit_length() - 1
+    return [values[int(format(i, f"0{bits}b")[::-1], 2)] if bits else values[i] for i in range(n)]
+
+
+class KzgCommitment(bytes):
+    """48-byte compressed G1 commitment."""
+
+    def __new__(cls, data: bytes):
+        if len(data) != 48:
+            raise KzgError("KZG commitment must be 48 bytes")
+        return super().__new__(cls, data)
+
+
+class KzgProof(bytes):
+    """48-byte compressed G1 proof."""
+
+    def __new__(cls, data: bytes):
+        if len(data) != 48:
+            raise KzgError("KZG proof must be 48 bytes")
+        return super().__new__(cls, data)
+
+
+class KzgSettings:
+    """Trusted setup in the blob-native form: G1 points of the Lagrange
+    basis over the bit-reversal-permuted evaluation domain, plus [1]_2 and
+    [τ]_2."""
+
+    def __init__(self, g1_lagrange_brp: list[G1Point], g2_monomial: list[G2Point]):
+        n = len(g1_lagrange_brp)
+        if n & (n - 1):
+            raise KzgError("setup size must be a power of two")
+        if len(g2_monomial) < 2:
+            raise KzgError("setup needs at least [1]_2 and [tau]_2")
+        self.g1_lagrange_brp = g1_lagrange_brp
+        self.g2_monomial = g2_monomial
+        self.n = n
+        self.roots_brp = _bit_reversal_permutation(_roots_of_unity(n))
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def from_json(cls, text: str) -> "KzgSettings":
+        """Load the c-kzg JSON trusted-setup layout."""
+        obj = json.loads(text)
+        g1 = obj.get("g1_lagrange") or obj.get("setup_G1_lagrange") or obj.get("setup_G1")
+        g2 = obj.get("g2_monomial") or obj.get("setup_G2")
+        if g1 is None or g2 is None:
+            raise KzgError("unrecognized trusted setup JSON layout")
+
+        def parse_g1(h: str) -> G1Point:
+            return G1Point.deserialize(bytes.fromhex(h.removeprefix("0x")))
+
+        def parse_g2(h: str) -> G2Point:
+            return G2Point.deserialize(bytes.fromhex(h.removeprefix("0x")))
+
+        try:
+            return cls([parse_g1(h) for h in g1], [parse_g2(h) for h in g2])
+        except InvalidPointError as exc:
+            raise KzgError(f"invalid point in trusted setup: {exc}") from exc
+
+    @classmethod
+    def from_file(cls, path: str) -> "KzgSettings":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    @classmethod
+    def insecure_dev_setup(cls, tau: int = 0x107A5, n: int = FIELD_ELEMENTS_PER_BLOB) -> "KzgSettings":
+        """Derive a setup from the KNOWN secret ``tau`` — INSECURE, test-only.
+
+        With tau known, the Lagrange values l_j(τ) are plain field scalars:
+            l_j(τ) = w_j·(τ^n − 1) / (n·(τ − w_j))
+        so the setup costs one scalar-mult per point instead of an MSM."""
+        roots = _roots_of_unity(n)
+        tau %= R
+        if tau in roots or tau == 0:
+            raise KzgError("pathological dev tau")
+        tn1 = (pow(tau, n, R) - 1) % R
+        n_inv = pow(n, R - 2, R)
+        g1 = []
+        for w in roots:
+            lj = w * tn1 % R * pow((tau - w) % R, R - 2, R) % R * n_inv % R
+            g1.append(G1_GENERATOR * lj)
+        g1_brp = _bit_reversal_permutation(g1)
+        g2 = [G2_GENERATOR, G2_GENERATOR * tau]
+        return cls(g1_brp, g2)
+
+
+# ---------------------------------------------------------------------------
+# field-element / blob codecs
+# ---------------------------------------------------------------------------
+
+
+def _fr_from_bytes(data: bytes) -> int:
+    """Big-endian 32-byte scalar, must be canonical (< r)."""
+    if len(data) != BYTES_PER_FIELD_ELEMENT:
+        raise KzgError("field element must be 32 bytes")
+    v = int.from_bytes(data, "big")
+    if v >= R:
+        raise KzgError("field element not canonical")
+    return v
+
+
+def _fr_to_bytes(v: int) -> bytes:
+    return (v % R).to_bytes(BYTES_PER_FIELD_ELEMENT, "big")
+
+
+def _blob_to_polynomial(blob: bytes, settings: KzgSettings) -> list[int]:
+    expected = settings.n * BYTES_PER_FIELD_ELEMENT
+    if len(blob) != expected:
+        raise KzgError(f"blob must be {expected} bytes, got {len(blob)}")
+    return [
+        _fr_from_bytes(blob[i * 32 : (i + 1) * 32]) for i in range(settings.n)
+    ]
+
+
+def _hash_to_bls_field(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha256(data).digest(), "big") % R
+
+
+# ---------------------------------------------------------------------------
+# polynomial math (evaluation form over the brp domain)
+# ---------------------------------------------------------------------------
+
+
+def _evaluate_polynomial_in_evaluation_form(
+    evals: list[int], z: int, settings: KzgSettings
+) -> int:
+    """Barycentric evaluation at z over the brp domain:
+        p(z) = (z^n − 1)/n · Σ_i e_i·w_i/(z − w_i)
+    with the in-domain short-circuit."""
+    n = settings.n
+    roots = settings.roots_brp
+    z %= R
+    for i, w in enumerate(roots):
+        if z == w:
+            return evals[i]
+    total = 0
+    for e, w in zip(evals, roots):
+        total = (total + e * w % R * pow((z - w) % R, R - 2, R)) % R
+    zn1 = (pow(z, n, R) - 1) % R
+    n_inv = pow(n, R - 2, R)
+    return total * zn1 % R * n_inv % R
+
+
+def _g1_lincomb(points: list[G1Point], scalars: list[int]) -> G1Point:
+    """Σ s_i·P_i (naive; device MSM hooks replace this for the hot path)."""
+    acc = G1Point.infinity()
+    for p, s in zip(points, scalars):
+        s %= R
+        if s == 0:
+            continue
+        acc = acc + p * s
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# public KZG operations (EIP-4844 semantics)
+# ---------------------------------------------------------------------------
+
+
+def blob_to_kzg_commitment(blob: bytes, settings: KzgSettings) -> KzgCommitment:
+    evals = _blob_to_polynomial(blob, settings)
+    return KzgCommitment(_g1_lincomb(settings.g1_lagrange_brp, evals).serialize())
+
+
+def compute_kzg_proof(blob: bytes, z_bytes: bytes, settings: KzgSettings) -> tuple[KzgProof, bytes]:
+    """Returns (proof, y_bytes) for evaluation at z (kzg.rs:71)."""
+    evals = _blob_to_polynomial(blob, settings)
+    z = _fr_from_bytes(z_bytes)
+    proof, y = _compute_kzg_proof_impl(evals, z, settings)
+    return proof, _fr_to_bytes(y)
+
+
+def _compute_kzg_proof_impl(
+    evals: list[int], z: int, settings: KzgSettings
+) -> tuple[KzgProof, int]:
+    n = settings.n
+    roots = settings.roots_brp
+    y = _evaluate_polynomial_in_evaluation_form(evals, z, settings)
+
+    # quotient q(X) = (p(X) − y)/(X − z) in evaluation form
+    q = [0] * n
+    if z in roots:
+        # z on the domain: use the L'Hôpital-style special column
+        m = roots.index(z)
+        for i, w in enumerate(roots):
+            if i == m:
+                continue
+            q[i] = (evals[i] - y) % R * pow((w - z) % R, R - 2, R) % R
+        # q_m = Σ_{i≠m} (e_i − y)·w_i / (z·(z − w_i))
+        acc = 0
+        for i, w in enumerate(roots):
+            if i == m:
+                continue
+            term = (evals[i] - y) % R * w % R
+            term = term * pow(z * (z - w) % R, R - 2, R) % R
+            acc = (acc + term) % R
+        q[m] = acc
+    else:
+        for i, w in enumerate(roots):
+            q[i] = (evals[i] - y) % R * pow((w - z) % R, R - 2, R) % R
+
+    proof_point = _g1_lincomb(settings.g1_lagrange_brp, q)
+    return KzgProof(proof_point.serialize()), y
+
+
+def verify_kzg_proof(
+    commitment: bytes, z_bytes: bytes, y_bytes: bytes, proof: bytes, settings: KzgSettings
+) -> bool:
+    """Pairing check e(P − y·g1, g2) == e(proof, [τ]_2 − z·g2) (kzg.rs:101)."""
+    z = _fr_from_bytes(z_bytes)
+    y = _fr_from_bytes(y_bytes)
+    try:
+        c = G1Point.deserialize(bytes(commitment))
+        pi = G1Point.deserialize(bytes(proof))
+    except InvalidPointError as exc:
+        raise KzgError(str(exc)) from exc
+    return _verify_kzg_proof_impl(c, z, y, pi, settings)
+
+
+def _verify_kzg_proof_impl(
+    commitment: G1Point, z: int, y: int, proof: G1Point, settings: KzgSettings
+) -> bool:
+    from .pairing import pairing_product_is_one
+
+    g2 = settings.g2_monomial[0]
+    tau_g2 = settings.g2_monomial[1]
+    p_minus_y = commitment - G1_GENERATOR * y
+    x_minus_z = tau_g2 - g2 * z
+    # e(P − y, −g2) · e(proof, [τ−z]_2) == 1
+    return pairing_product_is_one([(-p_minus_y, g2), (proof, x_minus_z)])
+
+
+def _compute_challenge(blob: bytes, commitment: bytes, settings: KzgSettings) -> int:
+    """Fiat-Shamir challenge binding blob+commitment (spec compute_challenge)."""
+    degree_poly = settings.n.to_bytes(16, "big")
+    return _hash_to_bls_field(
+        FIAT_SHAMIR_PROTOCOL_DOMAIN + degree_poly + blob + bytes(commitment)
+    )
+
+
+def compute_blob_kzg_proof(
+    blob: bytes, commitment: bytes, settings: KzgSettings
+) -> KzgProof:
+    evals = _blob_to_polynomial(blob, settings)
+    z = _compute_challenge(blob, commitment, settings)
+    proof, _ = _compute_kzg_proof_impl(evals, z, settings)
+    return proof
+
+
+def verify_blob_kzg_proof(
+    blob: bytes, commitment: bytes, proof: bytes, settings: KzgSettings
+) -> bool:
+    evals = _blob_to_polynomial(blob, settings)
+    z = _compute_challenge(blob, commitment, settings)
+    y = _evaluate_polynomial_in_evaluation_form(evals, z, settings)
+    try:
+        c = G1Point.deserialize(bytes(commitment))
+        pi = G1Point.deserialize(bytes(proof))
+    except InvalidPointError as exc:
+        raise KzgError(str(exc)) from exc
+    return _verify_kzg_proof_impl(c, z, y, pi, settings)
+
+
+def verify_blob_kzg_proof_batch(
+    blobs: list[bytes],
+    commitments: list[bytes],
+    proofs: list[bytes],
+    settings: KzgSettings,
+) -> bool:
+    """Random-linear-combination batch verification (kzg.rs:139): one
+    two-pairing check regardless of batch size."""
+    if not (len(blobs) == len(commitments) == len(proofs)):
+        raise KzgError("batch length mismatch")
+    if not blobs:
+        return True
+    if len(blobs) == 1:
+        return verify_blob_kzg_proof(blobs[0], commitments[0], proofs[0], settings)
+
+    try:
+        cs = [G1Point.deserialize(bytes(c)) for c in commitments]
+        pis = [G1Point.deserialize(bytes(p)) for p in proofs]
+    except InvalidPointError as exc:
+        raise KzgError(str(exc)) from exc
+
+    zs, ys = [], []
+    for blob, commitment in zip(blobs, commitments):
+        evals = _blob_to_polynomial(blob, settings)
+        z = _compute_challenge(blob, commitment, settings)
+        zs.append(z)
+        ys.append(_evaluate_polynomial_in_evaluation_form(evals, z, settings))
+
+    # r-powers from a transcript binding every (commitment, z, y, proof)
+    data = RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
+    data += settings.n.to_bytes(8, "big")
+    data += len(blobs).to_bytes(8, "big")
+    for c, z, y, p in zip(commitments, zs, ys, proofs):
+        data += bytes(c) + _fr_to_bytes(z) + _fr_to_bytes(y) + bytes(p)
+    r = _hash_to_bls_field(data)
+    r_powers = [1]
+    for _ in range(len(blobs) - 1):
+        r_powers.append(r_powers[-1] * r % R)
+
+    proof_lincomb = _g1_lincomb(pis, r_powers)
+    proof_z_lincomb = _g1_lincomb(
+        pis, [rp * z % R for rp, z in zip(r_powers, zs)]
+    )
+    c_minus_y = [c - G1_GENERATOR * y for c, y in zip(cs, ys)]
+    c_minus_y_lincomb = _g1_lincomb(c_minus_y, r_powers)
+
+    from .pairing import pairing_product_is_one
+
+    g2 = settings.g2_monomial[0]
+    tau_g2 = settings.g2_monomial[1]
+    lhs = c_minus_y_lincomb + proof_z_lincomb
+    return pairing_product_is_one([(-lhs, g2), (proof_lincomb, tau_g2)])

@@ -1,0 +1,358 @@
+"""BLS12-381 stack tests: fields, curves, pairing, hash-to-curve, signatures.
+
+Anchored to external known answers where available offline:
+  - eth2 interop validator-0 secret key → well-known public key
+  - RFC 9380 K.1 expand_message_xmd vectors
+  - generator compressed encodings
+plus algebraic invariants (bilinearity, determinism) and the edge-case
+matrix the reference exercises in crypto/bls.rs:351-580.
+"""
+
+import pytest
+
+from ethereum_consensus_tpu.crypto.fields import Fq, Fq2, Fq6, Fq12, Fr, P, R
+from ethereum_consensus_tpu.crypto.curves import (
+    G1_GENERATOR,
+    G2_GENERATOR,
+    G1Point,
+    G2Point,
+    InvalidPointError,
+)
+from ethereum_consensus_tpu.crypto.pairing import (
+    final_exponentiation,
+    miller_loop,
+    pairing,
+)
+from ethereum_consensus_tpu.crypto.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_g2,
+)
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.error import (
+    InvalidPublicKeyError,
+    InvalidSecretKeyError,
+    InvalidSignatureError,
+)
+
+# ---------------------------------------------------------------------------
+# fields
+# ---------------------------------------------------------------------------
+
+
+def test_fq_basics():
+    a = Fq(5)
+    assert a + Fq(P - 3) == Fq(2)
+    assert a * a.inverse() == Fq.one()
+    assert (-a) + a == Fq.zero()
+    assert Fq(4).sqrt() == Fq(2) or Fq(4).sqrt() == Fq(P - 2)
+
+
+def test_fq2_mul_inverse_sqrt():
+    x = Fq2.from_ints(3, 7)
+    assert x * x.inverse() == Fq2.one()
+    s = x.square().sqrt()
+    assert s == x or s == -x
+    # nonresidue mult: (a+bu)(1+u)
+    y = x.mul_by_nonresidue()
+    assert y == x * Fq2.from_ints(1, 1)
+
+
+def test_fq6_fq12_tower():
+    x = Fq6(Fq2.from_ints(1, 2), Fq2.from_ints(3, 4), Fq2.from_ints(5, 6))
+    assert x * x.inverse() == Fq6.one()
+    z = Fq12(x, Fq6.one())
+    assert z * z.inverse() == Fq12.one()
+    # frobenius is the p-power map: x^p computed both ways
+    w = Fq2.from_ints(11, 13)
+    assert w.frobenius() == w.pow(P)
+
+
+def test_fq12_frobenius_consistency():
+    z = Fq12(
+        Fq6(Fq2.from_ints(1, 2), Fq2.from_ints(3, 4), Fq2.from_ints(5, 6)),
+        Fq6(Fq2.from_ints(7, 8), Fq2.from_ints(9, 10), Fq2.from_ints(11, 12)),
+    )
+    assert z.frobenius_n(12) == z
+    assert z.frobenius_n(6) == z.conjugate()
+
+
+def test_fr():
+    a = Fr(123)
+    assert a * a.inverse() == Fr.one()
+    assert Fr(R) == Fr.zero()
+
+
+# ---------------------------------------------------------------------------
+# curves
+# ---------------------------------------------------------------------------
+
+
+def test_generators_valid():
+    assert G1_GENERATOR.is_on_curve() and G1_GENERATOR.in_subgroup()
+    assert G2_GENERATOR.is_on_curve() and G2_GENERATOR.in_subgroup()
+
+
+def test_generator_encodings():
+    # well-known compressed generator encodings
+    assert G1_GENERATOR.serialize().hex().startswith("97f1d3a73197d794")
+    assert G2_GENERATOR.serialize().hex().startswith("93e02b6052719f60")
+
+
+def test_scalar_mul_and_order():
+    assert (G1_GENERATOR * R).is_infinity()
+    assert (G2_GENERATOR * R).is_infinity()
+    assert G1_GENERATOR * 2 == G1_GENERATOR + G1_GENERATOR
+    assert G1_GENERATOR * 5 - G1_GENERATOR * 3 == G1_GENERATOR * 2
+
+
+def test_point_serialization_roundtrip():
+    for k in [1, 2, 3, 0xDEADBEEF]:
+        p = G1_GENERATOR * k
+        assert G1Point.deserialize(p.serialize()) == p
+        q = G2_GENERATOR * k
+        assert G2Point.deserialize(q.serialize()) == q
+    assert G1Point.deserialize(G1Point.infinity().serialize()).is_infinity()
+    assert G2Point.deserialize(G2Point.infinity().serialize()).is_infinity()
+
+
+def test_deserialize_rejects_garbage():
+    with pytest.raises(InvalidPointError):
+        G1Point.deserialize(b"\x00" * 48)  # compression flag unset
+    with pytest.raises(InvalidPointError):
+        G1Point.deserialize(b"\xc0" + b"\x01" + b"\x00" * 46)  # bad infinity
+    with pytest.raises(InvalidPointError):
+        G1Point.deserialize(b"\xff" * 48)  # x >= p
+    with pytest.raises(InvalidPointError):
+        G1Point.deserialize(b"\x9f" * 48)  # not on curve (overwhelming odds)
+    with pytest.raises(InvalidPointError):
+        G2Point.deserialize(b"\x00" * 96)
+    with pytest.raises(InvalidPointError):
+        G1Point.deserialize(b"\x97" + b"\x00" * 40)  # wrong length
+
+
+def test_interop_public_key_anchor():
+    """eth2 interop validator 0: the canonical sk→pk pair."""
+    sk = 0x25295F0D1D592A90B333E26E85149708208E9F8E8BC18F6C77BD62F8AD7A6866
+    pk = (G1_GENERATOR * sk).serialize()
+    assert pk.hex() == (
+        "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4"
+        "bf2d153f649f7b53359fe8b94a38e44c"
+    )
+
+
+# ---------------------------------------------------------------------------
+# pairing
+# ---------------------------------------------------------------------------
+
+
+def test_pairing_nondegenerate_and_torsion():
+    e = pairing(G1_GENERATOR, G2_GENERATOR)
+    assert not e.is_one()
+    assert e.pow(R).is_one()
+
+
+def test_pairing_bilinearity():
+    e = pairing(G1_GENERATOR, G2_GENERATOR)
+    assert pairing(G1_GENERATOR * 2, G2_GENERATOR) == e.pow(2)
+    assert pairing(G1_GENERATOR, G2_GENERATOR * 3) == e.pow(3)
+    a, b = 111, 222
+    assert pairing(G1_GENERATOR * a, G2_GENERATOR * b) == pairing(
+        G1_GENERATOR * b, G2_GENERATOR * a
+    )
+
+
+def test_pairing_product_identity():
+    f = miller_loop(G2_GENERATOR, -G1_GENERATOR) * miller_loop(
+        G2_GENERATOR, G1_GENERATOR
+    )
+    assert final_exponentiation(f).is_one()
+
+
+# ---------------------------------------------------------------------------
+# hash-to-curve
+# ---------------------------------------------------------------------------
+
+
+def test_expand_message_xmd_rfc_vectors():
+    """RFC 9380 Appendix K.1 (SHA-256, 0x20-byte outputs)."""
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert (
+        expand_message_xmd(b"", dst, 0x20).hex()
+        == "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+    assert (
+        expand_message_xmd(b"abc", dst, 0x20).hex()
+        == "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+    )
+
+
+def test_hash_to_g2_properties():
+    p = hash_to_g2(b"msg")
+    assert p.is_on_curve() and p.in_subgroup()
+    assert p == hash_to_g2(b"msg")
+    assert p != hash_to_g2(b"msg2")
+    assert p != hash_to_g2(b"msg", dst=b"other-dst")
+
+
+def test_isogeny_rederivation():
+    """The stored g2_isogeny constants match a fresh Vélu derivation."""
+    from ethereum_consensus_tpu.crypto import g2_isogeny as stored
+    from ethereum_consensus_tpu.crypto._isogeny_derive import derive, rational_maps
+
+    maps = rational_maps(derive())
+    assert maps["x_num"] == stored.X_NUM
+    assert maps["x_den"] == stored.X_DEN
+    assert maps["y_num"] == stored.Y_NUM
+    assert maps["y_den"] == stored.Y_DEN
+
+
+def test_isogeny_known_rfc_constants():
+    """Derived coefficients reproduce RFC 9380 E.3 anchors: k_(1,0) and
+    k_(3,3). The k_(3,3) check pins the y-map SIGN (scaling c = −1/3): with
+    c = +1/3 every hashed point comes out negated — self-consistent but not
+    interoperable."""
+    from ethereum_consensus_tpu.crypto import g2_isogeny as iso
+
+    k10 = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+    assert iso.X_NUM[0] == Fq2(Fq(k10), Fq(k10))
+    k33 = 0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10
+    assert iso.Y_NUM[3] == Fq2(Fq(k33), Fq(0))
+
+
+def test_sign_regression_vector():
+    """Pinned output of SecretKey.sign after the isogeny sign fix — guards
+    the whole hash-to-curve + sign pipeline against silent changes."""
+    sk = bls.SecretKey(
+        0x25295F0D1D592A90B333E26E85149708208E9F8E8BC18F6C77BD62F8AD7A6866
+    )
+    sig = sk.sign(b"\x00" * 32)
+    # recompute-once pinned value (see commit history); any change here
+    # means hash_to_g2 or scalar-mul semantics shifted
+    import hashlib
+
+    digest = hashlib.sha256(sig.to_bytes()).hexdigest()
+    assert bls.verify_signature(sk.public_key(), b"\x00" * 32, sig)
+    assert digest == SIGN_VECTOR_DIGEST, sig.to_bytes().hex()
+
+
+# computed once from the verified implementation (isogeny anchors green)
+SIGN_VECTOR_DIGEST = "f3738100c8fdd78a01622a214348a464340c63755bf66605f369275ab64a3b79"
+
+
+# ---------------------------------------------------------------------------
+# BLS signature API (mirrors crypto/bls.rs:351-580 edge cases)
+# ---------------------------------------------------------------------------
+
+
+def _keypair(seed: int):
+    sk = bls.SecretKey(seed)
+    return sk, sk.public_key()
+
+
+def test_sign_verify_roundtrip():
+    sk, pk = _keypair(42)
+    msg = b"a message to sign"
+    sig = sk.sign(msg)
+    assert bls.verify_signature(pk, msg, sig)
+    assert not bls.verify_signature(pk, b"another message", sig)
+
+
+def test_verify_rejects_tampered_signature():
+    sk, pk = _keypair(43)
+    sig = sk.sign(b"m")
+    # a different valid signature must not verify
+    other = sk.sign(b"n")
+    assert not bls.verify_signature(pk, b"m", other)
+
+
+def test_verify_wrong_key():
+    sk1, pk1 = _keypair(44)
+    sk2, pk2 = _keypair(45)
+    sig = sk1.sign(b"m")
+    assert not bls.verify_signature(pk2, b"m", sig)
+
+
+def test_secret_key_bounds():
+    with pytest.raises(InvalidSecretKeyError):
+        bls.SecretKey(0)
+    with pytest.raises(InvalidSecretKeyError):
+        bls.SecretKey(R)
+    with pytest.raises(InvalidSecretKeyError):
+        bls.SecretKey.from_bytes(b"\x00" * 31)  # short
+    with pytest.raises(InvalidSecretKeyError):
+        bls.SecretKey.from_bytes(b"\xff" * 32)  # >= r
+    # boundary: r-1 is valid
+    bls.SecretKey(R - 1)
+
+
+def test_secret_key_serde_roundtrip():
+    sk = bls.SecretKey(123456789)
+    assert bls.SecretKey.from_bytes(sk.to_bytes()) == sk
+
+
+def test_public_key_rejects_infinity():
+    inf = G1Point.infinity().serialize()
+    with pytest.raises(InvalidPublicKeyError):
+        bls.PublicKey.from_bytes(inf)
+
+
+def test_signature_accepts_infinity_encoding():
+    sig = bls.Signature.from_bytes(G2Point.infinity().serialize())
+    assert sig.is_infinity()
+
+
+def test_aggregate_and_fast_aggregate_verify():
+    msg = b"shared message"
+    keys = [_keypair(100 + i) for i in range(4)]
+    sigs = [sk.sign(msg) for sk, _ in keys]
+    agg = bls.aggregate(sigs)
+    pks = [pk for _, pk in keys]
+    assert bls.fast_aggregate_verify(pks, msg, agg)
+    assert not bls.fast_aggregate_verify(pks[:3], msg, agg)
+    assert not bls.fast_aggregate_verify(pks, b"other", agg)
+    assert not bls.fast_aggregate_verify([], msg, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    keys = [_keypair(200 + i) for i in range(3)]
+    msgs = [b"m0", b"m1", b"m2"]
+    sigs = [sk.sign(m) for (sk, _), m in zip(keys, msgs)]
+    agg = bls.aggregate(sigs)
+    pks = [pk for _, pk in keys]
+    assert bls.aggregate_verify(pks, msgs, agg)
+    assert not bls.aggregate_verify(pks, [b"m0", b"m1", b"mX"], agg)
+    assert not bls.aggregate_verify(pks[::-1], msgs, agg)
+    assert not bls.aggregate_verify(pks[:2], msgs, agg)
+
+
+def test_aggregate_empty_errors():
+    with pytest.raises(InvalidSignatureError):
+        bls.aggregate([])
+    with pytest.raises(InvalidPublicKeyError):
+        bls.eth_aggregate_public_keys([])
+
+
+def test_eth_aggregate_public_keys():
+    keys = [_keypair(300 + i) for i in range(3)]
+    agg = bls.eth_aggregate_public_keys([pk for _, pk in keys])
+    expected = keys[0][1].point + keys[1][1].point + keys[2][1].point
+    assert agg.point == expected
+
+
+def test_eth_fast_aggregate_verify_infinity_rule():
+    """Empty participant set + infinity signature → valid (altair
+    process_sync_aggregate rule, bls.rs:150-160)."""
+    inf_sig = bls.Signature(G2Point.infinity())
+    assert bls.eth_fast_aggregate_verify([], b"whatever", inf_sig)
+    # but empty keys with a real signature fails
+    sk, pk = _keypair(400)
+    assert not bls.eth_fast_aggregate_verify([], b"m", sk.sign(b"m"))
+    # and non-empty keys defer to fast_aggregate_verify
+    msg = b"sync"
+    assert bls.eth_fast_aggregate_verify([pk], msg, sk.sign(msg))
+
+
+def test_infinity_signature_never_verifies():
+    _, pk = _keypair(500)
+    inf_sig = bls.Signature(G2Point.infinity())
+    assert not bls.verify_signature(pk, b"m", inf_sig)

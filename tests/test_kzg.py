@@ -1,0 +1,132 @@
+"""KZG/EIP-4844 tests over an insecure known-tau dev setup.
+
+The dev setup is mathematically valid (commitments/proofs verify exactly as
+with a real ceremony) but uses a known secret and a small domain (n=64) so
+the pure-Python oracle stays fast. Shapes mirror the reference's kzg runner
+coverage (spec-tests/runners/kzg.rs:18-23).
+"""
+
+import pytest
+
+from ethereum_consensus_tpu.crypto.fields import R
+from ethereum_consensus_tpu.crypto.kzg import (
+    KzgError,
+    KzgSettings,
+    blob_to_kzg_commitment,
+    compute_blob_kzg_proof,
+    compute_kzg_proof,
+    verify_blob_kzg_proof,
+    verify_blob_kzg_proof_batch,
+    verify_kzg_proof,
+    _fr_to_bytes,
+)
+
+N = 64
+
+
+@pytest.fixture(scope="module")
+def settings():
+    return KzgSettings.insecure_dev_setup(tau=0xDEADBEEF1234, n=N)
+
+
+def make_blob(seed: int, settings) -> bytes:
+    vals = [(seed * 7919 + i * 104729) % R for i in range(settings.n)]
+    return b"".join(_fr_to_bytes(v) for v in vals)
+
+
+def test_dev_setup_structure(settings):
+    assert len(settings.g1_lagrange_brp) == N
+    assert len(settings.g2_monomial) == 2
+    # committing to the constant-1 polynomial gives [1]·g1 = g1:
+    # sum of all Lagrange basis points equals g1
+    from ethereum_consensus_tpu.crypto.curves import G1_GENERATOR, G1Point
+
+    acc = G1Point.infinity()
+    for p in settings.g1_lagrange_brp:
+        acc = acc + p
+    assert acc == G1_GENERATOR
+
+
+def test_commitment_deterministic(settings):
+    blob = make_blob(1, settings)
+    c1 = blob_to_kzg_commitment(blob, settings)
+    c2 = blob_to_kzg_commitment(blob, settings)
+    assert c1 == c2
+    assert c1 != blob_to_kzg_commitment(make_blob(2, settings), settings)
+
+
+def test_compute_and_verify_kzg_proof(settings):
+    blob = make_blob(3, settings)
+    commitment = blob_to_kzg_commitment(blob, settings)
+    z = _fr_to_bytes(0x123456)
+    proof, y = compute_kzg_proof(blob, z, settings)
+    assert verify_kzg_proof(commitment, z, y, proof, settings)
+    # wrong y fails
+    bad_y = _fr_to_bytes((int.from_bytes(y, "big") + 1) % R)
+    assert not verify_kzg_proof(commitment, z, bad_y, proof, settings)
+    # wrong z fails
+    assert not verify_kzg_proof(commitment, _fr_to_bytes(0x999), y, proof, settings)
+
+
+def test_kzg_proof_at_domain_point(settings):
+    """z on the evaluation domain exercises the special quotient column."""
+    blob = make_blob(4, settings)
+    commitment = blob_to_kzg_commitment(blob, settings)
+    w = settings.roots_brp[5]
+    z = _fr_to_bytes(w)
+    proof, y = compute_kzg_proof(blob, z, settings)
+    # y must equal the blob's 5th (brp-ordered) evaluation
+    assert int.from_bytes(y, "big") == int.from_bytes(blob[5 * 32 : 6 * 32], "big")
+    assert verify_kzg_proof(commitment, z, y, proof, settings)
+
+
+def test_blob_proof_roundtrip(settings):
+    blob = make_blob(5, settings)
+    commitment = blob_to_kzg_commitment(blob, settings)
+    proof = compute_blob_kzg_proof(blob, commitment, settings)
+    assert verify_blob_kzg_proof(blob, commitment, proof, settings)
+    # tampered blob fails
+    tampered = make_blob(6, settings)
+    assert not verify_blob_kzg_proof(tampered, commitment, proof, settings)
+
+
+def test_blob_proof_batch(settings):
+    blobs = [make_blob(10 + i, settings) for i in range(3)]
+    commitments = [blob_to_kzg_commitment(b, settings) for b in blobs]
+    proofs = [
+        compute_blob_kzg_proof(b, c, settings) for b, c in zip(blobs, commitments)
+    ]
+    assert verify_blob_kzg_proof_batch(blobs, commitments, proofs, settings)
+    # single-element and empty batches
+    assert verify_blob_kzg_proof_batch(blobs[:1], commitments[:1], proofs[:1], settings)
+    assert verify_blob_kzg_proof_batch([], [], [], settings)
+    # swapped proofs fail
+    assert not verify_blob_kzg_proof_batch(
+        blobs, commitments, [proofs[1], proofs[0], proofs[2]], settings
+    )
+    with pytest.raises(KzgError):
+        verify_blob_kzg_proof_batch(blobs, commitments[:2], proofs, settings)
+
+
+def test_invalid_blob_rejected(settings):
+    with pytest.raises(KzgError):
+        blob_to_kzg_commitment(b"\x00" * 31, settings)  # wrong size
+    # non-canonical field element (>= r)
+    bad = _fr_to_bytes(0)[:-32] + (R).to_bytes(32, "big") + b"\x00" * 32 * (N - 1)
+    with pytest.raises(KzgError):
+        blob_to_kzg_commitment(bad, settings)
+
+
+def test_json_setup_roundtrip(settings):
+    """Serialize the dev setup to the c-kzg JSON layout and reload it."""
+    import json
+
+    obj = {
+        "g1_lagrange": ["0x" + p.serialize().hex() for p in settings.g1_lagrange_brp],
+        "g2_monomial": ["0x" + p.serialize().hex() for p in settings.g2_monomial],
+    }
+    loaded = KzgSettings.from_json(json.dumps(obj))
+    blob = make_blob(20, settings)
+    assert blob_to_kzg_commitment(blob, loaded) == blob_to_kzg_commitment(
+        blob, settings
+    )
