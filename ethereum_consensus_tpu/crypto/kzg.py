@@ -24,7 +24,7 @@ import json
 
 from ..error import KzgError
 from .curves import G1Point, G2Point, G1_GENERATOR, G2_GENERATOR, InvalidPointError
-from .fields import Fr, R
+from .fields import R
 
 __all__ = [
     "FIELD_ELEMENTS_PER_BLOB",
@@ -110,7 +110,11 @@ class KzgSettings:
     # -- constructors -------------------------------------------------------
     @classmethod
     def from_json(cls, text: str) -> "KzgSettings":
-        """Load the c-kzg JSON trusted-setup layout."""
+        """Load the c-kzg JSON trusted-setup layout.
+
+        Ceremony files list the Lagrange points in NATURAL domain order;
+        the blob convention is bit-reversal-permuted, so the permutation is
+        applied here (matching c-kzg's load-time behavior)."""
         obj = json.loads(text)
         g1 = obj.get("g1_lagrange") or obj.get("setup_G1_lagrange") or obj.get("setup_G1")
         g2 = obj.get("g2_monomial") or obj.get("setup_G2")
@@ -124,9 +128,21 @@ class KzgSettings:
             return G2Point.deserialize(bytes.fromhex(h.removeprefix("0x")))
 
         try:
-            return cls([parse_g1(h) for h in g1], [parse_g2(h) for h in g2])
+            g1_points = [parse_g1(h) for h in g1]
+            g2_points = [parse_g2(h) for h in g2]
         except InvalidPointError as exc:
             raise KzgError(f"invalid point in trusted setup: {exc}") from exc
+        return cls(_bit_reversal_permutation(g1_points), g2_points)
+
+    def to_json(self) -> str:
+        """Dump in the c-kzg layout (natural domain order — inverse brp)."""
+        natural = _bit_reversal_permutation(self.g1_lagrange_brp)  # involution
+        return json.dumps(
+            {
+                "g1_lagrange": ["0x" + p.serialize().hex() for p in natural],
+                "g2_monomial": ["0x" + p.serialize().hex() for p in self.g2_monomial],
+            }
+        )
 
     @classmethod
     def from_file(cls, path: str) -> "KzgSettings":
@@ -312,6 +328,10 @@ def _compute_challenge(blob: bytes, commitment: bytes, settings: KzgSettings) ->
 def compute_blob_kzg_proof(
     blob: bytes, commitment: bytes, settings: KzgSettings
 ) -> KzgProof:
+    try:
+        G1Point.deserialize(bytes(commitment))  # validate before transcript
+    except InvalidPointError as exc:
+        raise KzgError(f"invalid commitment: {exc}") from exc
     evals = _blob_to_polynomial(blob, settings)
     z = _compute_challenge(blob, commitment, settings)
     proof, _ = _compute_kzg_proof_impl(evals, z, settings)

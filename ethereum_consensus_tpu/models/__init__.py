@@ -1,0 +1,8 @@
+"""Per-fork spec modules (phase0 → electra) and the fork-polymorphic types
+layer — the "model families" of this framework.
+
+Reference parity: ethereum-consensus/src/{phase0,altair,bellatrix,capella,
+deneb,electra}/ and src/types/.
+"""
+
+from . import phase0  # noqa: F401

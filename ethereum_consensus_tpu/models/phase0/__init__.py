@@ -1,0 +1,19 @@
+"""phase0 — the base beacon-chain spec (C19).
+
+Reference parity: ethereum-consensus/src/phase0/ (4,185 LoC, the handwritten
+root fork). Submodules mirror the reference's fork-diff layout:
+containers (beacon_state.rs/beacon_block.rs/operations.rs/validator.rs),
+helpers, block_processing, epoch_processing, slot_processing,
+state_transition, genesis.
+"""
+
+from . import (  # noqa: F401
+    block_processing,
+    containers,
+    epoch_processing,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401

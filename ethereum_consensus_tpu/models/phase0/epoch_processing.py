@@ -1,0 +1,461 @@
+"""phase0 epoch processing.
+
+Reference parity: ethereum-consensus/src/phase0/epoch_processing.rs (1,072
+LoC): process_epoch:1039, justification/finalization :173, rewards &
+penalties :217 (component deltas :762-995), registry updates :253,
+slashings :321, final resets :366-525.
+
+These whole-registry sweeps are the epoch-boundary hot path; ops/sweeps.py
+provides the vectorized device twin, cross-checked against this host
+implementation.
+"""
+
+from __future__ import annotations
+
+from ...error import StateTransitionError, saturating_sub
+from ...primitives import GENESIS_EPOCH
+from . import helpers as h
+from .containers import Checkpoint
+
+__all__ = [
+    "process_epoch",
+    "process_justification_and_finalization",
+    "weigh_justification_and_finalization",
+    "process_rewards_and_penalties",
+    "process_registry_updates",
+    "process_slashings",
+    "process_eth1_data_reset",
+    "process_effective_balance_updates",
+    "process_slashings_reset",
+    "process_randao_mixes_reset",
+    "process_historical_roots_update",
+    "process_participation_record_updates",
+    "get_base_reward",
+    "get_attestation_deltas",
+    "get_matching_source_attestations",
+    "get_matching_target_attestations",
+    "get_matching_head_attestations",
+    "get_unslashed_attesting_indices",
+    "get_attesting_balance",
+    "get_finality_delay",
+    "is_in_inactivity_leak",
+    "get_eligible_validator_indices",
+]
+
+
+# ---------------------------------------------------------------------------
+# matching attestations
+# ---------------------------------------------------------------------------
+
+
+def get_matching_source_attestations(state, epoch: int, context):
+    current = h.get_current_epoch(state, context)
+    previous = h.get_previous_epoch(state, context)
+    if epoch == current:
+        return state.current_epoch_attestations
+    if epoch == previous:
+        return state.previous_epoch_attestations
+    raise StateTransitionError(f"epoch {epoch} is not current or previous")
+
+
+def get_matching_target_attestations(state, epoch: int, context):
+    block_root = h.get_block_root(state, epoch, context)
+    return [
+        a
+        for a in get_matching_source_attestations(state, epoch, context)
+        if a.data.target.root == block_root
+    ]
+
+
+def get_matching_head_attestations(state, epoch: int, context):
+    return [
+        a
+        for a in get_matching_target_attestations(state, epoch, context)
+        if a.data.beacon_block_root == h.get_block_root_at_slot(state, a.data.slot)
+    ]
+
+
+def get_unslashed_attesting_indices(state, attestations, context) -> set[int]:
+    out: set[int] = set()
+    for a in attestations:
+        out |= h.get_attesting_indices(state, a.data, a.aggregation_bits, context)
+    return {i for i in out if not state.validators[i].slashed}
+
+
+def get_attesting_balance(state, attestations, context) -> int:
+    return h.get_total_balance(
+        state, get_unslashed_attesting_indices(state, attestations, context), context
+    )
+
+
+# ---------------------------------------------------------------------------
+# justification & finalization
+# ---------------------------------------------------------------------------
+
+
+def process_justification_and_finalization(state, context) -> None:
+    """(epoch_processing.rs:173)"""
+    if h.get_current_epoch(state, context) <= GENESIS_EPOCH + 1:
+        return
+    previous_epoch = h.get_previous_epoch(state, context)
+    current_epoch = h.get_current_epoch(state, context)
+    previous_attestations = get_matching_target_attestations(
+        state, previous_epoch, context
+    )
+    current_attestations = get_matching_target_attestations(
+        state, current_epoch, context
+    )
+    total_active = h.get_total_active_balance(state, context)
+    previous_target = get_attesting_balance(state, previous_attestations, context)
+    current_target = get_attesting_balance(state, current_attestations, context)
+    weigh_justification_and_finalization(
+        state, total_active, previous_target, current_target, context
+    )
+
+
+def weigh_justification_and_finalization(
+    state,
+    total_active_balance: int,
+    previous_epoch_target_balance: int,
+    current_epoch_target_balance: int,
+    context,
+) -> None:
+    previous_epoch = h.get_previous_epoch(state, context)
+    current_epoch = h.get_current_epoch(state, context)
+    old_previous_justified = state.previous_justified_checkpoint.copy()
+    old_current_justified = state.current_justified_checkpoint.copy()
+
+    # update justification
+    state.previous_justified_checkpoint = state.current_justified_checkpoint.copy()
+    bits = state.justification_bits
+    state.justification_bits = [False] + bits[:-1]
+    if previous_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=previous_epoch,
+            root=h.get_block_root(state, previous_epoch, context),
+        )
+        state.justification_bits[1] = True
+    if current_epoch_target_balance * 3 >= total_active_balance * 2:
+        state.current_justified_checkpoint = Checkpoint(
+            epoch=current_epoch,
+            root=h.get_block_root(state, current_epoch, context),
+        )
+        state.justification_bits[0] = True
+
+    # finalization (the four FFG rules)
+    bits = state.justification_bits
+    if all(bits[1:4]) and old_previous_justified.epoch + 3 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified.copy()
+    if all(bits[1:3]) and old_previous_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_previous_justified.copy()
+    if all(bits[0:3]) and old_current_justified.epoch + 2 == current_epoch:
+        state.finalized_checkpoint = old_current_justified.copy()
+    if all(bits[0:2]) and old_current_justified.epoch + 1 == current_epoch:
+        state.finalized_checkpoint = old_current_justified.copy()
+
+
+# ---------------------------------------------------------------------------
+# rewards & penalties
+# ---------------------------------------------------------------------------
+
+
+def get_base_reward(state, index: int, context) -> int:
+    total_balance = h.get_total_active_balance(state, context)
+    effective = state.validators[index].effective_balance
+    return (
+        effective
+        * context.BASE_REWARD_FACTOR
+        // h.integer_squareroot(total_balance)
+        // BASE_REWARDS_PER_EPOCH
+    )
+
+
+BASE_REWARDS_PER_EPOCH = 4
+PROPOSER_REWARD_QUOTIENT = 8
+
+
+def get_proposer_reward(state, attesting_index: int, context) -> int:
+    return get_base_reward(state, attesting_index, context) // context.PROPOSER_REWARD_QUOTIENT
+
+
+def get_finality_delay(state, context) -> int:
+    return h.get_previous_epoch(state, context) - state.finalized_checkpoint.epoch
+
+
+def is_in_inactivity_leak(state, context) -> bool:
+    return get_finality_delay(state, context) > context.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def get_eligible_validator_indices(state, context) -> list[int]:
+    previous_epoch = h.get_previous_epoch(state, context)
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if h.is_active_validator(v, previous_epoch)
+        or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+    ]
+
+
+def get_attestation_component_deltas(state, attestations, context):
+    """Rewards attesters in ``attestations``, penalizes eligible absentees
+    (epoch_processing.rs component-delta pattern :762+)."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    total_balance = h.get_total_active_balance(state, context)
+    unslashed = get_unslashed_attesting_indices(state, attestations, context)
+    attesting_balance = h.get_total_balance(state, unslashed, context)
+    increment = context.EFFECTIVE_BALANCE_INCREMENT
+    for index in get_eligible_validator_indices(state, context):
+        if index in unslashed:
+            if is_in_inactivity_leak(state, context):
+                rewards[index] += get_base_reward(state, index, context)
+            else:
+                reward_numerator = get_base_reward(state, index, context) * (
+                    attesting_balance // increment
+                )
+                rewards[index] += reward_numerator // (total_balance // increment)
+        else:
+            penalties[index] += get_base_reward(state, index, context)
+    return rewards, penalties
+
+
+def get_source_deltas(state, context):
+    previous_epoch = h.get_previous_epoch(state, context)
+    return get_attestation_component_deltas(
+        state,
+        get_matching_source_attestations(state, previous_epoch, context),
+        context,
+    )
+
+
+def get_target_deltas(state, context):
+    previous_epoch = h.get_previous_epoch(state, context)
+    return get_attestation_component_deltas(
+        state,
+        get_matching_target_attestations(state, previous_epoch, context),
+        context,
+    )
+
+
+def get_head_deltas(state, context):
+    previous_epoch = h.get_previous_epoch(state, context)
+    return get_attestation_component_deltas(
+        state,
+        get_matching_head_attestations(state, previous_epoch, context),
+        context,
+    )
+
+
+def get_inclusion_delay_deltas(state, context):
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n  # no inclusion-delay penalties
+    previous_epoch = h.get_previous_epoch(state, context)
+    source_attestations = get_matching_source_attestations(
+        state, previous_epoch, context
+    )
+    for index in get_unslashed_attesting_indices(state, source_attestations, context):
+        candidates = [
+            a
+            for a in source_attestations
+            if index
+            in h.get_attesting_indices(state, a.data, a.aggregation_bits, context)
+        ]
+        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+        rewards[attestation.proposer_index] += get_proposer_reward(
+            state, index, context
+        )
+        max_attester_reward = get_base_reward(state, index, context) - get_proposer_reward(
+            state, index, context
+        )
+        rewards[index] += max_attester_reward // attestation.inclusion_delay
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas(state, context):
+    n = len(state.validators)
+    rewards = [0] * n  # no inactivity rewards
+    penalties = [0] * n
+    if is_in_inactivity_leak(state, context):
+        previous_epoch = h.get_previous_epoch(state, context)
+        matching_target_attesting_indices = get_unslashed_attesting_indices(
+            state,
+            get_matching_target_attestations(state, previous_epoch, context),
+            context,
+        )
+        for index in get_eligible_validator_indices(state, context):
+            base_rewards = BASE_REWARDS_PER_EPOCH * get_base_reward(
+                state, index, context
+            )
+            penalties[index] += saturating_sub(
+                base_rewards, get_proposer_reward(state, index, context)
+            )
+            if index not in matching_target_attesting_indices:
+                effective = state.validators[index].effective_balance
+                penalties[index] += (
+                    effective
+                    * get_finality_delay(state, context)
+                    // context.INACTIVITY_PENALTY_QUOTIENT
+                )
+    return rewards, penalties
+
+
+def get_attestation_deltas(state, context):
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    for fn in (
+        get_source_deltas,
+        get_target_deltas,
+        get_head_deltas,
+        get_inclusion_delay_deltas,
+        get_inactivity_penalty_deltas,
+    ):
+        r, p = fn(state, context)
+        for i in range(n):
+            rewards[i] += r[i]
+            penalties[i] += p[i]
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(state, context) -> None:
+    """(epoch_processing.rs:217)"""
+    if h.get_current_epoch(state, context) == GENESIS_EPOCH:
+        return
+    rewards, penalties = get_attestation_deltas(state, context)
+    for index in range(len(state.validators)):
+        h.increase_balance(state, index, rewards[index])
+        h.decrease_balance(state, index, penalties[index])
+
+
+# ---------------------------------------------------------------------------
+# registry / slashings / resets
+# ---------------------------------------------------------------------------
+
+
+def process_registry_updates(state, context) -> None:
+    """(epoch_processing.rs:253)"""
+    current_epoch = h.get_current_epoch(state, context)
+    for index, validator in enumerate(state.validators):
+        if h.is_eligible_for_activation_queue(validator, context):
+            validator.activation_eligibility_epoch = current_epoch + 1
+        if (
+            h.is_active_validator(validator, current_epoch)
+            and validator.effective_balance <= context.ejection_balance
+        ):
+            h.initiate_validator_exit(state, index, context)
+
+    activation_queue = sorted(
+        (
+            index
+            for index, v in enumerate(state.validators)
+            if h.is_eligible_for_activation(state, v)
+        ),
+        key=lambda index: (
+            state.validators[index].activation_eligibility_epoch,
+            index,
+        ),
+    )
+    churn_limit = h.get_validator_churn_limit(state, context)
+    activation_epoch = h.compute_activation_exit_epoch(current_epoch, context)
+    for index in activation_queue[:churn_limit]:
+        state.validators[index].activation_epoch = activation_epoch
+
+
+def process_slashings(state, context) -> None:
+    """(epoch_processing.rs:321)"""
+    epoch = h.get_current_epoch(state, context)
+    total_balance = h.get_total_active_balance(state, context)
+    adjusted_total_slashing_balance = min(
+        sum(state.slashings) * context.PROPORTIONAL_SLASHING_MULTIPLIER,
+        total_balance,
+    )
+    increment = context.EFFECTIVE_BALANCE_INCREMENT
+    for index, validator in enumerate(state.validators):
+        if (
+            validator.slashed
+            and epoch + context.EPOCHS_PER_SLASHINGS_VECTOR // 2
+            == validator.withdrawable_epoch
+        ):
+            penalty_numerator = (
+                validator.effective_balance
+                // increment
+                * adjusted_total_slashing_balance
+            )
+            penalty = penalty_numerator // total_balance * increment
+            h.decrease_balance(state, index, penalty)
+
+
+def process_eth1_data_reset(state, context) -> None:
+    next_epoch = h.get_current_epoch(state, context) + 1
+    if next_epoch % context.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        state.eth1_data_votes = []
+
+
+def process_effective_balance_updates(state, context) -> None:
+    hysteresis_increment = (
+        context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
+    )
+    downward_threshold = hysteresis_increment * context.HYSTERESIS_DOWNWARD_MULTIPLIER
+    upward_threshold = hysteresis_increment * context.HYSTERESIS_UPWARD_MULTIPLIER
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        if (
+            balance + downward_threshold < validator.effective_balance
+            or validator.effective_balance + upward_threshold < balance
+        ):
+            validator.effective_balance = min(
+                balance - balance % context.EFFECTIVE_BALANCE_INCREMENT,
+                context.MAX_EFFECTIVE_BALANCE,
+            )
+
+
+def process_slashings_reset(state, context) -> None:
+    next_epoch = h.get_current_epoch(state, context) + 1
+    state.slashings[next_epoch % context.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+
+def process_randao_mixes_reset(state, context) -> None:
+    current_epoch = h.get_current_epoch(state, context)
+    next_epoch = current_epoch + 1
+    state.randao_mixes[next_epoch % context.EPOCHS_PER_HISTORICAL_VECTOR] = (
+        h.get_randao_mix(state, current_epoch)
+    )
+
+
+def process_historical_roots_update(state, context) -> None:
+    next_epoch = h.get_current_epoch(state, context) + 1
+    epochs_per_period = (
+        context.SLOTS_PER_HISTORICAL_ROOT // context.SLOTS_PER_EPOCH
+    )
+    if next_epoch % epochs_per_period == 0:
+        from .containers import build
+
+        ns = build(context.preset)
+        historical_batch = ns.HistoricalBatch(
+            block_roots=list(state.block_roots),
+            state_roots=list(state.state_roots),
+        )
+        state.historical_roots.append(
+            ns.HistoricalBatch.hash_tree_root(historical_batch)
+        )
+
+
+def process_participation_record_updates(state, context) -> None:
+    state.previous_epoch_attestations = state.current_epoch_attestations
+    state.current_epoch_attestations = []
+
+
+def process_epoch(state, context) -> None:
+    """(epoch_processing.rs:1039)"""
+    process_justification_and_finalization(state, context)
+    process_rewards_and_penalties(state, context)
+    process_registry_updates(state, context)
+    process_slashings(state, context)
+    process_eth1_data_reset(state, context)
+    process_effective_balance_updates(state, context)
+    process_slashings_reset(state, context)
+    process_randao_mixes_reset(state, context)
+    process_historical_roots_update(state, context)
+    process_participation_record_updates(state, context)

@@ -1,0 +1,96 @@
+"""phase0 genesis.
+
+Reference parity: ethereum-consensus/src/phase0/genesis.rs —
+initialize_beacon_state_from_eth1:15, is_valid_genesis_state:107,
+get_genesis_block:137.
+"""
+
+from __future__ import annotations
+
+from ...primitives import GENESIS_EPOCH, GENESIS_SLOT
+from . import helpers as h
+from .block_processing import apply_deposit, process_deposit
+from .containers import (
+    BeaconBlockHeader,
+    DepositData,
+    Eth1Data,
+    Fork,
+    build,
+)
+
+__all__ = [
+    "initialize_beacon_state_from_eth1",
+    "is_valid_genesis_state",
+    "get_genesis_block",
+]
+
+
+def initialize_beacon_state_from_eth1(
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits: list,
+    context,
+    execution_payload_header=None,
+):
+    """(genesis.rs:15)"""
+    ns = build(context.preset)
+    fork = Fork(
+        previous_version=context.genesis_fork_version,
+        current_version=context.genesis_fork_version,
+        epoch=GENESIS_EPOCH,
+    )
+    state = ns.BeaconState(
+        genesis_time=eth1_timestamp + context.genesis_delay,
+        fork=fork,
+        eth1_data=Eth1Data(
+            block_hash=eth1_block_hash, deposit_count=len(deposits)
+        ),
+        latest_block_header=BeaconBlockHeader(
+            body_root=ns.BeaconBlockBody.hash_tree_root(ns.BeaconBlockBody())
+        ),
+        randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    # process deposits with an incrementally updated deposit root
+    leaves = [d.data for d in deposits]
+    from ...ssz import List as SSZList
+
+    deposit_data_list_type = SSZList[DepositData, 2**32]
+    for index, deposit in enumerate(deposits):
+        deposit_data_list = leaves[: index + 1]
+        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
+            deposit_data_list
+        )
+        process_deposit(state, deposit, context)
+
+    # activate bootstrap validators
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        validator.effective_balance = min(
+            balance - balance % context.EFFECTIVE_BALANCE_INCREMENT,
+            context.MAX_EFFECTIVE_BALANCE,
+        )
+        if validator.effective_balance == context.MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = GENESIS_EPOCH
+            validator.activation_epoch = GENESIS_EPOCH
+
+    state.genesis_validators_root = type(state).__ssz_fields__[
+        "validators"
+    ].hash_tree_root(state.validators)
+    return state
+
+
+def is_valid_genesis_state(state, context) -> bool:
+    """(genesis.rs:107)"""
+    if state.genesis_time < context.min_genesis_time:
+        return False
+    active = h.get_active_validator_indices(state, GENESIS_EPOCH)
+    return len(active) >= context.min_genesis_active_validator_count
+
+
+def get_genesis_block(state, context):
+    """(genesis.rs:137)"""
+    ns = build(context.preset)
+    return ns.BeaconBlock(
+        state_root=type(state).hash_tree_root(state),
+    )

@@ -1,0 +1,521 @@
+"""phase0 spec helpers: epochs/slots, committees/shuffling, proposers,
+domains, balances, validator predicates, slashing.
+
+Reference parity: ethereum-consensus/src/phase0/helpers.rs (1,172 LoC):
+compute_shuffled_index:249, optimized compute_shuffled_indices:287,
+compute_proposer_index:400, get_beacon_committee:775,
+get_beacon_proposer_index:808, get_domain:190,
+is_valid_indexed_attestation:71, verify_block_signature:144,
+balance ops :979-1035, slash_validator:1088.
+
+All functions are (state, ..., context)-shaped; container classes come from
+the preset-independent module scope or ``type(state)`` so the same code
+serves every preset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ...crypto import bls
+from ...domains import DomainType
+from ...error import (
+    InvalidIndexedAttestation,
+    OutOfBoundsError,
+    StateTransitionError,
+    checked_add,
+    saturating_sub,
+)
+from ...primitives import FAR_FUTURE_EPOCH, GENESIS_EPOCH
+from ...signing import compute_signing_root
+from .containers import Fork, ForkData
+
+__all__ = [
+    "integer_squareroot",
+    "xor",
+    "compute_epoch_at_slot",
+    "compute_start_slot_at_epoch",
+    "compute_activation_exit_epoch",
+    "compute_shuffled_index",
+    "compute_shuffled_indices",
+    "compute_committee",
+    "compute_proposer_index",
+    "compute_fork_data_root",
+    "compute_fork_digest",
+    "compute_domain",
+    "get_current_epoch",
+    "get_previous_epoch",
+    "get_block_root",
+    "get_block_root_at_slot",
+    "get_randao_mix",
+    "get_active_validator_indices",
+    "get_validator_churn_limit",
+    "get_seed",
+    "get_committee_count_per_slot",
+    "get_beacon_committee",
+    "get_beacon_proposer_index",
+    "get_total_balance",
+    "get_total_active_balance",
+    "get_domain",
+    "get_indexed_attestation",
+    "get_attesting_indices",
+    "increase_balance",
+    "decrease_balance",
+    "initiate_validator_exit",
+    "slash_validator",
+    "is_active_validator",
+    "is_eligible_for_activation_queue",
+    "is_eligible_for_activation",
+    "is_slashable_validator",
+    "is_slashable_attestation_data",
+    "is_valid_indexed_attestation",
+    "verify_block_signature",
+    "get_committee_count_at_slot",
+]
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# ---------------------------------------------------------------------------
+# math + time
+# ---------------------------------------------------------------------------
+
+
+def integer_squareroot(n: int) -> int:
+    import math
+
+    if n < 0:
+        raise OutOfBoundsError("integer_squareroot of negative")
+    return math.isqrt(n)
+
+
+def xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def compute_epoch_at_slot(slot: int, context) -> int:
+    return slot // context.SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(epoch: int, context) -> int:
+    return epoch * context.SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(epoch: int, context) -> int:
+    return checked_add(epoch, 1 + context.MAX_SEED_LOOKAHEAD)
+
+
+def get_current_epoch(state, context) -> int:
+    return compute_epoch_at_slot(state.slot, context)
+
+
+def get_previous_epoch(state, context) -> int:
+    current = get_current_epoch(state, context)
+    return GENESIS_EPOCH if current == GENESIS_EPOCH else current - 1
+
+
+# ---------------------------------------------------------------------------
+# roots / mixes
+# ---------------------------------------------------------------------------
+
+
+def get_block_root_at_slot(state, slot: int) -> bytes:
+    limit = len(state.block_roots)
+    if not (slot < state.slot <= slot + limit):
+        raise OutOfBoundsError(f"slot {slot} outside block-root window at {state.slot}")
+    return state.block_roots[slot % limit]
+
+
+def get_block_root(state, epoch: int, context) -> bytes:
+    return get_block_root_at_slot(state, compute_start_slot_at_epoch(epoch, context))
+
+
+def get_randao_mix(state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % len(state.randao_mixes)]
+
+
+# ---------------------------------------------------------------------------
+# shuffling + committees
+# ---------------------------------------------------------------------------
+
+
+def compute_shuffled_index(index: int, count: int, seed: bytes, context) -> int:
+    """Single-index swap-or-not shuffle (helpers.rs:249)."""
+    if index >= count or count == 0:
+        raise OutOfBoundsError("shuffle index out of range")
+    for round_ in range(context.SHUFFLE_ROUND_COUNT):
+        round_byte = round_.to_bytes(1, "little")
+        pivot = int.from_bytes(_sha256(seed + round_byte)[:8], "little") % count
+        flip = (pivot + count - index) % count
+        position = max(index, flip)
+        source = _sha256(seed + round_byte + (position // 256).to_bytes(4, "little"))
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) & 1:
+            index = flip
+    return index
+
+
+def compute_shuffled_indices(indices: list[int], seed: bytes, context) -> list[int]:
+    """Whole-list shuffle — O(rounds·n) with one hash per 256 positions
+    (the reference's `shuffling` optimized feature, helpers.rs:287).
+    Applies the INVERSE permutation order so the result matches mapping
+    each index through compute_shuffled_index."""
+    count = len(indices)
+    if count == 0:
+        return []
+    shuffled = list(indices)
+    # forward list-shuffle applies rounds in reverse to equal per-index map
+    for round_ in reversed(range(context.SHUFFLE_ROUND_COUNT)):
+        round_byte = round_.to_bytes(1, "little")
+        pivot = int.from_bytes(_sha256(seed + round_byte)[:8], "little") % count
+        sources: dict[int, bytes] = {}
+
+        def bit_at(position: int) -> int:
+            chunk = position // 256
+            if chunk not in sources:
+                sources[chunk] = _sha256(
+                    seed + round_byte + chunk.to_bytes(4, "little")
+                )
+            byte = sources[chunk][(position % 256) // 8]
+            return (byte >> (position % 8)) & 1
+
+        for i in range(count):
+            flip = (pivot + count - i) % count
+            if i < flip:
+                if bit_at(flip):
+                    shuffled[i], shuffled[flip] = shuffled[flip], shuffled[i]
+            elif i == flip:
+                continue
+    return shuffled
+
+
+def compute_committee(
+    indices: list[int], seed: bytes, index: int, count: int, context
+) -> list[int]:
+    """Slice ``index``/``count`` of the shuffled active set (spec
+    compute_committee)."""
+    start = len(indices) * index // count
+    end = len(indices) * (index + 1) // count
+    return [
+        indices[compute_shuffled_index(i, len(indices), seed, context)]
+        for i in range(start, end)
+    ]
+
+
+def compute_proposer_index(state, indices: list[int], seed: bytes, context) -> int:
+    """Effective-balance-weighted proposer sampling (helpers.rs:400)."""
+    if not indices:
+        raise StateTransitionError("no active validators for proposer selection")
+    max_random_byte = 255
+    i = 0
+    total = len(indices)
+    while True:
+        candidate = indices[compute_shuffled_index(i % total, total, seed, context)]
+        random_byte = _sha256(seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        effective = state.validators[candidate].effective_balance
+        if effective * max_random_byte >= context.MAX_EFFECTIVE_BALANCE * random_byte:
+            return candidate
+        i += 1
+
+
+def get_active_validator_indices(state, epoch: int) -> list[int]:
+    return [
+        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+    ]
+
+
+def get_validator_churn_limit(state, context) -> int:
+    active = len(get_active_validator_indices(state, get_current_epoch(state, context)))
+    return max(context.min_per_epoch_churn_limit, active // context.churn_limit_quotient)
+
+
+def get_seed(state, epoch: int, domain_type: DomainType, context) -> bytes:
+    mix = get_randao_mix(
+        state,
+        epoch + context.EPOCHS_PER_HISTORICAL_VECTOR - context.MIN_SEED_LOOKAHEAD - 1,
+    )
+    return _sha256(domain_type.as_bytes() + epoch.to_bytes(8, "little") + mix)
+
+
+def get_committee_count_per_slot(state, epoch: int, context) -> int:
+    active = len(get_active_validator_indices(state, epoch))
+    return max(
+        1,
+        min(
+            context.MAX_COMMITTEES_PER_SLOT,
+            active // context.SLOTS_PER_EPOCH // context.TARGET_COMMITTEE_SIZE,
+        ),
+    )
+
+
+# alias matching older spec naming used by some callers
+def get_committee_count_at_slot(state, slot: int, context) -> int:
+    return get_committee_count_per_slot(
+        state, compute_epoch_at_slot(slot, context), context
+    )
+
+
+def get_beacon_committee(state, slot: int, index: int, context) -> list[int]:
+    """(helpers.rs:775)"""
+    epoch = compute_epoch_at_slot(slot, context)
+    committees_per_slot = get_committee_count_per_slot(state, epoch, context)
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(state, epoch, DomainType.BEACON_ATTESTER, context)
+    return compute_committee(
+        indices,
+        seed,
+        (slot % context.SLOTS_PER_EPOCH) * committees_per_slot + index,
+        committees_per_slot * context.SLOTS_PER_EPOCH,
+        context,
+    )
+
+
+def get_beacon_proposer_index(state, context) -> int:
+    """(helpers.rs:808)"""
+    epoch = get_current_epoch(state, context)
+    seed = _sha256(
+        get_seed(state, epoch, DomainType.BEACON_PROPOSER, context)
+        + int(state.slot).to_bytes(8, "little")
+    )
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(state, indices, seed, context)
+
+
+# ---------------------------------------------------------------------------
+# balances
+# ---------------------------------------------------------------------------
+
+
+def get_total_balance(state, indices, context) -> int:
+    total = sum(state.validators[i].effective_balance for i in set(indices))
+    return max(context.EFFECTIVE_BALANCE_INCREMENT, total)
+
+
+def get_total_active_balance(state, context) -> int:
+    return get_total_balance(
+        state,
+        get_active_validator_indices(state, get_current_epoch(state, context)),
+        context,
+    )
+
+
+def increase_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = checked_add(state.balances[index], delta)
+
+
+def decrease_balance(state, index: int, delta: int) -> None:
+    state.balances[index] = saturating_sub(state.balances[index], delta)
+
+
+# ---------------------------------------------------------------------------
+# domains / signing
+# ---------------------------------------------------------------------------
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return ForkData.hash_tree_root(
+        ForkData(
+            current_version=current_version,
+            genesis_validators_root=genesis_validators_root,
+        )
+    )
+
+
+def compute_fork_digest(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: DomainType,
+    fork_version: bytes | None,
+    genesis_validators_root: bytes | None,
+    context,
+) -> bytes:
+    if fork_version is None:
+        fork_version = context.genesis_fork_version
+    if genesis_validators_root is None:
+        genesis_validators_root = b"\x00" * 32
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type.as_bytes() + fork_data_root[:28]
+
+
+def get_domain(state, domain_type: DomainType, epoch: int | None, context) -> bytes:
+    if epoch is None:
+        epoch = get_current_epoch(state, context)
+    fork_version = (
+        state.fork.previous_version
+        if epoch < state.fork.epoch
+        else state.fork.current_version
+    )
+    return compute_domain(
+        domain_type, fork_version, state.genesis_validators_root, context
+    )
+
+
+# ---------------------------------------------------------------------------
+# validator predicates
+# ---------------------------------------------------------------------------
+
+
+def is_active_validator(validator, epoch: int) -> bool:
+    return validator.activation_epoch <= epoch < validator.exit_epoch
+
+
+def is_eligible_for_activation_queue(validator, context) -> bool:
+    return (
+        validator.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        and validator.effective_balance == context.MAX_EFFECTIVE_BALANCE
+    )
+
+
+def is_eligible_for_activation(state, validator) -> bool:
+    return (
+        validator.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+        and validator.activation_epoch == FAR_FUTURE_EPOCH
+    )
+
+
+def is_slashable_validator(validator, epoch: int) -> bool:
+    return (
+        not validator.slashed
+        and validator.activation_epoch <= epoch < validator.withdrawable_epoch
+    )
+
+
+def is_slashable_attestation_data(data_1, data_2) -> bool:
+    # double vote or surround vote
+    double = data_1 != data_2 and data_1.target.epoch == data_2.target.epoch
+    surround = (
+        data_1.source.epoch < data_2.source.epoch
+        and data_2.target.epoch < data_1.target.epoch
+    )
+    return double or surround
+
+
+# ---------------------------------------------------------------------------
+# attestations + signatures
+# ---------------------------------------------------------------------------
+
+
+def get_attesting_indices(state, data, bits: list[bool], context) -> set[int]:
+    committee = get_beacon_committee(state, data.slot, data.index, context)
+    if len(bits) != len(committee):
+        raise InvalidIndexedAttestation(
+            f"aggregation bits length {len(bits)} != committee size {len(committee)}"
+        )
+    return {idx for i, idx in enumerate(committee) if bits[i]}
+
+
+def get_indexed_attestation(state, attestation, context):
+    from .containers import build
+
+    ns = build(context.preset)
+    indices = get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits, context
+    )
+    return ns.IndexedAttestation(
+        attesting_indices=sorted(indices),
+        data=attestation.data.copy(),
+        signature=attestation.signature,
+    )
+
+
+def is_valid_indexed_attestation(state, indexed_attestation, context) -> None:
+    """Raises on failure (helpers.rs:71). The BLS fast_aggregate_verify here
+    is the #1 signature hot path (SURVEY.md §3.1)."""
+    indices = list(indexed_attestation.attesting_indices)
+    if not indices:
+        raise InvalidIndexedAttestation("no attesting indices")
+    if indices != sorted(set(indices)):
+        raise InvalidIndexedAttestation("attesting indices not sorted/unique")
+    if any(i >= len(state.validators) for i in indices):
+        raise InvalidIndexedAttestation("attesting index out of range")
+    public_keys = [
+        bls.PublicKey.from_bytes(state.validators[i].public_key) for i in indices
+    ]
+    domain = get_domain(
+        state,
+        DomainType.BEACON_ATTESTER,
+        indexed_attestation.data.target.epoch,
+        context,
+    )
+    signing_root = compute_signing_root(
+        type(indexed_attestation.data), indexed_attestation.data, domain
+    )
+    signature = bls.Signature.from_bytes(indexed_attestation.signature)
+    if not bls.fast_aggregate_verify(public_keys, signing_root, signature):
+        raise InvalidIndexedAttestation("aggregate signature does not verify")
+
+
+def verify_block_signature(state, signed_block, context) -> None:
+    """(helpers.rs:144)"""
+    from ...error import InvalidBlock
+
+    block = signed_block.message
+    if block.proposer_index >= len(state.validators):
+        raise InvalidBlock("proposer index out of range")
+    proposer = state.validators[block.proposer_index]
+    domain = get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    signing_root = compute_signing_root(type(block), block, domain)
+    pk = bls.PublicKey.from_bytes(proposer.public_key)
+    sig = bls.Signature.from_bytes(signed_block.signature)
+    if not bls.verify_signature(pk, signing_root, sig):
+        raise InvalidBlock("invalid block signature")
+
+
+# ---------------------------------------------------------------------------
+# exits + slashing
+# ---------------------------------------------------------------------------
+
+
+def initiate_validator_exit(state, index: int, context) -> None:
+    validator = state.validators[index]
+    if validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    exit_epochs = [
+        v.exit_epoch for v in state.validators if v.exit_epoch != FAR_FUTURE_EPOCH
+    ]
+    exit_queue_epoch = max(
+        exit_epochs + [compute_activation_exit_epoch(get_current_epoch(state, context), context)]
+    )
+    exit_queue_churn = sum(
+        1 for v in state.validators if v.exit_epoch == exit_queue_epoch
+    )
+    if exit_queue_churn >= get_validator_churn_limit(state, context):
+        exit_queue_epoch = checked_add(exit_queue_epoch, 1)
+    validator.exit_epoch = exit_queue_epoch
+    validator.withdrawable_epoch = checked_add(
+        exit_queue_epoch, context.min_validator_withdrawability_delay
+    )
+
+
+def slash_validator(state, slashed_index: int, whistleblower_index: int | None, context) -> None:
+    """(helpers.rs:1088)"""
+    epoch = get_current_epoch(state, context)
+    initiate_validator_exit(state, slashed_index, context)
+    validator = state.validators[slashed_index]
+    validator.slashed = True
+    validator.withdrawable_epoch = max(
+        validator.withdrawable_epoch, epoch + context.EPOCHS_PER_SLASHINGS_VECTOR
+    )
+    state.slashings[epoch % context.EPOCHS_PER_SLASHINGS_VECTOR] = checked_add(
+        state.slashings[epoch % context.EPOCHS_PER_SLASHINGS_VECTOR],
+        validator.effective_balance,
+    )
+    decrease_balance(
+        state,
+        slashed_index,
+        validator.effective_balance // context.MIN_SLASHING_PENALTY_QUOTIENT,
+    )
+
+    proposer_index = get_beacon_proposer_index(state, context)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = (
+        validator.effective_balance // context.WHISTLEBLOWER_REWARD_QUOTIENT
+    )
+    proposer_reward = whistleblower_reward // context.PROPOSER_REWARD_QUOTIENT
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)

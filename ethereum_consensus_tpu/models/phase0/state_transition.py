@@ -1,0 +1,40 @@
+"""phase0 state transition.
+
+Reference parity: ethereum-consensus/src/phase0/state_transition.rs:15-106
+(state_transition_block_in_slot, state_transition, Validation toggle).
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+from ...error import InvalidStateRoot
+from .block_processing import process_block
+from .helpers import verify_block_signature
+from .slot_processing import process_slots
+
+__all__ = ["Validation", "state_transition", "state_transition_block_in_slot"]
+
+
+class Validation(Enum):
+    ENABLED = "enabled"
+    DISABLED = "disabled"
+
+
+def state_transition_block_in_slot(state, signed_block, validation, context) -> None:
+    """Apply a block to a state already advanced to the block's slot
+    (state_transition.rs:15)."""
+    if validation is Validation.ENABLED:
+        verify_block_signature(state, signed_block, context)
+    block = signed_block.message
+    process_block(state, block, context)
+    if validation is Validation.ENABLED:
+        state_root = type(state).hash_tree_root(state)
+        if block.state_root != state_root:
+            raise InvalidStateRoot(block.state_root, state_root)
+
+
+def state_transition(state, signed_block, context, validation=Validation.ENABLED) -> None:
+    """(state_transition.rs:67)"""
+    process_slots(state, signed_block.message.slot, context)
+    state_transition_block_in_slot(state, signed_block, validation, context)

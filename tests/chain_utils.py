@@ -1,0 +1,192 @@
+"""Shared toy-chain helpers: deterministic validator keys, valid deposits
+with merkle proofs, genesis construction, block production and attestation
+crafting — the scaffolding the sanity/finality-style tests drive.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ethereum_consensus_tpu.config import Context
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.domains import DomainType
+from ethereum_consensus_tpu.models.phase0 import (
+    build,
+    genesis,
+    helpers as h,
+)
+from ethereum_consensus_tpu.models.phase0.containers import (
+    DepositData,
+    DepositMessage,
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+)
+from ethereum_consensus_tpu.signing import compute_signing_root
+from ethereum_consensus_tpu.ssz import uint64
+from ethereum_consensus_tpu.ssz.merkle import Tree
+
+ETH1_BLOCK_HASH = b"\x42" * 32
+ETH1_TIMESTAMP = 1578009600
+
+
+@functools.lru_cache(maxsize=None)
+def secret_key(index: int) -> bls.SecretKey:
+    return bls.SecretKey(index + 1)
+
+
+@functools.lru_cache(maxsize=None)
+def public_key_bytes(index: int) -> bytes:
+    return secret_key(index).public_key().to_bytes()
+
+
+def withdrawal_credentials(index: int) -> bytes:
+    return b"\x00" + bls.hash(public_key_bytes(index))[1:]
+
+
+def make_deposit_data(index: int, context, amount: int | None = None) -> DepositData:
+    if amount is None:
+        amount = context.MAX_EFFECTIVE_BALANCE
+    message = DepositMessage(
+        public_key=public_key_bytes(index),
+        withdrawal_credentials=withdrawal_credentials(index),
+        amount=amount,
+    )
+    domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
+    root = compute_signing_root(DepositMessage, message, domain)
+    signature = secret_key(index).sign(root).to_bytes()
+    return DepositData(
+        public_key=message.public_key,
+        withdrawal_credentials=message.withdrawal_credentials,
+        amount=amount,
+        signature=signature,
+    )
+
+
+def make_deposits(count: int, context):
+    """Deposits with valid incremental-tree merkle proofs (deposit i proven
+    against the tree holding deposits 0..i, mixed with count i+1)."""
+    ns = build(context.preset)
+    datas = [make_deposit_data(i, context) for i in range(count)]
+    leaves = [DepositData.hash_tree_root(d) for d in datas]
+    deposits = []
+    for i in range(count):
+        tree = Tree(leaves[: i + 1], limit=2**DEPOSIT_CONTRACT_TREE_DEPTH)
+        branch = tree.proof(i) + [(i + 1).to_bytes(32, "little")]
+        deposits.append(ns.Deposit(proof=branch, data=datas[i]))
+    return deposits
+
+
+def make_genesis_state(validator_count: int, context):
+    deposits = make_deposits(validator_count, context)
+    state = genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context
+    )
+    return state
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis(validator_count: int, preset_name: str):
+    """Genesis construction is slow (BLS deposit signatures); cache per
+    (count, preset) and hand out deep copies."""
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    return make_genesis_state(validator_count, context), context
+
+
+def fresh_genesis(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_randao_reveal(state, slot: int, context) -> bytes:
+    """Caller must have advanced ``state`` to ``slot`` for proposer lookup."""
+    epoch = slot // context.SLOTS_PER_EPOCH
+    proposer_sk = secret_key(h.get_beacon_proposer_index(state, context))
+    domain = h.get_domain(state, DomainType.RANDAO, epoch, context)
+    root = compute_signing_root(uint64, epoch, domain)
+    return proposer_sk.sign(root).to_bytes()
+
+
+def produce_block(state, slot: int, context, attestations=()):
+    """Advance ``state`` to ``slot`` and build a valid signed block on top.
+    Mutates ``state`` only by slot-advancing (the block is NOT applied)."""
+    from ethereum_consensus_tpu.models.phase0.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.block_processing import process_block
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    # compute post-state root on a scratch copy
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)
+
+
+def sign_block(state, block, context) -> bytes:
+    """(Re-)sign ``block`` with its proposer's key against ``state``'s fork."""
+    ns = build(context.preset)
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    return secret_key(block.proposer_index).sign(root).to_bytes()
+
+
+def make_attestation(state, slot: int, index: int, context, participation=1.0):
+    """A valid attestation for (slot, committee index) on ``state`` (which
+    must be at a slot where [slot]'s data is known, i.e. state.slot >= slot)."""
+    ns = build(context.preset)
+    committee = h.get_beacon_committee(state, slot, index, context)
+    epoch = slot // context.SLOTS_PER_EPOCH
+    if epoch == h.get_current_epoch(state, context):
+        source = state.current_justified_checkpoint.copy()
+    else:
+        source = state.previous_justified_checkpoint.copy()
+    start_slot = h.compute_start_slot_at_epoch(epoch, context)
+    data = ns.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=_block_root_at_or_latest(state, slot),
+        source=source,
+        target=ns.Checkpoint(
+            epoch=epoch, root=_block_root_at_or_latest(state, start_slot)
+        ),
+    )
+    n_participants = max(1, int(len(committee) * participation))
+    bits = [i < n_participants for i in range(len(committee))]
+    domain = h.get_domain(state, DomainType.BEACON_ATTESTER, epoch, context)
+    root = compute_signing_root(ns.AttestationData, data, domain)
+    sigs = [
+        secret_key(committee[i]).sign(root) for i in range(len(committee)) if bits[i]
+    ]
+    signature = bls.aggregate(sigs).to_bytes()
+    return ns.Attestation(
+        aggregation_bits=bits, data=data, signature=signature
+    )
+
+
+def _block_root_at_or_latest(state, slot: int) -> bytes:
+    """Block root for ``slot``: from history if in the past, else the root
+    the latest header will take once its state root is filled."""
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    if slot < state.slot:
+        return h.get_block_root_at_slot(state, slot)
+    header = state.latest_block_header.copy()
+    if header.state_root == b"\x00" * 32:
+        header.state_root = type(state).hash_tree_root(state)
+    return BeaconBlockHeader.hash_tree_root(header)

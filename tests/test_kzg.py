@@ -118,15 +118,20 @@ def test_invalid_blob_rejected(settings):
 
 
 def test_json_setup_roundtrip(settings):
-    """Serialize the dev setup to the c-kzg JSON layout and reload it."""
-    import json
-
-    obj = {
-        "g1_lagrange": ["0x" + p.serialize().hex() for p in settings.g1_lagrange_brp],
-        "g2_monomial": ["0x" + p.serialize().hex() for p in settings.g2_monomial],
-    }
-    loaded = KzgSettings.from_json(json.dumps(obj))
+    """Dump/reload through the c-kzg JSON layout (natural order on disk,
+    brp applied at load). A naive dump of the brp-ordered points would NOT
+    roundtrip — that asymmetry is the point of this test."""
+    loaded = KzgSettings.from_json(settings.to_json())
+    assert loaded.g1_lagrange_brp == settings.g1_lagrange_brp
     blob = make_blob(20, settings)
     assert blob_to_kzg_commitment(blob, loaded) == blob_to_kzg_commitment(
         blob, settings
     )
+
+
+def test_blob_proof_rejects_garbage_commitment(settings):
+    blob = make_blob(21, settings)
+    with pytest.raises(KzgError):
+        compute_blob_kzg_proof(blob, b"\x01" * 48, settings)
+    with pytest.raises(KzgError):
+        compute_blob_kzg_proof(blob, b"\x01" * 47, settings)

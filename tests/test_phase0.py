@@ -1,0 +1,244 @@
+"""phase0 spec tests driven through the toy chain.
+
+Coverage mirrors the reference's conformance surface at small scale
+(sanity/blocks, sanity/slots, operations, shuffling, finality —
+spec-tests/runners/{sanity,operations,shuffling}.rs) using self-generated
+states instead of the official vectors.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    fresh_genesis,
+    make_attestation,
+    produce_block,
+    secret_key,
+)
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.domains import DomainType  # noqa: E402
+from ethereum_consensus_tpu.error import (  # noqa: E402
+    InvalidAttestation,
+    InvalidBeaconBlockHeader,
+    InvalidStateRoot,
+    StateTransitionError,
+)
+from ethereum_consensus_tpu.models.phase0 import (  # noqa: E402
+    build,
+    helpers as h,
+)
+from ethereum_consensus_tpu.models.phase0.block_processing import (  # noqa: E402
+    process_attestation,
+)
+from ethereum_consensus_tpu.models.phase0.genesis import (  # noqa: E402
+    is_valid_genesis_state,
+)
+from ethereum_consensus_tpu.models.phase0.slot_processing import (  # noqa: E402
+    process_slots,
+)
+from ethereum_consensus_tpu.models.phase0.state_transition import (  # noqa: E402
+    Validation,
+    state_transition,
+)
+
+
+@pytest.fixture(scope="module")
+def genesis16():
+    return fresh_genesis(16, "minimal")
+
+
+# ---------------------------------------------------------------------------
+# shuffling (runners/shuffling.rs parity: both impls must agree)
+# ---------------------------------------------------------------------------
+
+
+def test_shuffling_impls_agree():
+    ctx = Context.for_minimal()
+    seed = bytes(range(32))
+    n = 100
+    listed = h.compute_shuffled_indices(list(range(n)), seed, ctx)
+    mapped = [
+        listed[i] == h.compute_shuffled_index(i, n, seed, ctx) for i in range(n)
+    ]
+    # shuffled[i] = indices[compute_shuffled_index(i)]
+    expected = [h.compute_shuffled_index(i, n, seed, ctx) for i in range(n)]
+    assert listed == expected
+    assert sorted(listed) == list(range(n))
+
+
+def test_shuffle_is_permutation_and_seed_sensitive():
+    ctx = Context.for_minimal()
+    n = 50
+    a = h.compute_shuffled_indices(list(range(n)), b"\x01" * 32, ctx)
+    b = h.compute_shuffled_indices(list(range(n)), b"\x02" * 32, ctx)
+    assert sorted(a) == list(range(n))
+    assert a != b
+
+
+# ---------------------------------------------------------------------------
+# genesis
+# ---------------------------------------------------------------------------
+
+
+def test_genesis_state_valid(genesis16):
+    state, ctx = genesis16
+    assert len(state.validators) == 16
+    assert all(v.effective_balance == ctx.MAX_EFFECTIVE_BALANCE for v in state.validators)
+    assert state.genesis_validators_root != b"\x00" * 32
+    # 16 < min_genesis_active_validator_count (64) for minimal
+    assert not is_valid_genesis_state(state, ctx)
+
+
+# ---------------------------------------------------------------------------
+# slots
+# ---------------------------------------------------------------------------
+
+
+def test_process_slots_advances_and_records_roots(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    root_before = type(state).hash_tree_root(state)
+    process_slots(state, 3, ctx)
+    assert state.slot == 3
+    assert state.state_roots[0] == root_before
+    assert state.latest_block_header.state_root == root_before
+    with pytest.raises(StateTransitionError):
+        process_slots(state, 2, ctx)  # backwards
+
+
+# ---------------------------------------------------------------------------
+# blocks (sanity/blocks shape)
+# ---------------------------------------------------------------------------
+
+
+def test_apply_block_and_state_root_check(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    block = produce_block(state.copy(), 1, ctx)
+    state_transition(state, block, ctx)
+    assert state.slot == 1
+    assert state.latest_block_header.slot == 1
+
+
+def test_wrong_state_root_rejected(genesis16):
+    from chain_utils import sign_block
+
+    state, ctx = genesis16
+    state = state.copy()
+    block = produce_block(state.copy(), 1, ctx)
+    block.message.state_root = b"\xde" * 32
+    process_slots(state, 1, ctx)
+    block.signature = sign_block(state, block.message, ctx)  # proposer signs the lie
+    from ethereum_consensus_tpu.models.phase0.state_transition import (
+        state_transition_block_in_slot,
+    )
+
+    with pytest.raises(InvalidStateRoot):
+        state_transition_block_in_slot(state, block, Validation.ENABLED, ctx)
+
+
+def test_bad_proposer_rejected(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    block = produce_block(state.copy(), 1, ctx)
+    actual = block.message.proposer_index
+    block.message.proposer_index = (actual + 1) % len(state.validators)
+    with pytest.raises((InvalidBeaconBlockHeader, StateTransitionError)):
+        state_transition(state, block, ctx, Validation.DISABLED)
+
+
+def test_invalid_signature_rejected(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    block = produce_block(state.copy(), 1, ctx)
+    # sign with the wrong key
+    wrong = secret_key(7).sign(b"\x00" * 32).to_bytes()
+    block.signature = wrong
+    from ethereum_consensus_tpu.error import InvalidBlock
+
+    with pytest.raises(InvalidBlock):
+        state_transition(state, block, ctx)
+
+
+# ---------------------------------------------------------------------------
+# attestations
+# ---------------------------------------------------------------------------
+
+
+def test_attestation_flow(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    # advance two slots, attest slot 1, include at slot 2
+    block1 = produce_block(state, 1, ctx)  # advances state to slot 1 in place
+    state_transition_noadvance(state, block1, ctx)
+    att = make_attestation(state, 1, 0, ctx)
+    process_slots(state, 2, ctx)
+    process_attestation(state, att, ctx)
+    assert len(state.current_epoch_attestations) == 1
+    pending = state.current_epoch_attestations[0]
+    assert pending.inclusion_delay == 1
+    assert pending.data.slot == 1
+
+
+def state_transition_noadvance(state, signed_block, ctx):
+    """Apply a block when the state is already at the block slot."""
+    from ethereum_consensus_tpu.models.phase0.state_transition import (
+        state_transition_block_in_slot,
+    )
+
+    state_transition_block_in_slot(state, signed_block, Validation.ENABLED, ctx)
+
+
+def test_attestation_wrong_source_rejected(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    block1 = produce_block(state, 1, ctx)
+    state_transition_noadvance(state, block1, ctx)
+    att = make_attestation(state, 1, 0, ctx)
+    att.data.source.epoch = 3  # breaks both source match and signature
+    process_slots(state, 2, ctx)
+    with pytest.raises(InvalidAttestation):
+        process_attestation(state, att, ctx)
+
+
+def test_attestation_too_early_rejected(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    block1 = produce_block(state, 1, ctx)
+    state_transition_noadvance(state, block1, ctx)
+    att = make_attestation(state, 1, 0, ctx)
+    # state still at slot 1: inclusion delay 0 < MIN_ATTESTATION_INCLUSION_DELAY
+    with pytest.raises(InvalidAttestation):
+        process_attestation(state, att, ctx)
+
+
+# ---------------------------------------------------------------------------
+# committees
+# ---------------------------------------------------------------------------
+
+
+def test_committees_partition_validators(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    epoch = 0
+    seen = set()
+    for slot in range(ctx.SLOTS_PER_EPOCH):
+        count = h.get_committee_count_per_slot(state, epoch, ctx)
+        for index in range(count):
+            committee = h.get_beacon_committee(state, slot, index, ctx)
+            for v in committee:
+                assert v not in seen, "validator in two committees"
+                seen.add(v)
+    assert seen == set(range(16))
+
+
+def test_proposer_is_active(genesis16):
+    state, ctx = genesis16
+    state = state.copy()
+    proposer = h.get_beacon_proposer_index(state, ctx)
+    assert 0 <= proposer < 16
