@@ -1,0 +1,108 @@
+"""Headline benchmark: SSZ hash_tree_root merkleization throughput.
+
+Measures the device merkle reduction (ops/merkle.py — Pallas SHA-256 on TPU,
+XLA elsewhere) over a 2^20-leaf tree against the single-core host hashlib
+merkleizer (the stand-in for the reference's single-core `ssz_rs`/`sha2`
+path; the reference publishes no numbers — see BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "hash_tree_root_leaves_per_sec", "value": ..., "unit":
+   "leaves/sec", "vs_baseline": device/host speedup}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+LOG2_LEAVES = 20
+N = 1 << LOG2_LEAVES  # 1,048,576 32-byte leaves = 32 MiB
+DEVICE_REPS = 20
+
+
+def bench_device(words, zero_words, depth):
+    """(seconds per full-tree reduction on device (min over reps), root)."""
+    import jax
+
+    from ethereum_consensus_tpu.ops.merkle import merkle_root_words
+
+    root = np.asarray(merkle_root_words(words, zero_words, depth))
+    times = []
+    for _ in range(DEVICE_REPS):
+        t0 = time.perf_counter()
+        # fetch the 32-byte root to host: forces full execution even where
+        # block_until_ready returns early (axon tunnel); transfer is 32B.
+        np.asarray(merkle_root_words(words, zero_words, depth))
+        times.append(time.perf_counter() - t0)
+    return min(times), root
+
+
+def bench_host(chunks: bytes) -> tuple[float, bytes]:
+    """Seconds for the single-core hashlib merkleizer (one run — it's slow).
+
+    ops.sha256.install_device_hasher is never called here, so hash_level
+    stays on the pure-hashlib path — a fair single-core CPU baseline."""
+    from ethereum_consensus_tpu.ssz.merkle import merkleize_chunks
+
+    t0 = time.perf_counter()
+    root = merkleize_chunks(chunks)
+    return time.perf_counter() - t0, root
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ethereum_consensus_tpu.ops.merkle import zero_hash_words
+
+    rng = np.random.default_rng(42)
+    chunks = rng.integers(0, 256, size=N * 32, dtype=np.uint8).tobytes()
+    words = jnp.asarray(
+        np.ascontiguousarray(
+            np.frombuffer(chunks, dtype=">u4").astype(np.uint32).reshape(N, 8).T
+        )
+    )
+    zero_words = jnp.asarray(zero_hash_words())
+
+    device_s, device_root = bench_device(words, zero_words, LOG2_LEAVES)
+    host_s, host_root = bench_host(chunks)
+
+    got = device_root.astype(">u4").tobytes()
+    if got != host_root:
+        print(
+            json.dumps(
+                {
+                    "metric": "hash_tree_root_leaves_per_sec",
+                    "value": 0,
+                    "unit": "leaves/sec",
+                    "vs_baseline": 0,
+                    "error": "device root mismatch vs host merkleizer",
+                }
+            )
+        )
+        sys.exit(1)
+
+    print(
+        json.dumps(
+            {
+                "metric": "hash_tree_root_leaves_per_sec",
+                "value": round(N / device_s, 1),
+                "unit": "leaves/sec",
+                "vs_baseline": round(host_s / device_s, 2),
+                "detail": {
+                    "leaves": N,
+                    "device_s": round(device_s, 4),
+                    "host_single_core_s": round(host_s, 4),
+                    "backend": jax.default_backend(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,12 @@ import numpy as np
 from ..ssz.merkle import BYTES_PER_CHUNK, next_pow_of_two, zero_hash
 from .sha256 import sha256_64b
 
-__all__ = ["merkle_root_words", "merkleize_chunks_device", "zero_hash_words"]
+__all__ = [
+    "merkle_root_words",
+    "merkleize_chunks_device",
+    "reduce_levels",
+    "zero_hash_words",
+]
 
 _MAX_DEPTH = 64
 
@@ -35,16 +40,19 @@ def zero_hash_words() -> np.ndarray:
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("depth",))
-def merkle_root_words(nodes: jax.Array, zero_words: jax.Array, depth: int) -> jax.Array:
-    """Reduce ``nodes`` (8, N) uint32 to the root of a depth-``depth`` tree.
+def reduce_levels(
+    nodes: jax.Array, zero_words: jax.Array, depth: int, start_level: int = 0
+) -> jax.Array:
+    """Reduce ``nodes`` (8, N) uint32 to the root of a tree whose leaves sit
+    ``start_level`` levels above the chunk layer, up to total ``depth``.
 
     Odd levels are padded with the precomputed ``zero_words`` (64, 8) sibling
     for that level (the host merkleizer's strategy), so sparse trees never
     hash into fully-zero subtrees. Levels above the populated region chain
-    zero-subtree siblings. Returns (8,) root words."""
+    zero-subtree siblings. Returns (8,) root words. Traceable (not jitted
+    here) so sharded callers can embed it inside shard_map bodies."""
     n = nodes.shape[1]
-    level = 0
+    level = start_level
     while n > 1:
         if n % 2 == 1:
             nodes = jnp.concatenate([nodes, zero_words[level][:, None]], axis=1)
@@ -58,6 +66,12 @@ def merkle_root_words(nodes: jax.Array, zero_words: jax.Array, depth: int) -> ja
         msgs = jnp.concatenate([nodes, zero_words[d][:, None]], axis=0)
         nodes = sha256_64b(msgs)
     return nodes[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def merkle_root_words(nodes: jax.Array, zero_words: jax.Array, depth: int) -> jax.Array:
+    """Reduce ``nodes`` (8, N) uint32 to the root of a depth-``depth`` tree."""
+    return reduce_levels(nodes, zero_words, depth)
 
 
 def merkleize_chunks_device(chunks: bytes, limit: int | None = None) -> bytes:

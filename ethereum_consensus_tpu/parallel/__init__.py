@@ -1,0 +1,21 @@
+"""Multi-chip parallelism: device meshes, sharded merkleization, and the
+distributed chain step.
+
+The reference is a single-process library (SURVEY.md §2.5); scale-out here is
+green-field TPU design: batch axes of the crypto kernels (merkle leaf ranges,
+signature batches, validator-registry sweeps) are sharded over a
+``jax.sharding.Mesh`` with XLA collectives (``all_gather``/``psum``) riding
+ICI, per the shard_map recipe.
+"""
+
+from .mesh import chip_mesh, default_device_mesh
+from .merkle import sharded_merkle_root_words, sharded_merkleize_chunks
+from .step import make_chain_step
+
+__all__ = [
+    "chip_mesh",
+    "default_device_mesh",
+    "sharded_merkle_root_words",
+    "sharded_merkleize_chunks",
+    "make_chain_step",
+]

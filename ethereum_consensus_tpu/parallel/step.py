@@ -1,0 +1,133 @@
+"""The distributed chain step: one epoch-boundary device sweep, sharded.
+
+This is the multi-chip "training step" of the framework: the validator
+registry (the only axis at mainnet scale — VALIDATOR_REGISTRY_LIMIT = 2^40,
+phase0/presets/mainnet.rs:26) is sharded row-wise over the mesh, and one
+jitted step performs, entirely on device:
+
+  1. the effective-balance hysteresis sweep
+     (reference: phase0/epoch_processing.rs process_effective_balance_updates)
+  2. the total-active-balance reduction (``psum`` across chips)
+  3. the SSZ ``hash_tree_root`` of the balances list — per-device subtree
+     reduction, one ``all_gather`` of subtree roots over ICI, replicated top
+     tree + length mix-in — bit-identical to the host merkleizer.
+
+Exact u64 spec semantics require ``jax_enable_x64`` (SURVEY.md §7 hard
+parts); callers enable it before building the step (see __graft_entry__ and
+tests). Sweep math is exact integer arithmetic — no floats anywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..ops.merkle import reduce_levels
+from ..ops.sha256 import sha256_64b
+from ..ssz.merkle import next_pow_of_two
+from .mesh import SHARD_AXIS
+
+__all__ = ["make_chain_step", "u64_to_be_words"]
+
+
+def _bswap32(x):
+    x = x.astype(jnp.uint32)
+    return (
+        (x >> np.uint32(24))
+        | ((x >> np.uint32(8)) & np.uint32(0xFF00))
+        | ((x << np.uint32(8)) & np.uint32(0xFF0000))
+        | (x << np.uint32(24))
+    )
+
+
+def u64_to_be_words(values):
+    """(N,) uint64 → (2N,) uint32: the big-endian-word view of the
+    little-endian u64 byte serialization (SSZ basic-value packing)."""
+    lo = (values & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (values >> jnp.uint64(32)).astype(jnp.uint32)
+    return jnp.stack([_bswap32(lo), _bswap32(hi)], axis=1).reshape(-1)
+
+
+def _length_words(length: int) -> np.ndarray:
+    """(8,) uint32 word view of the SSZ length mix-in chunk."""
+    chunk = length.to_bytes(8, "little") + b"\x00" * 24
+    return np.frombuffer(chunk, dtype=">u4").astype(np.uint32)
+
+
+def make_chain_step(
+    mesh: Mesh,
+    axis_name: str = SHARD_AXIS,
+    registry_limit: int = 2**40,
+    effective_balance_increment: int = 10**9,
+    max_effective_balance: int = 32 * 10**9,
+    hysteresis_quotient: int = 4,
+    hysteresis_downward_multiplier: int = 1,
+    hysteresis_upward_multiplier: int = 5,
+):
+    """Build the jitted distributed chain step over ``mesh``.
+
+    Returns ``step(balances, effective_balances, active_mask, zero_words)``
+    where the first three are (N,) arrays sharded over ``axis_name`` (N
+    divisible by mesh size; N/devices divisible by 4 — one SSZ chunk packs
+    four u64 balances) and ``zero_words`` is ops.merkle.zero_hash_words().
+    Returns ``(new_effective_balances, total_active_balance, balances_root)``
+    with the root as (8,) uint32 words, replicated.
+    """
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "make_chain_step needs exact u64 semantics: enable jax_enable_x64"
+        )
+    n_dev = mesh.shape[axis_name]
+    chunk_limit = (registry_limit + 3) // 4
+    depth = (next_pow_of_two(chunk_limit) - 1).bit_length()
+
+    increment = np.uint64(effective_balance_increment)
+    hysteresis_increment = np.uint64(effective_balance_increment // hysteresis_quotient)
+    downward = hysteresis_increment * np.uint64(hysteresis_downward_multiplier)
+    upward = hysteresis_increment * np.uint64(hysteresis_upward_multiplier)
+    max_eff = np.uint64(max_effective_balance)
+
+    def body(balances, eff, active, zero_words):
+        local_n = balances.shape[0]
+        if local_n % 4:
+            raise ValueError("per-device balance count must be a multiple of 4")
+
+        # 1. hysteresis sweep (epoch_processing.rs process_effective_balance_updates)
+        candidate = jnp.minimum(balances - balances % increment, max_eff)
+        new_eff = jnp.where(
+            (balances + downward < eff) | (eff + upward < balances), candidate, eff
+        )
+
+        # 2. total active balance across the whole mesh
+        total = jax.lax.psum(
+            jnp.sum(jnp.where(active, new_eff, jnp.uint64(0))), axis_name
+        )
+
+        # 3. hash_tree_root(balances): local subtree → all_gather → top tree
+        words = u64_to_be_words(balances).reshape(local_n // 4, 8).T
+        local_depth = (local_n // 4 - 1).bit_length()
+        sub = reduce_levels(words, zero_words, local_depth)
+        roots = jax.lax.all_gather(sub, axis_name)  # (n_dev, 8)
+        merkle = reduce_levels(roots.T, zero_words, depth, start_level=local_depth)
+        # SSZ List → mix_in_length(root, N)
+        length = jnp.asarray(_length_words(local_n * n_dev))
+        msg = jnp.concatenate([merkle, length]).reshape(16, 1)
+        root = sha256_64b(msg)[:, 0]
+        return new_eff, total, root
+
+    # check_vma=False: the SHA-256 fori_loop carries a mix of unvarying
+    # (padding-block literals) and device-varying lanes, which the vma type
+    # system rejects; replication of the psum/top-tree outputs is guaranteed
+    # by construction here.
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), P(axis_name), P(None, None)),
+            out_specs=(P(axis_name), P(), P(None)),
+            check_vma=False,
+        )
+    )
