@@ -1,0 +1,68 @@
+"""Built-in networks and Network → Context resolution.
+
+Reference parity: ethereum-consensus/src/networks.rs:12-73 — `Network` enum
+(mainnet/sepolia/goerli/holesky + Custom config dir), `TryFrom<Network> for
+Context` (networks.rs:51-66), `typical_genesis_time` (networks.rs:70).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["Network", "network_to_context", "typical_genesis_time"]
+
+
+@dataclass(frozen=True)
+class Network:
+    """A known network name, or a custom config directory (networks.rs:12)."""
+
+    name: str
+
+    MAINNET = None  # type: Network
+    SEPOLIA = None  # type: Network
+    GOERLI = None  # type: Network
+    HOLESKY = None  # type: Network
+
+    KNOWN = ("mainnet", "sepolia", "goerli", "holesky")
+
+    @property
+    def is_custom(self) -> bool:
+        return self.name not in self.KNOWN
+
+    def __str__(self) -> str:
+        if self.is_custom:
+            return f"custom ({os.path.join(self.name, 'config.yaml')})"
+        return self.name
+
+    def to_context(self):
+        return network_to_context(self)
+
+
+Network.MAINNET = Network("mainnet")
+Network.SEPOLIA = Network("sepolia")
+Network.GOERLI = Network("goerli")
+Network.HOLESKY = Network("holesky")
+
+
+def network_to_context(network: Network | str):
+    """(networks.rs:51-66) — a custom network's name is a directory holding
+    config.yaml."""
+    from .context import Context
+
+    name = network.name if isinstance(network, Network) else network
+    if name == "mainnet":
+        return Context.for_mainnet()
+    if name == "sepolia":
+        return Context.for_sepolia()
+    if name == "goerli":
+        return Context.for_goerli()
+    if name == "holesky":
+        return Context.for_holesky()
+    return Context.try_from_file(os.path.join(name, "config.yaml"))
+
+
+def typical_genesis_time(context) -> int:
+    """Testnet-typical genesis = min_genesis_time + genesis_delay
+    (networks.rs:70-73)."""
+    return context.min_genesis_time + context.genesis_delay

@@ -23,7 +23,7 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from ..ops.merkle import reduce_levels, zero_hash_words
-from ..ssz.merkle import BYTES_PER_CHUNK, next_pow_of_two, zero_hash
+from ..ssz.merkle import BYTES_PER_CHUNK, merkleize_chunks, next_pow_of_two, zero_hash
 from .mesh import SHARD_AXIS
 
 __all__ = ["sharded_merkle_root_words", "sharded_merkleize_chunks"]
@@ -49,7 +49,7 @@ def sharded_merkle_root_words(
     if n % n_dev != 0:
         raise ValueError(f"leaf count {n} not divisible by mesh size {n_dev}")
     local_n = n // n_dev
-    if local_n & (local_n - 1):
+    if local_n == 0 or local_n & (local_n - 1):
         raise ValueError(f"local leaf count {local_n} must be a power of two")
     local_depth = (local_n - 1).bit_length()
 
@@ -92,9 +92,14 @@ def sharded_merkleize_chunks(
         return zero_hash(depth)
 
     n_dev = mesh.shape[axis_name]
-    padded = max(next_pow_of_two(count), n_dev)
-    if padded > width:
-        padded = width
+    # shardable only when every device owns a full, aligned 2^k-leaf subtree
+    # inside the virtual tree: mesh size a power of two and ≤ width. Anything
+    # else (tiny trees, odd meshes) goes to the host merkleizer, which
+    # handles every input.
+    if n_dev & (n_dev - 1) or n_dev > width:
+        return merkleize_chunks(chunks, limit)
+    local = max(1, next_pow_of_two(count) // n_dev)
+    padded = local * n_dev  # == max(next_pow_of_two(count), n_dev) ≤ width
     data = chunks + b"\x00" * ((padded - count) * BYTES_PER_CHUNK)
     words = np.ascontiguousarray(
         np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(padded, 8).T

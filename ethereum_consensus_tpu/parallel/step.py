@@ -94,6 +94,14 @@ def make_chain_step(
         local_n = balances.shape[0]
         if local_n % 4:
             raise ValueError("per-device balance count must be a multiple of 4")
+        # each device must own a full, aligned 2^k-chunk subtree; otherwise
+        # the zero-padded local reduction computes a root over misplaced
+        # leaves (chunk owned by the next device replaced by a zero chunk)
+        local_chunks = local_n // 4
+        if local_chunks == 0 or local_chunks & (local_chunks - 1):
+            raise ValueError(
+                f"per-device chunk count {local_chunks} must be a power of two"
+            )
 
         # 1. hysteresis sweep (epoch_processing.rs process_effective_balance_updates)
         candidate = jnp.minimum(balances - balances % increment, max_eff)

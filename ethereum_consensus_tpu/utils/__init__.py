@@ -1,0 +1,3 @@
+"""Runtime utilities: slot clock, misc host-side helpers."""
+
+from . import clock  # noqa: F401

@@ -1,0 +1,172 @@
+"""Consensus slot clock.
+
+Reference parity: ethereum-consensus/src/clock.rs (401 LoC) — nanosecond
+`TimeProvider` trait (clock.rs:68-71), `Clock` genesis-time math
+(clock.rs:137-215), per-network constructors (clock.rs:109-135), async
+`SlotStream` (clock.rs:234-267, tokio) here as an asyncio async-iterator.
+
+Times are integer nanoseconds since the UNIX epoch throughout, like the
+reference; durations returned to callers are float seconds (the asyncio
+convention).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import AsyncIterator, Protocol
+
+__all__ = [
+    "MAINNET_GENESIS_TIME",
+    "SEPOLIA_GENESIS_TIME",
+    "GOERLI_GENESIS_TIME",
+    "HOLESKY_GENESIS_TIME",
+    "TimeProvider",
+    "SystemTime",
+    "Clock",
+    "SlotStream",
+    "convert_timestamp_to_slot",
+    "for_mainnet",
+    "for_sepolia",
+    "for_goerli",
+    "for_holesky",
+]
+
+# genesis times for the built-in networks (clock.rs:12-15)
+MAINNET_GENESIS_TIME = 1606824023
+SEPOLIA_GENESIS_TIME = 1655733600
+GOERLI_GENESIS_TIME = 1616508000
+HOLESKY_GENESIS_TIME = 1695902400
+
+NANOS_PER_SEC = 1_000_000_000
+
+
+def convert_timestamp_to_slot(
+    timestamp: int, genesis_time: int, seconds_per_slot: int
+) -> int | None:
+    """Second-precision timestamp → slot; None before genesis (clock.rs:38)."""
+    if timestamp < genesis_time:
+        return None
+    return (timestamp - genesis_time) // seconds_per_slot
+
+
+class TimeProvider(Protocol):
+    """Current time with nanosecond precision (clock.rs:68-71)."""
+
+    def get_current_time(self) -> int: ...
+
+
+class SystemTime:
+    """Wall-clock provider (clock.rs:74-82)."""
+
+    def get_current_time(self) -> int:
+        return time.time_ns()
+
+
+class Clock:
+    """Slot clock over a pluggable time provider (clock.rs:83-215)."""
+
+    def __init__(
+        self,
+        genesis_time: int,
+        seconds_per_slot: int,
+        slots_per_epoch: int,
+        time_provider: TimeProvider,
+    ):
+        # nanosecond units carried in the names — callers comparing against
+        # UNIX-seconds timestamps must use genesis_time / timestamp_at_slot
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+        self.genesis_time_nanos = genesis_time * NANOS_PER_SEC
+        self.nanos_per_slot = seconds_per_slot * NANOS_PER_SEC
+        self.slots_per_epoch = slots_per_epoch
+        self.time_provider = time_provider
+
+    def _now(self) -> int:
+        return self.time_provider.get_current_time()
+
+    def before_genesis(self) -> bool:
+        return self._now() < self.genesis_time_nanos
+
+    def slot_at_time(self, current_time_nanos: int) -> int | None:
+        """Nanosecond timestamp → slot; None before genesis (clock.rs:169)."""
+        if current_time_nanos < self.genesis_time_nanos:
+            return None
+        return (current_time_nanos - self.genesis_time_nanos) // self.nanos_per_slot
+
+    def current_slot(self) -> int | None:
+        return self.slot_at_time(self._now())
+
+    def timestamp_at_slot(self, slot: int) -> int:
+        """Slot → seconds since UNIX epoch (clock.rs:174)."""
+        return slot * self.seconds_per_slot + self.genesis_time
+
+    def epoch_for(self, slot: int) -> int:
+        return slot // self.slots_per_epoch
+
+    def current_epoch(self) -> int | None:
+        slot = self.current_slot()
+        return None if slot is None else self.epoch_for(slot)
+
+    def duration_until_slot(self, slot: int) -> float:
+        """Seconds until ``slot`` starts; 0 if in the past (clock.rs:190)."""
+        target = slot * self.nanos_per_slot + self.genesis_time_nanos
+        return max(0, target - self._now()) / NANOS_PER_SEC
+
+    def duration_until_next_slot(self) -> float:
+        """(clock.rs:204)"""
+        now = self._now()
+        if now < self.genesis_time_nanos:
+            return (self.genesis_time_nanos - now) / NANOS_PER_SEC
+        next_slot = self.slot_at_time(now) + 1
+        target = next_slot * self.nanos_per_slot + self.genesis_time_nanos
+        return (target - now) / NANOS_PER_SEC
+
+    def into_stream(self) -> "SlotStream":
+        return SlotStream(self)
+
+
+class SlotStream:
+    """Async iterator of slots (clock.rs:234-267).
+
+    The first ``__anext__`` yields the slot current *at first iteration*
+    immediately even when mid-slot (not the slot at stream construction,
+    which may be long past); subsequent yields align to slot starts.
+    """
+
+    def __init__(self, clock: Clock):
+        self.clock = clock
+        self._yielded_first = False
+
+    def __aiter__(self) -> AsyncIterator[int | None]:
+        return self
+
+    async def __anext__(self) -> int | None:
+        import asyncio
+
+        if not self._yielded_first:
+            self._yielded_first = True
+            first_slot = self.clock.current_slot()
+            if first_slot is not None:
+                return first_slot
+        await asyncio.sleep(self.clock.duration_until_next_slot())
+        return self.clock.current_slot()
+
+
+def _system_clock(genesis_time: int, seconds_per_slot: int, slots_per_epoch: int) -> Clock:
+    return Clock(genesis_time, seconds_per_slot, slots_per_epoch, SystemTime())
+
+
+def for_mainnet() -> Clock:
+    return _system_clock(MAINNET_GENESIS_TIME, 12, 32)
+
+
+def for_sepolia() -> Clock:
+    return _system_clock(SEPOLIA_GENESIS_TIME, 12, 32)
+
+
+def for_goerli() -> Clock:
+    return _system_clock(GOERLI_GENESIS_TIME, 12, 32)
+
+
+def for_holesky() -> Clock:
+    return _system_clock(HOLESKY_GENESIS_TIME, 12, 32)

@@ -1,0 +1,161 @@
+"""Clock + networks tests — deterministic mock time, mirroring the
+reference's strategy (clock.rs:269-401: a `Ticker` TimeProvider drives the
+slot math and the stream without wall-clock)."""
+
+import asyncio
+
+import pytest
+
+from ethereum_consensus_tpu.config import Context
+from ethereum_consensus_tpu.config.networks import (
+    Network,
+    network_to_context,
+    typical_genesis_time,
+)
+from ethereum_consensus_tpu.utils.clock import (
+    Clock,
+    SystemTime,
+    convert_timestamp_to_slot,
+    for_mainnet,
+)
+
+NANOS = 1_000_000_000
+
+
+class Ticker:
+    """Mock TimeProvider: returns a scripted sequence of nanosecond times."""
+
+    def __init__(self, times):
+        self.times = list(times)
+        self.i = 0
+
+    def get_current_time(self) -> int:
+        t = self.times[min(self.i, len(self.times) - 1)]
+        self.i += 1
+        return t
+
+
+def make_clock(times, genesis=1000, spslot=12, spepoch=32):
+    return Clock(genesis, spslot, spepoch, Ticker(times))
+
+
+def test_before_genesis():
+    clock = make_clock([999 * NANOS, 1000 * NANOS])
+    assert clock.before_genesis()
+    assert not clock.before_genesis()
+
+
+def test_current_slot_math():
+    g = 1000
+    clock = make_clock(
+        [(g - 1) * NANOS, g * NANOS, (g + 11) * NANOS, (g + 12) * NANOS,
+         (g + 12 * 32) * NANOS]
+    )
+    assert clock.current_slot() is None
+    assert clock.current_slot() == 0
+    assert clock.current_slot() == 0
+    assert clock.current_slot() == 1
+    assert clock.current_slot() == 32
+
+
+def test_epoch_math():
+    clock = make_clock([(1000 + 12 * 32 * 5) * NANOS])
+    assert clock.epoch_for(32 * 5) == 5
+    assert clock.current_epoch() == 5
+
+
+def test_timestamp_at_slot_roundtrip():
+    clock = make_clock([0])
+    for slot in (0, 1, 7, 12345):
+        ts = clock.timestamp_at_slot(slot)
+        assert convert_timestamp_to_slot(ts, 1000, 12) == slot
+
+
+def test_duration_until_next_slot_pre_and_post_genesis():
+    g = 1000
+    clock = make_clock([(g - 5) * NANOS, (g + 3) * NANOS, g * NANOS])
+    assert clock.duration_until_next_slot() == pytest.approx(5.0)
+    assert clock.duration_until_next_slot() == pytest.approx(9.0)
+    # exactly at a slot start: a full slot until the next
+    assert clock.duration_until_next_slot() == pytest.approx(12.0)
+
+
+def test_duration_until_slot_past_is_zero():
+    clock = make_clock([(1000 + 100 * 12) * NANOS] * 2)
+    assert clock.duration_until_slot(1) == 0
+    assert clock.duration_until_slot(101) == pytest.approx(12.0)
+
+
+def test_slot_stream_first_yield_is_immediate():
+    g = 1000
+    # stream: current slot 2 (mid-slot), then aligned yields 3, 4
+    times = [
+        (g + 29) * NANOS,  # SlotStream init: current_slot -> 2
+        (g + 29) * NANOS,  # duration_until_next_slot -> 7s
+        (g + 36) * NANOS,  # current_slot after sleep -> 3
+        (g + 36) * NANOS,  # duration_until_next_slot -> 12
+        (g + 48) * NANOS,  # current_slot -> 4
+    ]
+    clock = make_clock(times)
+
+    async def take(n):
+        out = []
+        sleeps = []
+
+        real_sleep = asyncio.sleep
+
+        async def fake_sleep(d):
+            sleeps.append(d)
+            await real_sleep(0)
+
+        asyncio.sleep = fake_sleep
+        try:
+            stream = clock.into_stream()
+            async for slot in stream:
+                out.append(slot)
+                if len(out) == n:
+                    break
+        finally:
+            asyncio.sleep = real_sleep
+        return out, sleeps
+
+    out, sleeps = asyncio.run(take(3))
+    assert out == [2, 3, 4]
+    assert sleeps[0] == pytest.approx(7.0)
+    assert sleeps[1] == pytest.approx(12.0)
+
+
+def test_network_resolution():
+    for name in Network.KNOWN:
+        ctx = network_to_context(Network(name))
+        assert ctx.config.name == name if name != "goerli" else True
+    assert str(Network("mydevnet")).startswith("custom")
+
+
+def test_network_custom_config(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "PRESET_BASE: 'minimal'\nCONFIG_NAME: 'devnet'\nSECONDS_PER_SLOT: 3\n"
+    )
+    ctx = network_to_context(Network(str(tmp_path)))
+    assert ctx.config.name == "devnet"
+    assert ctx.seconds_per_slot == 3
+    assert ctx.preset.name == "minimal"
+
+
+def test_context_clock_uses_typical_genesis_time():
+    ctx = Context.for_minimal()
+    clock = ctx.clock()
+    expected = typical_genesis_time(ctx)
+    assert clock.genesis_time == expected
+    assert clock.genesis_time_nanos == expected * NANOS
+    assert clock.nanos_per_slot == ctx.seconds_per_slot * NANOS
+    assert clock.slots_per_epoch == ctx.SLOTS_PER_EPOCH
+
+
+def test_for_mainnet_constructor():
+    clock = for_mainnet()
+    assert isinstance(clock.time_provider, SystemTime)
+    assert clock.timestamp_at_slot(0) == 1606824023
+    # slot duration on mainnet is 12s
+    assert clock.timestamp_at_slot(100) - clock.timestamp_at_slot(99) == 12

@@ -45,3 +45,58 @@ print("entry-ok")
 """
     )
     assert "entry-ok" in out
+
+
+def test_sharded_merkleize_small_and_odd_meshes():
+    """Regression: small chunk counts (< mesh size) and non-power-of-two
+    meshes must fall back to the host merkleizer instead of crashing."""
+    out = run_in_cpu_mesh(
+        """
+import numpy as np
+from ethereum_consensus_tpu.parallel import chip_mesh, sharded_merkleize_chunks
+from ethereum_consensus_tpu.ssz.merkle import merkleize_chunks
+
+rng = np.random.default_rng(5)
+for n_dev, count, limit in [(8, 4, None), (8, 1, None), (6, 64, None),
+                            (8, 3, 4096), (5, 17, 64)]:
+    mesh = chip_mesh(n_dev)
+    chunks = rng.integers(0, 256, size=count * 32, dtype=np.uint8).tobytes()
+    got = sharded_merkleize_chunks(chunks, mesh, limit=limit)
+    want = merkleize_chunks(chunks, limit=limit)
+    assert got == want, (n_dev, count, limit, got.hex(), want.hex())
+print("small-odd-ok")
+"""
+    )
+    assert "small-odd-ok" in out
+
+
+def test_chain_step_rejects_non_pow2_local_chunks():
+    """Regression: a per-device chunk count that is not a power of two would
+    silently produce a wrong root; the step must refuse to trace."""
+    out = run_in_cpu_mesh(
+        """
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from ethereum_consensus_tpu.ops.merkle import zero_hash_words
+from ethereum_consensus_tpu.parallel import chip_mesh, make_chain_step
+
+mesh = chip_mesh(2)
+step = make_chain_step(mesh)
+n = 24  # 12 per device -> 3 chunks: not a power of two
+balances = jnp.asarray(np.full(n, 32 * 10**9, dtype=np.uint64))
+eff = jnp.asarray(np.full(n, 32 * 10**9, dtype=np.uint64))
+active = jnp.asarray(np.ones(n, dtype=bool))
+zw = jnp.asarray(zero_hash_words())
+try:
+    step(balances, eff, active, zw)
+except ValueError as e:
+    assert "power of two" in str(e), e
+    print("step-reject-ok")
+else:
+    raise AssertionError("expected ValueError for non-pow2 local chunks")
+""",
+        n_devices=2,
+    )
+    assert "step-reject-ok" in out
