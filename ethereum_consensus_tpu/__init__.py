@@ -24,3 +24,19 @@ __version__ = "0.1.0"
 
 from . import error, fork, primitives, ssz  # noqa: F401
 from .fork import Fork  # noqa: F401
+
+
+def __getattr__(name):
+    # heavyweight subsystems load lazily so `import ethereum_consensus_tpu`
+    # stays cheap (models pulls crypto + every fork's containers)
+    import importlib
+
+    if name in {
+        "api", "builder", "cli", "clock", "config", "crypto", "executor", "execution_engine",
+        "models", "networking", "ops", "parallel", "serde", "signing", "types",
+        "utils",
+    }:
+        if name == "clock":
+            return importlib.import_module(".utils.clock", __name__)
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

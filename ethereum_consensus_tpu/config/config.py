@@ -64,6 +64,8 @@ class Config:
     min_per_epoch_churn_limit: int = 4
     max_per_epoch_activation_churn_limit: int = 8
     churn_limit_quotient: int = 65536
+    min_per_epoch_churn_limit_electra: int = 128_000_000_000
+    max_per_epoch_activation_exit_churn_limit: int = 256_000_000_000
 
     # fork choice
     proposer_score_boost: int = 40
@@ -140,6 +142,8 @@ def minimal_config() -> Config:
         min_per_epoch_churn_limit=2,
         max_per_epoch_activation_churn_limit=4,
         churn_limit_quotient=32,
+        min_per_epoch_churn_limit_electra=64_000_000_000,
+        max_per_epoch_activation_exit_churn_limit=128_000_000_000,
         deposit_chain_id=5,
         deposit_network_id=5,
         deposit_contract_address=_hex("1234567890123456789012345678901234567890"),

@@ -26,6 +26,7 @@ class DomainType(IntEnum):
     SYNC_COMMITTEE_SELECTION_PROOF = 8
     CONTRIBUTION_AND_PROOF = 9
     BLS_TO_EXECUTION_CHANGE = 10
+    CONSOLIDATION = 11
     APPLICATION_MASK = 0x01000000  # bytes [0,0,0,1]
     # DOMAIN_APPLICATION_BUILDER shares the application-mask encoding
     APPLICATION_BUILDER = 0x01000000

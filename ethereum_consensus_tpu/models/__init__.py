@@ -5,4 +5,4 @@ Reference parity: ethereum-consensus/src/{phase0,altair,bellatrix,capella,
 deneb,electra}/ and src/types/.
 """
 
-from . import phase0  # noqa: F401
+from . import altair, bellatrix, capella, deneb, electra, phase0  # noqa: F401

@@ -1,0 +1,20 @@
+"""altair — sync committees, participation flags, light client (C20).
+
+Reference parity: ethereum-consensus/src/altair/ (3,801 LoC). Fork-diff
+modules compose over phase0 (re-imports for unchanged logic), replacing the
+reference's spec-gen flattening.
+"""
+
+from . import (  # noqa: F401
+    block_processing,
+    constants,
+    containers,
+    epoch_processing,
+    fork,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401
+from .fork import upgrade_to_altair  # noqa: F401

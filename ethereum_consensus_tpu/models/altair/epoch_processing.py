@@ -1,0 +1,142 @@
+"""altair epoch processing.
+
+Reference parity: ethereum-consensus/src/altair/epoch_processing.rs —
+participation-flag justification (:51), process_inactivity_updates:104,
+flag-delta rewards (:160), process_participation_flag_updates:201,
+altair process_slashings (:240), process_sync_committee_updates:273,
+altair process_epoch:305.
+"""
+
+from __future__ import annotations
+
+from ...primitives import GENESIS_EPOCH
+from ..phase0.epoch_processing import (  # noqa: F401 — fork-diff re-exports
+    process_effective_balance_updates,
+    process_eth1_data_reset,
+    process_historical_roots_update,
+    process_randao_mixes_reset,
+    process_registry_updates,
+    process_slashings_reset,
+    weigh_justification_and_finalization,
+)
+from . import helpers as h
+from .constants import PARTICIPATION_FLAG_WEIGHTS, TIMELY_TARGET_FLAG_INDEX
+
+__all__ = [
+    "process_justification_and_finalization",
+    "process_inactivity_updates",
+    "process_rewards_and_penalties",
+    "process_participation_flag_updates",
+    "process_slashings",
+    "process_sync_committee_updates",
+    "process_epoch",
+]
+
+
+def process_justification_and_finalization(state, context) -> None:
+    """(epoch_processing.rs:51) — target balances from participation flags."""
+    current_epoch = h.get_current_epoch(state, context)
+    if current_epoch <= GENESIS_EPOCH + 1:
+        return
+    previous_indices = h.get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, h.get_previous_epoch(state, context), context
+    )
+    current_indices = h.get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, current_epoch, context
+    )
+    total_active = h.get_total_active_balance(state, context)
+    previous_target = h.get_total_balance(state, previous_indices, context)
+    current_target = h.get_total_balance(state, current_indices, context)
+    weigh_justification_and_finalization(
+        state, total_active, previous_target, current_target, context
+    )
+
+
+def process_inactivity_updates(state, context) -> None:
+    """(epoch_processing.rs:104)"""
+    current_epoch = h.get_current_epoch(state, context)
+    if current_epoch == GENESIS_EPOCH:
+        return
+    eligible = h.get_eligible_validator_indices(state, context)
+    unslashed_participating = h.get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, h.get_previous_epoch(state, context), context
+    )
+    not_leaking = not h.is_in_inactivity_leak(state, context)
+    for index in eligible:
+        if index in unslashed_participating:
+            state.inactivity_scores[index] -= min(1, state.inactivity_scores[index])
+        else:
+            state.inactivity_scores[index] += context.inactivity_score_bias
+        if not_leaking:
+            state.inactivity_scores[index] -= min(
+                context.inactivity_score_recovery_rate,
+                state.inactivity_scores[index],
+            )
+
+
+def process_rewards_and_penalties(state, context) -> None:
+    """(epoch_processing.rs:160) — flag deltas + inactivity penalties."""
+    if h.get_current_epoch(state, context) == GENESIS_EPOCH:
+        return
+    deltas = [
+        h.get_flag_index_deltas(state, flag_index, context)
+        for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
+    ]
+    deltas.append(h.get_inactivity_penalty_deltas(state, context))
+    for rewards, penalties in deltas:
+        for index in range(len(state.validators)):
+            h.increase_balance(state, index, rewards[index])
+            h.decrease_balance(state, index, penalties[index])
+
+
+def process_participation_flag_updates(state, context) -> None:
+    """(epoch_processing.rs:201)"""
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.current_epoch_participation = [0] * len(state.validators)
+
+
+def process_slashings(state, context) -> None:
+    """(epoch_processing.rs:240) — altair proportional multiplier."""
+    epoch = h.get_current_epoch(state, context)
+    total_balance = h.get_total_active_balance(state, context)
+    adjusted_total_slashing_balance = min(
+        sum(state.slashings) * context.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR,
+        total_balance,
+    )
+    increment = context.EFFECTIVE_BALANCE_INCREMENT
+    for index, validator in enumerate(state.validators):
+        if (
+            validator.slashed
+            and epoch + context.EPOCHS_PER_SLASHINGS_VECTOR // 2
+            == validator.withdrawable_epoch
+        ):
+            penalty_numerator = (
+                validator.effective_balance // increment * adjusted_total_slashing_balance
+            )
+            penalty = penalty_numerator // total_balance * increment
+            h.decrease_balance(state, index, penalty)
+
+
+def process_sync_committee_updates(state, context) -> None:
+    """(epoch_processing.rs:273)"""
+    next_epoch = h.get_current_epoch(state, context) + 1
+    if next_epoch % context.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        next_sync_committee = h.get_next_sync_committee(state, context)
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = next_sync_committee
+
+
+def process_epoch(state, context) -> None:
+    """(epoch_processing.rs:305)"""
+    process_justification_and_finalization(state, context)
+    process_inactivity_updates(state, context)
+    process_rewards_and_penalties(state, context)
+    process_registry_updates(state, context)
+    process_slashings(state, context)
+    process_eth1_data_reset(state, context)
+    process_effective_balance_updates(state, context)
+    process_slashings_reset(state, context)
+    process_randao_mixes_reset(state, context)
+    process_historical_roots_update(state, context)
+    process_participation_flag_updates(state, context)
+    process_sync_committee_updates(state, context)

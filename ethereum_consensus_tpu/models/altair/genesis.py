@@ -1,0 +1,81 @@
+"""altair genesis.
+
+Reference parity: ethereum-consensus/src/altair/genesis.rs — same shape as
+phase0 but with the altair fork version and sync committees initialized
+after bootstrap deposits.
+"""
+
+from __future__ import annotations
+
+from ...primitives import GENESIS_EPOCH
+from ..phase0.containers import BeaconBlockHeader, DepositData, Eth1Data, Fork
+from ..phase0.genesis import is_valid_genesis_state  # noqa: F401 — unchanged
+from . import helpers as h
+from .block_processing import process_deposit
+from .containers import build
+
+__all__ = [
+    "initialize_beacon_state_from_eth1",
+    "is_valid_genesis_state",
+    "get_genesis_block",
+]
+
+
+def initialize_beacon_state_from_eth1(
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits: list,
+    context,
+    execution_payload_header=None,
+):
+    """(genesis.rs:12)"""
+    ns = build(context.preset)
+    fork = Fork(
+        previous_version=context.altair_fork_version,
+        current_version=context.altair_fork_version,
+        epoch=GENESIS_EPOCH,
+    )
+    state = ns.BeaconState(
+        genesis_time=eth1_timestamp + context.genesis_delay,
+        fork=fork,
+        eth1_data=Eth1Data(block_hash=eth1_block_hash, deposit_count=len(deposits)),
+        latest_block_header=BeaconBlockHeader(
+            body_root=ns.BeaconBlockBody.hash_tree_root(ns.BeaconBlockBody())
+        ),
+        randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    from ...ssz import List as SSZList
+
+    deposit_data_list_type = SSZList[DepositData, 2**32]
+    leaves = [d.data for d in deposits]
+    for index, deposit in enumerate(deposits):
+        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
+            leaves[: index + 1]
+        )
+        process_deposit(state, deposit, context)
+
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        validator.effective_balance = min(
+            balance - balance % context.EFFECTIVE_BALANCE_INCREMENT,
+            context.MAX_EFFECTIVE_BALANCE,
+        )
+        if validator.effective_balance == context.MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = GENESIS_EPOCH
+            validator.activation_epoch = GENESIS_EPOCH
+
+    state.genesis_validators_root = type(state).__ssz_fields__[
+        "validators"
+    ].hash_tree_root(state.validators)
+
+    sync_committee = h.get_next_sync_committee(state, context)
+    state.current_sync_committee = sync_committee
+    state.next_sync_committee = sync_committee.copy()
+    return state
+
+
+def get_genesis_block(state, context):
+    """(phase0 genesis.rs:137 shape with the altair block type)"""
+    ns = build(context.preset)
+    return ns.BeaconBlock(state_root=type(state).hash_tree_root(state))

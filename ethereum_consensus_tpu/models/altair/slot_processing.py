@@ -1,0 +1,16 @@
+"""altair slot processing (phase0 skeleton + altair process_epoch)."""
+
+from __future__ import annotations
+
+from ..transition import process_slot_generic, process_slots_generic
+from .epoch_processing import process_epoch
+
+__all__ = ["process_slot", "process_slots"]
+
+
+def process_slot(state, context) -> None:
+    process_slot_generic(state, context)
+
+
+def process_slots(state, slot: int, context) -> None:
+    process_slots_generic(state, slot, context, process_epoch)

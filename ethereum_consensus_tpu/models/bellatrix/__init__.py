@@ -1,0 +1,17 @@
+"""bellatrix — the merge: execution payloads, blinded blocks (C21).
+
+Reference parity: ethereum-consensus/src/bellatrix/ (4,485 LoC).
+"""
+
+from . import (  # noqa: F401
+    block_processing,
+    containers,
+    epoch_processing,
+    fork,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401
+from .fork import upgrade_to_bellatrix  # noqa: F401

@@ -1,0 +1,17 @@
+"""capella — withdrawals, BLS→execution changes (C22).
+
+Reference parity: ethereum-consensus/src/capella/ (4,974 LoC).
+"""
+
+from . import (  # noqa: F401
+    block_processing,
+    containers,
+    epoch_processing,
+    fork,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401
+from .fork import upgrade_to_capella  # noqa: F401

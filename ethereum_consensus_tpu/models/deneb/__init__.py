@@ -1,0 +1,19 @@
+"""deneb — blobs / EIP-4844, 7044, 7045, 7514 (C23).
+
+Reference parity: ethereum-consensus/src/deneb/ (5,253 LoC).
+"""
+
+from . import (  # noqa: F401
+    blob_sidecar,
+    block_processing,
+    containers,
+    epoch_processing,
+    execution_engine,
+    fork,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401
+from .fork import upgrade_to_deneb  # noqa: F401

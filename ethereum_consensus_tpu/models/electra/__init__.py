@@ -1,0 +1,19 @@
+"""electra — EIP-7251 / 6110 / 7002 / 7549 (C24).
+
+Reference parity: ethereum-consensus/src/electra/ (6,577 LoC). Unlike the
+reference (which leaves electra out of the polymorphic layer/Executor,
+SURVEY.md §2 C24), this fork is fully wired into types/ and the Executor.
+"""
+
+from . import (  # noqa: F401
+    block_processing,
+    containers,
+    epoch_processing,
+    fork,
+    genesis,
+    helpers,
+    slot_processing,
+    state_transition,
+)
+from .containers import build  # noqa: F401
+from .fork import upgrade_to_electra  # noqa: F401

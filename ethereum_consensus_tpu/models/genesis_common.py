@@ -1,0 +1,79 @@
+"""Fork-generic genesis construction.
+
+Every fork's ``initialize_beacon_state_from_eth1`` repeats one skeleton
+(phase0/genesis.rs:15 re-spun per fork by spec-gen): build the empty state
+at the fork's version, fold in bootstrap deposits against an incremental
+deposit tree, activate full-balance validators, set the validators root —
+then the fork-specific tail (altair+: sync committees; bellatrix+: genesis
+execution payload header).
+"""
+
+from __future__ import annotations
+
+from ..primitives import GENESIS_EPOCH
+from .phase0.containers import BeaconBlockHeader, DepositData, Eth1Data, Fork
+
+__all__ = ["initialize_state_generic"]
+
+DEPOSIT_DATA_LIST_BOUND = 2**32
+
+
+def initialize_state_generic(
+    ns,
+    fork_version: bytes,
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits: list,
+    context,
+    process_deposit_fn,
+    get_next_sync_committee_fn=None,
+    execution_payload_header=None,
+):
+    """Returns the fork's genesis BeaconState (see module docstring)."""
+    state = ns.BeaconState(
+        genesis_time=eth1_timestamp + context.genesis_delay,
+        fork=Fork(
+            previous_version=fork_version,
+            current_version=fork_version,
+            epoch=GENESIS_EPOCH,
+        ),
+        eth1_data=Eth1Data(block_hash=eth1_block_hash, deposit_count=len(deposits)),
+        latest_block_header=BeaconBlockHeader(
+            body_root=ns.BeaconBlockBody.hash_tree_root(ns.BeaconBlockBody())
+        ),
+        randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    from ..ssz import List as SSZList
+
+    deposit_data_list_type = SSZList[DepositData, DEPOSIT_DATA_LIST_BOUND]
+    leaves = [d.data for d in deposits]
+    for index, deposit in enumerate(deposits):
+        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
+            leaves[: index + 1]
+        )
+        process_deposit_fn(state, deposit, context)
+
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        validator.effective_balance = min(
+            balance - balance % context.EFFECTIVE_BALANCE_INCREMENT,
+            context.MAX_EFFECTIVE_BALANCE,
+        )
+        if validator.effective_balance == context.MAX_EFFECTIVE_BALANCE:
+            validator.activation_eligibility_epoch = GENESIS_EPOCH
+            validator.activation_epoch = GENESIS_EPOCH
+
+    state.genesis_validators_root = type(state).__ssz_fields__[
+        "validators"
+    ].hash_tree_root(state.validators)
+
+    if get_next_sync_committee_fn is not None:
+        sync_committee = get_next_sync_committee_fn(state, context)
+        state.current_sync_committee = sync_committee
+        state.next_sync_committee = sync_committee.copy()
+
+    if execution_payload_header is not None:
+        state.latest_execution_payload_header = execution_payload_header.copy()
+
+    return state

@@ -112,8 +112,11 @@ def process_eth1_data(state, body, context) -> None:
         state.eth1_data = body.eth1_data.copy()
 
 
-def process_proposer_slashing(state, proposer_slashing, context) -> None:
-    """(block_processing.rs:34)"""
+def process_proposer_slashing(state, proposer_slashing, context, slash_fn=None) -> None:
+    """(block_processing.rs:34) — ``slash_fn`` lets later forks swap in
+    their slash_validator (the only fork-varying piece)."""
+    if slash_fn is None:
+        slash_fn = h.slash_validator
     header_1 = proposer_slashing.signed_header_1.message
     header_2 = proposer_slashing.signed_header_2.message
     if header_1.slot != header_2.slot:
@@ -146,7 +149,7 @@ def process_proposer_slashing(state, proposer_slashing, context) -> None:
         sig = bls.Signature.from_bytes(signed_header.signature)
         if not bls.verify_signature(pk, signing_root, sig):
             raise InvalidProposerSlashing("invalid header signature")
-    h.slash_validator(state, index, None, context)
+    slash_fn(state, index, None, context)
 
 
 def process_attester_slashing(state, attester_slashing, context) -> None:

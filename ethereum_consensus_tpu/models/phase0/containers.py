@@ -30,10 +30,10 @@ from ...primitives import (
     ValidatorIndex,
     Version,
 )
+from ...signing import SigningData
 from ...ssz import (
     Bitlist,
     Bitvector,
-    ByteVector,
     Container,
     List,
     Vector,
@@ -161,11 +161,6 @@ class SignedVoluntaryExit(Container):
 class HistoricalSummary(Container):
     block_summary_root: Root
     state_summary_root: Root
-
-
-class SigningData(Container):
-    object_root: Root
-    domain: ByteVector[32]
 
 
 @functools.lru_cache(maxsize=None)

@@ -8,13 +8,21 @@ from __future__ import annotations
 
 from .crypto import bls
 from .error import InvalidSignatureError
-from .models.phase0.containers import SigningData
+from .ssz import ByteVector, Container
 
 __all__ = [
+    "SigningData",
     "compute_signing_root",
     "sign_with_domain",
     "verify_signed_data",
 ]
+
+
+class SigningData(Container):
+    """(signing.rs:7) — also re-exported via models.phase0.containers."""
+
+    object_root: ByteVector[32]
+    domain: ByteVector[32]
 
 
 def compute_signing_root(ssz_type, value, domain: bytes) -> bytes:

@@ -21,6 +21,8 @@ from .core import (
     deserialize,
     get_generalized_index,
     hash_tree_root,
+    prove,
+    compute_subtree_root,
     serialize,
     uint8,
     uint16,
@@ -59,6 +61,8 @@ __all__ = [
     "deserialize",
     "get_generalized_index",
     "hash_tree_root",
+    "prove",
+    "compute_subtree_root",
     "serialize",
     "uint8",
     "uint16",

@@ -48,6 +48,8 @@ __all__ = [
     "deserialize",
     "hash_tree_root",
     "get_generalized_index",
+    "prove",
+    "compute_subtree_root",
     "DeserializeError",
 ]
 
@@ -972,3 +974,93 @@ def get_generalized_index(typ, *path) -> int:
         root = root * (2 if is_list else 1) * base + pos
         typ = next_typ
     return root
+
+
+# ---------------------------------------------------------------------------
+# Typed single-branch proofs (the ssz_rs `prove` equivalent,
+# reference: ssz_rs re-exported at ethereum-consensus/src/ssz/mod.rs:1-8,
+# used by spec-tests/runners/light_client.rs:10-13)
+# ---------------------------------------------------------------------------
+
+
+def _top_level_chunk_bytes(typ, value) -> bytes:
+    """The populated 32-byte chunks at ``typ``'s top merkle layer
+    (pre-length-mixin for list kinds)."""
+    from .merkle import pack_bytes
+
+    if isinstance(typ, type) and issubclass(typ, Container):
+        return b"".join(
+            t.hash_tree_root(getattr(value, key))
+            for key, t in typ.__ssz_fields__.items()
+        )
+    if isinstance(typ, (Vector, List)):
+        if _is_basic(typ.elem):
+            return pack_bytes(b"".join(typ.elem.serialize(v) for v in value))
+        return b"".join(typ.elem.hash_tree_root(v) for v in value)
+    if isinstance(typ, (Bitvector, Bitlist)):
+        return pack_bytes(_bits_to_bytes(value, include_delimiter=False))
+    if isinstance(typ, (ByteVector, ByteList)):
+        return pack_bytes(bytes(value))
+    raise TypeError(f"cannot chunk {typ!r}")
+
+
+def _element_at(typ, value, chunk_index: int):
+    """(elem_typ, elem_value) under top-layer chunk ``chunk_index`` — only
+    meaningful for composite-element kinds (deeper descent)."""
+    if isinstance(typ, type) and issubclass(typ, Container):
+        key = list(typ.__ssz_fields__)[chunk_index]
+        return typ.__ssz_fields__[key], getattr(value, key)
+    if isinstance(typ, (Vector, List)) and not _is_basic(typ.elem):
+        if chunk_index < len(value):
+            return typ.elem, value[chunk_index]
+        return typ.elem, typ.elem.default()
+    raise TypeError(f"{typ!r}: generalized index descends below chunk layer")
+
+
+def compute_subtree_root(typ, value, gindex: int) -> bytes:
+    """hash of the subtree at ``gindex`` in hash_tree_root(typ, value)."""
+    from .merkle import merkleize_chunks, next_pow_of_two, zero_hash
+
+    if gindex < 1:
+        raise ValueError("generalized index must be >= 1")
+    if gindex == 1:
+        return hash_tree_root(typ, value)
+    bits = bin(gindex)[3:]  # descent path, MSB first
+
+    is_list_kind = isinstance(typ, (List, Bitlist, ByteList))
+    if is_list_kind:
+        if bits[0] == "1":
+            if len(bits) > 1:
+                raise ValueError("cannot descend into the length mix-in")
+            return len(value).to_bytes(32, "little")
+        bits = bits[1:]
+
+    chunks = _top_level_chunk_bytes(typ, value)
+    limit = next_pow_of_two(_chunk_count_of(typ))
+    depth = (limit - 1).bit_length()
+    if not bits:
+        return merkleize_chunks(chunks, limit=limit)
+    if len(bits) <= depth:
+        k = depth - len(bits)
+        start = int(bits, 2) << k
+        sub = chunks[start * 32 : (start + (1 << k)) * 32]
+        if not sub:
+            return zero_hash(k)
+        return merkleize_chunks(sub, limit=1 << k)
+    # deeper than the chunk layer: recurse into the addressed element
+    chunk_index = int(bits[:depth], 2)
+    elem_typ, elem_val = _element_at(typ, value, chunk_index)
+    sub_gindex = int("1" + bits[depth:], 2)
+    return compute_subtree_root(elem_typ, elem_val, sub_gindex)
+
+
+def prove(typ, value, gindex: int) -> list[bytes]:
+    """Single-branch merkle proof for ``gindex``: branch[i] is the sibling
+    at distance i above the leaf, as consumed by
+    is_valid_merkle_branch_for_generalized_index / is_valid_merkle_branch."""
+    branch = []
+    g = gindex
+    while g > 1:
+        branch.append(compute_subtree_root(typ, value, g ^ 1))
+        g >>= 1
+    return branch

@@ -18,8 +18,10 @@ __all__ = [
     "hash_pair",
     "hash_level_host",
     "register_device_hasher",
+    "register_native_hasher",
     "hash_level",
     "DEVICE_MIN_NODES",
+    "NATIVE_MIN_NODES",
 ]
 
 
@@ -59,9 +61,25 @@ def register_device_hasher(fn: Callable[[bytes], bytes]) -> None:
     _device_hasher = fn
 
 
+# The native C++ hasher (ethereum_consensus_tpu.native) sits between hashlib
+# and the device: it wins over hashlib once the level is big enough to
+# amortize the ctypes call (~1µs), far below the device threshold.
+_native_hasher: Callable[[bytes], bytes] | None = None
+
+NATIVE_MIN_NODES = 8
+
+
+def register_native_hasher(fn: Callable[[bytes], bytes]) -> None:
+    global _native_hasher
+    _native_hasher = fn
+
+
 def hash_level(nodes: bytes) -> bytes:
-    """Hash one merkle level, routing to the device backend when registered
-    and the batch is large enough to amortize the transfer."""
-    if _device_hasher is not None and len(nodes) // 64 >= DEVICE_MIN_NODES:
+    """Hash one merkle level, routing to the fastest registered backend:
+    device for huge levels, native C++ for medium, hashlib otherwise."""
+    n = len(nodes) // 64
+    if _device_hasher is not None and n >= DEVICE_MIN_NODES:
         return _device_hasher(nodes)
+    if _native_hasher is not None and n >= NATIVE_MIN_NODES:
+        return _native_hasher(nodes)
     return hash_level_host(nodes)

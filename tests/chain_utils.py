@@ -190,3 +190,522 @@ def _block_root_at_or_latest(state, slot: int) -> bytes:
     if header.state_root == b"\x00" * 32:
         header.state_root = type(state).hash_tree_root(state)
     return BeaconBlockHeader.hash_tree_root(header)
+
+
+# ---------------------------------------------------------------------------
+# altair
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis_altair(validator_count: int, preset_name: str):
+    from ethereum_consensus_tpu.models.altair import genesis as altair_genesis
+
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    deposits = make_deposits(validator_count, context)
+    state = altair_genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context
+    )
+    return state, context
+
+
+def fresh_genesis_altair(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis_altair(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_sync_aggregate(state, context, participation=1.0):
+    """Full (or partial) sync-committee signature over the previous slot's
+    block root; ``state`` must be at the block's slot."""
+    from ethereum_consensus_tpu.models.altair import build as altair_build
+    from ethereum_consensus_tpu.models.altair import helpers as ah
+    from ethereum_consensus_tpu.primitives import Root
+
+    ns = altair_build(context.preset)
+    previous_slot = max(state.slot, 1) - 1
+    root = h.get_block_root_at_slot(state, previous_slot)
+    domain = ah.get_domain(
+        state,
+        DomainType.SYNC_COMMITTEE,
+        previous_slot // context.SLOTS_PER_EPOCH,
+        context,
+    )
+    signing_root = compute_signing_root(Root, root, domain)
+
+    index_by_key = {bytes(v.public_key): i for i, v in enumerate(state.validators)}
+    committee_indices = [
+        index_by_key[bytes(pk)] for pk in state.current_sync_committee.public_keys
+    ]
+    n_participants = max(1, int(len(committee_indices) * participation))
+    bits = [i < n_participants for i in range(len(committee_indices))]
+    sigs = [
+        secret_key(committee_indices[i]).sign(signing_root)
+        for i in range(len(committee_indices))
+        if bits[i]
+    ]
+    return ns.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=bls.aggregate(sigs).to_bytes(),
+    )
+
+
+def produce_block_altair(state, slot: int, context, attestations=()):
+    """altair produce_block: advances state, builds body with attestations +
+    a full sync aggregate, fills the post-state root, and signs."""
+    from ethereum_consensus_tpu.models.altair import build as altair_build
+    from ethereum_consensus_tpu.models.altair.block_processing import process_block
+    from ethereum_consensus_tpu.models.altair.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = altair_build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+        sync_aggregate=make_sync_aggregate(state, context),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)
+
+
+# ---------------------------------------------------------------------------
+# bellatrix
+# ---------------------------------------------------------------------------
+
+GENESIS_PAYLOAD_BLOCK_HASH = b"\x77" * 32
+
+
+def make_genesis_payload_header(context):
+    """A non-default genesis ExecutionPayloadHeader (post-merge genesis)."""
+    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
+
+    ns = bellatrix_build(context.preset)
+    return ns.ExecutionPayloadHeader(
+        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
+        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
+        prev_randao=ETH1_BLOCK_HASH,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis_bellatrix(validator_count: int, preset_name: str):
+    from ethereum_consensus_tpu.models.bellatrix import genesis as bellatrix_genesis
+
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    deposits = make_deposits(validator_count, context)
+    state = bellatrix_genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH,
+        ETH1_TIMESTAMP,
+        deposits,
+        context,
+        execution_payload_header=make_genesis_payload_header(context),
+    )
+    return state, context
+
+
+def fresh_genesis_bellatrix(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis_bellatrix(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_execution_payload(state, context, block_number=1):
+    """A payload valid for ``state`` at its current slot (bellatrix checks:
+    parent hash chains, prev_randao matches, timestamp matches)."""
+    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
+    from ethereum_consensus_tpu.models.bellatrix import helpers as bh
+
+    ns = bellatrix_build(context.preset)
+    epoch = state.slot // context.SLOTS_PER_EPOCH
+    return ns.ExecutionPayload(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(state, epoch),
+        block_number=block_number,
+        timestamp=bh.compute_timestamp_at_slot(state, state.slot, context),
+        block_hash=bls.hash(b"exec-block-%d" % int(state.slot)),
+    )
+
+
+def produce_block_bellatrix(state, slot: int, context, attestations=()):
+    """bellatrix produce_block: attestations + sync aggregate + a chained
+    execution payload."""
+    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
+    from ethereum_consensus_tpu.models.bellatrix.block_processing import process_block
+    from ethereum_consensus_tpu.models.bellatrix.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = bellatrix_build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+        sync_aggregate=make_sync_aggregate(state, context),
+        execution_payload=make_execution_payload(state, context, block_number=slot),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)
+
+
+# ---------------------------------------------------------------------------
+# capella
+# ---------------------------------------------------------------------------
+
+
+def make_genesis_payload_header_capella(context):
+    from ethereum_consensus_tpu.models.capella import build as capella_build
+
+    ns = capella_build(context.preset)
+    return ns.ExecutionPayloadHeader(
+        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
+        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
+        prev_randao=ETH1_BLOCK_HASH,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis_capella(validator_count: int, preset_name: str):
+    from ethereum_consensus_tpu.models.capella import genesis as capella_genesis
+
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    deposits = make_deposits(validator_count, context)
+    state = capella_genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH,
+        ETH1_TIMESTAMP,
+        deposits,
+        context,
+        execution_payload_header=make_genesis_payload_header_capella(context),
+    )
+    return state, context
+
+
+def fresh_genesis_capella(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis_capella(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_execution_payload_capella(state, context, block_number=1):
+    """Capella payload: bellatrix checks + the expected-withdrawals list."""
+    from ethereum_consensus_tpu.models.capella import build as capella_build
+    from ethereum_consensus_tpu.models.capella import helpers as ch
+    from ethereum_consensus_tpu.models.capella.block_processing import (
+        get_expected_withdrawals,
+    )
+
+    ns = capella_build(context.preset)
+    epoch = state.slot // context.SLOTS_PER_EPOCH
+    return ns.ExecutionPayload(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(state, epoch),
+        block_number=block_number,
+        timestamp=ch.compute_timestamp_at_slot(state, state.slot, context),
+        block_hash=bls.hash(b"exec-block-capella-%d" % int(state.slot)),
+        withdrawals=get_expected_withdrawals(state, context),
+    )
+
+
+def produce_block_capella(state, slot: int, context, attestations=(),
+                          bls_to_execution_changes=()):
+    from ethereum_consensus_tpu.models.capella import build as capella_build
+    from ethereum_consensus_tpu.models.capella.block_processing import process_block
+    from ethereum_consensus_tpu.models.capella.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = capella_build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+        sync_aggregate=make_sync_aggregate(state, context),
+        execution_payload=make_execution_payload_capella(
+            state, context, block_number=slot
+        ),
+        bls_to_execution_changes=list(bls_to_execution_changes),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)
+
+
+# ---------------------------------------------------------------------------
+# deneb
+# ---------------------------------------------------------------------------
+
+
+def make_genesis_payload_header_deneb(context):
+    from ethereum_consensus_tpu.models.deneb import build as deneb_build
+
+    ns = deneb_build(context.preset)
+    return ns.ExecutionPayloadHeader(
+        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
+        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
+        prev_randao=ETH1_BLOCK_HASH,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis_deneb(validator_count: int, preset_name: str):
+    from ethereum_consensus_tpu.models.deneb import genesis as deneb_genesis
+
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    deposits = make_deposits(validator_count, context)
+    state = deneb_genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH,
+        ETH1_TIMESTAMP,
+        deposits,
+        context,
+        execution_payload_header=make_genesis_payload_header_deneb(context),
+    )
+    return state, context
+
+
+def fresh_genesis_deneb(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis_deneb(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_execution_payload_deneb(state, context, block_number=1):
+    from ethereum_consensus_tpu.models.deneb import build as deneb_build
+    from ethereum_consensus_tpu.models.deneb import helpers as dh
+    from ethereum_consensus_tpu.models.capella.block_processing import (
+        get_expected_withdrawals,
+    )
+
+    ns = deneb_build(context.preset)
+    epoch = state.slot // context.SLOTS_PER_EPOCH
+    return ns.ExecutionPayload(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(state, epoch),
+        block_number=block_number,
+        timestamp=dh.compute_timestamp_at_slot(state, state.slot, context),
+        block_hash=bls.hash(b"exec-block-deneb-%d" % int(state.slot)),
+        withdrawals=get_expected_withdrawals(state, context),
+    )
+
+
+def produce_block_deneb(state, slot: int, context, attestations=(),
+                        blob_kzg_commitments=()):
+    from ethereum_consensus_tpu.models.deneb import build as deneb_build
+    from ethereum_consensus_tpu.models.deneb.block_processing import process_block
+    from ethereum_consensus_tpu.models.deneb.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = deneb_build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+        sync_aggregate=make_sync_aggregate(state, context),
+        execution_payload=make_execution_payload_deneb(
+            state, context, block_number=slot
+        ),
+        blob_kzg_commitments=list(blob_kzg_commitments),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)
+
+
+# ---------------------------------------------------------------------------
+# electra
+# ---------------------------------------------------------------------------
+
+
+def make_genesis_payload_header_electra(context):
+    from ethereum_consensus_tpu.models.electra import build as electra_build
+
+    ns = electra_build(context.preset)
+    return ns.ExecutionPayloadHeader(
+        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
+        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
+        prev_randao=ETH1_BLOCK_HASH,
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def cached_genesis_electra(validator_count: int, preset_name: str):
+    from ethereum_consensus_tpu.models.electra import genesis as electra_genesis
+
+    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    deposits = make_deposits(validator_count, context)
+    state = electra_genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH,
+        ETH1_TIMESTAMP,
+        deposits,
+        context,
+        execution_payload_header=make_genesis_payload_header_electra(context),
+    )
+    return state, context
+
+
+def fresh_genesis_electra(validator_count: int = 64, preset_name: str = "minimal"):
+    state, context = cached_genesis_electra(validator_count, preset_name)
+    return state.copy(), context
+
+
+def make_execution_payload_electra(state, context, block_number=1,
+                                   deposit_receipts=(), withdrawal_requests=()):
+    from ethereum_consensus_tpu.models.electra import build as electra_build
+    from ethereum_consensus_tpu.models.electra import helpers as eh
+    from ethereum_consensus_tpu.models.electra.block_processing import (
+        get_expected_withdrawals,
+    )
+
+    ns = electra_build(context.preset)
+    epoch = state.slot // context.SLOTS_PER_EPOCH
+    withdrawals, _ = get_expected_withdrawals(state, context)
+    return ns.ExecutionPayload(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(state, epoch),
+        block_number=block_number,
+        timestamp=eh.compute_timestamp_at_slot(state, state.slot, context),
+        block_hash=bls.hash(b"exec-block-electra-%d" % int(state.slot)),
+        withdrawals=withdrawals,
+        deposit_receipts=list(deposit_receipts),
+        withdrawal_requests=list(withdrawal_requests),
+    )
+
+
+def make_attestation_electra(state, slot: int, context, participation=1.0):
+    """One committee-spanning electra attestation covering ALL committees of
+    ``slot`` (EIP-7549)."""
+    from ethereum_consensus_tpu.models.electra import build as electra_build
+
+    ns = electra_build(context.preset)
+    epoch = slot // context.SLOTS_PER_EPOCH
+    committee_count = h.get_committee_count_per_slot(state, epoch, context)
+    committees = [
+        h.get_beacon_committee(state, slot, index, context)
+        for index in range(committee_count)
+    ]
+    if epoch == h.get_current_epoch(state, context):
+        source = state.current_justified_checkpoint.copy()
+    else:
+        source = state.previous_justified_checkpoint.copy()
+    start_slot = h.compute_start_slot_at_epoch(epoch, context)
+    data = ns.AttestationData(
+        slot=slot,
+        index=0,
+        beacon_block_root=_block_root_at_or_latest(state, slot),
+        source=source,
+        target=ns.Checkpoint(
+            epoch=epoch, root=_block_root_at_or_latest(state, start_slot)
+        ),
+    )
+    bits = []
+    signers = set()
+    for committee in committees:
+        n_participants = max(1, int(len(committee) * participation))
+        for i, v in enumerate(committee):
+            take = i < n_participants
+            bits.append(take)
+            if take:
+                signers.add(v)
+    committee_bits = [True] * committee_count + [False] * (
+        context.MAX_COMMITTEES_PER_SLOT - committee_count
+    )
+    domain = h.get_domain(state, DomainType.BEACON_ATTESTER, epoch, context)
+    root = compute_signing_root(ns.AttestationData, data, domain)
+    signature = bls.aggregate([secret_key(v).sign(root) for v in sorted(signers)])
+    return ns.Attestation(
+        aggregation_bits=bits,
+        data=data,
+        committee_bits=committee_bits,
+        signature=signature.to_bytes(),
+    )
+
+
+def produce_block_electra(state, slot: int, context, attestations=(),
+                          deposit_receipts=(), withdrawal_requests=(),
+                          consolidations=()):
+    from ethereum_consensus_tpu.models.electra import build as electra_build
+    from ethereum_consensus_tpu.models.electra.block_processing import process_block
+    from ethereum_consensus_tpu.models.electra.slot_processing import process_slots
+    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
+
+    ns = electra_build(context.preset)
+    if state.slot < slot:
+        process_slots(state, slot, context)
+    proposer_index = h.get_beacon_proposer_index(state, context)
+    body = ns.BeaconBlockBody(
+        randao_reveal=make_randao_reveal(state, slot, context),
+        eth1_data=state.eth1_data.copy(),
+        attestations=list(attestations),
+        sync_aggregate=make_sync_aggregate(state, context),
+        execution_payload=make_execution_payload_electra(
+            state, context, block_number=slot,
+            deposit_receipts=deposit_receipts,
+            withdrawal_requests=withdrawal_requests,
+        ),
+        consolidations=list(consolidations),
+    )
+    block = ns.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
+        body=body,
+    )
+    scratch = state.copy()
+    process_block(scratch, block, context)
+    block.state_root = type(scratch).hash_tree_root(scratch)
+
+    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
+    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    signature = secret_key(proposer_index).sign(root).to_bytes()
+    return ns.SignedBeaconBlock(message=block, signature=signature)

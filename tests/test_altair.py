@@ -1,0 +1,185 @@
+"""altair fork tests: flags, sync committees, chain-to-finality with sync
+aggregates, phase0→altair upgrade (translate_participation).
+
+Mirrors the reference's altair coverage: sanity/finality runner shapes plus
+fork-upgrade vectors (spec-tests/runners/{finality,fork}.rs) at toy scale.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    fresh_genesis,
+    fresh_genesis_altair,
+    make_attestation,
+    make_sync_aggregate,
+    produce_block,
+    produce_block_altair,
+)
+
+from ethereum_consensus_tpu.error import InvalidSyncAggregate  # noqa: E402
+from ethereum_consensus_tpu.models.altair import (  # noqa: E402
+    build,
+    helpers as ah,
+    upgrade_to_altair,
+)
+from ethereum_consensus_tpu.models.altair.block_processing import (  # noqa: E402
+    process_sync_aggregate,
+)
+from ethereum_consensus_tpu.models.altair.epoch_processing import (  # noqa: E402
+    process_sync_committee_updates,
+)
+from ethereum_consensus_tpu.models.altair.state_transition import (  # noqa: E402
+    Validation,
+    state_transition_block_in_slot,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+
+
+def test_flags_roundtrip():
+    flags = 0
+    flags = ah.add_flag(flags, 0)
+    assert ah.has_flag(flags, 0) and not ah.has_flag(flags, 1)
+    flags = ah.add_flag(flags, 2)
+    assert flags == 0b101
+    assert ah.has_flag(flags, 2) and not ah.has_flag(flags, 1)
+
+
+def test_altair_genesis_has_sync_committees():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    assert len(state.current_sync_committee.public_keys) == ctx.SYNC_COMMITTEE_SIZE
+    assert state.current_sync_committee == state.next_sync_committee
+    assert bytes(state.fork.current_version) == ctx.altair_fork_version
+    # committee members are real validators
+    registered = {bytes(v.public_key) for v in state.validators}
+    for pk in state.current_sync_committee.public_keys:
+        assert bytes(pk) in registered
+    assert len(state.inactivity_scores) == 16
+    assert list(state.current_epoch_participation) == [0] * 16
+
+
+def test_sync_aggregate_rejects_bad_signature():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    block = produce_block_altair(state, 1, ctx)
+    aggregate = block.message.body.sync_aggregate.copy()
+    sig = bytearray(bytes(aggregate.sync_committee_signature))
+    sig[20] ^= 0xFF
+    aggregate.sync_committee_signature = bytes(sig)
+    with pytest.raises(InvalidSyncAggregate):
+        process_sync_aggregate(state, aggregate, ctx)
+
+
+def test_sync_aggregate_rewards_participants():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    block = produce_block_altair(state, 1, ctx)  # advances state to slot 1
+    before = list(state.balances)
+    process_sync_aggregate(state, block.message.body.sync_aggregate, ctx)
+    assert sum(state.balances) > sum(before)
+
+
+def test_altair_chain_reaches_finality_with_sync_aggregates():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    genesis_total = sum(state.balances)
+
+    epochs = 4
+    pending_atts = []
+    for slot in range(1, epochs * ctx.SLOTS_PER_EPOCH + 1):
+        block = produce_block_altair(state, slot, ctx, attestations=pending_atts)
+        state_transition_block_in_slot(state, block, Validation.ENABLED, ctx)
+        pending_atts = [
+            make_attestation(state, slot, index, ctx)
+            for index in range(
+                h.get_committee_count_per_slot(
+                    state, h.get_current_epoch(state, ctx), ctx
+                )
+            )
+        ]
+
+    assert state.current_justified_checkpoint.epoch >= 3
+    assert state.finalized_checkpoint.epoch >= 2
+    assert sum(state.balances) > genesis_total
+    # participation flags were set for the previous epoch
+    assert any(f != 0 for f in state.previous_epoch_participation)
+
+
+def test_sync_committee_rotation_at_period_boundary():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    period = ctx.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    # place the state at the last epoch of a sync-committee period
+    state.slot = (period - 1) * ctx.SLOTS_PER_EPOCH
+    old_next = state.next_sync_committee.copy()
+    process_sync_committee_updates(state, ctx)
+    assert state.current_sync_committee == old_next
+    assert len(state.next_sync_committee.public_keys) == ctx.SYNC_COMMITTEE_SIZE
+    # off-boundary: no rotation
+    state.slot += ctx.SLOTS_PER_EPOCH
+    current = state.current_sync_committee.copy()
+    process_sync_committee_updates(state, ctx)
+    assert state.current_sync_committee == current
+
+
+def test_upgrade_to_altair_translates_participation():
+    state, ctx = fresh_genesis(16, "minimal")
+    state = state.copy()
+
+    # run one full phase0 epoch with attestations so pending attestations
+    # carry over into previous_epoch_attestations
+    pending_atts = []
+    for slot in range(1, 2 * ctx.SLOTS_PER_EPOCH + 1):
+        block = produce_block(state, slot, ctx, attestations=pending_atts)
+        from ethereum_consensus_tpu.models.phase0.state_transition import (
+            Validation as P0Validation,
+            state_transition_block_in_slot as p0_transition,
+        )
+
+        p0_transition(state, block, P0Validation.ENABLED, ctx)
+        pending_atts = [
+            make_attestation(state, slot, index, ctx)
+            for index in range(
+                h.get_committee_count_per_slot(
+                    state, h.get_current_epoch(state, ctx), ctx
+                )
+            )
+        ]
+
+    pre_root_fields = (
+        state.genesis_validators_root,
+        state.eth1_deposit_index,
+        len(state.validators),
+    )
+    post = upgrade_to_altair(state, ctx)
+
+    assert bytes(post.fork.current_version) == ctx.altair_fork_version
+    assert bytes(post.fork.previous_version) == bytes(state.fork.current_version)
+    assert post.fork.epoch == h.get_current_epoch(state, ctx)
+    assert (
+        post.genesis_validators_root,
+        post.eth1_deposit_index,
+        len(post.validators),
+    ) == pre_root_fields
+    # previous-epoch attestations were translated into participation flags
+    assert any(f != 0 for f in post.previous_epoch_participation)
+    assert list(post.current_epoch_participation) == [0] * len(post.validators)
+    assert len(post.current_sync_committee.public_keys) == ctx.SYNC_COMMITTEE_SIZE
+
+    # the upgraded state continues as a live altair chain
+    next_slot = post.slot + 1
+    block = produce_block_altair(post, next_slot, ctx)
+    state_transition_block_in_slot(post, block, Validation.ENABLED, ctx)
+    assert post.slot == next_slot
+
+
+def test_altair_state_hash_tree_root_changes_with_participation():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    a = state.copy()
+    root_before = type(a).hash_tree_root(a)
+    a.current_epoch_participation[0] = 1
+    assert type(a).hash_tree_root(a) != root_before

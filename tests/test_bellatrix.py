@@ -1,0 +1,163 @@
+"""bellatrix fork tests: merge predicates, execution-payload processing with
+the bool ExecutionEngine mock, altair→bellatrix upgrade, short post-merge
+chain.
+
+Mirrors the reference's coverage for bellatrix (operations runner's
+execution_payload handler + fork runner + sanity, spec-tests/runners/
+operations.rs:60-80) at toy scale.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    GENESIS_PAYLOAD_BLOCK_HASH,
+    fresh_genesis_altair,
+    fresh_genesis_bellatrix,
+    make_attestation,
+    make_execution_payload,
+    produce_block_bellatrix,
+)
+
+from ethereum_consensus_tpu.error import (  # noqa: E402
+    ExecutionEngineError,
+    InvalidExecutionPayload,
+)
+from ethereum_consensus_tpu.models.bellatrix import (  # noqa: E402
+    build,
+    helpers as bh,
+    upgrade_to_bellatrix,
+)
+from ethereum_consensus_tpu.models.bellatrix.block_processing import (  # noqa: E402
+    process_execution_payload,
+)
+from ethereum_consensus_tpu.models.bellatrix.state_transition import (  # noqa: E402
+    Validation,
+    state_transition_block_in_slot,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+
+
+def test_merge_transition_predicates():
+    state, ctx = fresh_genesis_bellatrix(16, "minimal")
+    ns = build(ctx.preset)
+    # post-merge genesis: non-default header
+    assert bh.is_merge_transition_complete(state)
+    body = ns.BeaconBlockBody()
+    assert bh.is_execution_enabled(state, body)
+
+    pre_merge = state.copy()
+    pre_merge.latest_execution_payload_header = ns.ExecutionPayloadHeader()
+    assert not bh.is_merge_transition_complete(pre_merge)
+    assert not bh.is_merge_transition_block(pre_merge, body)  # empty payload
+    body_with_payload = ns.BeaconBlockBody(
+        execution_payload=make_execution_payload(pre_merge, ctx)
+    )
+    assert bh.is_merge_transition_block(pre_merge, body_with_payload)
+    assert bh.is_execution_enabled(pre_merge, body_with_payload)
+
+
+def test_process_execution_payload_updates_header():
+    state, ctx = fresh_genesis_bellatrix(16, "minimal")
+    state = state.copy()
+    state.slot = 1
+    ns = build(ctx.preset)
+    payload = make_execution_payload(state, ctx, block_number=1)
+    body = ns.BeaconBlockBody(execution_payload=payload)
+    process_execution_payload(state, body, ctx)
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+    assert state.latest_execution_payload_header.block_number == 1
+    assert (
+        state.latest_execution_payload_header.transactions_root
+        == type(payload).__ssz_fields__["transactions"].hash_tree_root(
+            payload.transactions
+        )
+    )
+
+
+def test_process_execution_payload_validations():
+    state, ctx = fresh_genesis_bellatrix(16, "minimal")
+    state = state.copy()
+    state.slot = 1
+    ns = build(ctx.preset)
+
+    bad_parent = make_execution_payload(state, ctx)
+    bad_parent.parent_hash = b"\x01" * 32
+    with pytest.raises(InvalidExecutionPayload, match="parent hash"):
+        process_execution_payload(
+            state, ns.BeaconBlockBody(execution_payload=bad_parent), ctx
+        )
+
+    bad_randao = make_execution_payload(state, ctx)
+    bad_randao.prev_randao = b"\x02" * 32
+    with pytest.raises(InvalidExecutionPayload, match="randao"):
+        process_execution_payload(
+            state, ns.BeaconBlockBody(execution_payload=bad_randao), ctx
+        )
+
+    bad_time = make_execution_payload(state, ctx)
+    bad_time.timestamp += 1
+    with pytest.raises(InvalidExecutionPayload, match="timestamp"):
+        process_execution_payload(
+            state, ns.BeaconBlockBody(execution_payload=bad_time), ctx
+        )
+
+
+def test_execution_engine_mock_rejects():
+    state, ctx = fresh_genesis_bellatrix(16, "minimal")
+    state = state.copy()
+    state.slot = 1
+    ns = build(ctx.preset)
+    payload = make_execution_payload(state, ctx)
+    ctx.execution_engine = False
+    try:
+        with pytest.raises(ExecutionEngineError):
+            process_execution_payload(
+                state, ns.BeaconBlockBody(execution_payload=payload), ctx
+            )
+    finally:
+        ctx.execution_engine = True
+
+
+def test_upgrade_to_bellatrix_from_altair():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    post = upgrade_to_bellatrix(state, ctx)
+    assert bytes(post.fork.current_version) == ctx.bellatrix_fork_version
+    assert bytes(post.fork.previous_version) == bytes(state.fork.current_version)
+    assert not bh.is_merge_transition_complete(post)  # default header
+    assert post.current_sync_committee == state.current_sync_committee
+    assert len(post.validators) == len(state.validators)
+
+
+def test_bellatrix_chain_runs_two_epochs():
+    state, ctx = fresh_genesis_bellatrix(16, "minimal")
+    state = state.copy()
+    prev_hash = GENESIS_PAYLOAD_BLOCK_HASH
+
+    pending_atts = []
+    # three epochs: justification is guarded until the epoch-2 boundary
+    # (altair process_justification_and_finalization GENESIS_EPOCH+1 skip)
+    for slot in range(1, 3 * ctx.SLOTS_PER_EPOCH + 1):
+        block = produce_block_bellatrix(state, slot, ctx, attestations=pending_atts)
+        # payloads chain by block hash
+        assert bytes(block.message.body.execution_payload.parent_hash) == bytes(
+            prev_hash
+        )
+        state_transition_block_in_slot(state, block, Validation.ENABLED, ctx)
+        prev_hash = block.message.body.execution_payload.block_hash
+        pending_atts = [
+            make_attestation(state, slot, index, ctx)
+            for index in range(
+                h.get_committee_count_per_slot(
+                    state, h.get_current_epoch(state, ctx), ctx
+                )
+            )
+        ]
+
+    assert state.latest_execution_payload_header.block_hash == prev_hash
+    assert state.current_justified_checkpoint.epoch >= 1

@@ -1,0 +1,203 @@
+"""deneb fork tests: blob commitments in payload processing, versioned
+hashes, blob-sidecar inclusion proofs, EIP-7044 exits, EIP-7045
+attestations, capella→deneb upgrade, short deneb chain with blobs.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    fresh_genesis_capella,
+    fresh_genesis_deneb,
+    make_attestation,
+    make_execution_payload_deneb,
+    produce_block_deneb,
+    secret_key,
+)
+
+from ethereum_consensus_tpu.crypto import bls, kzg  # noqa: E402
+from ethereum_consensus_tpu.domains import DomainType  # noqa: E402
+from ethereum_consensus_tpu.error import (  # noqa: E402
+    InvalidBlobData,
+    InvalidVoluntaryExit,
+)
+from ethereum_consensus_tpu.models.deneb import (  # noqa: E402
+    build,
+    helpers as dh,
+    upgrade_to_deneb,
+)
+from ethereum_consensus_tpu.models.deneb.blob_sidecar import (  # noqa: E402
+    get_subtree_index,
+    verify_blob_sidecar_inclusion_proof,
+)
+from ethereum_consensus_tpu.models.deneb.block_processing import (  # noqa: E402
+    process_execution_payload,
+    process_voluntary_exit,
+)
+from ethereum_consensus_tpu.models.deneb.state_transition import (  # noqa: E402
+    Validation,
+    state_transition_block_in_slot,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.containers import (  # noqa: E402
+    VoluntaryExit,
+)
+from ethereum_consensus_tpu.signing import compute_signing_root  # noqa: E402
+from ethereum_consensus_tpu.ssz import (  # noqa: E402
+    get_generalized_index,
+    prove,
+)
+
+
+def test_versioned_hash():
+    commitment = b"\xc5" * 48
+    vh = dh.kzg_commitment_to_versioned_hash(commitment)
+    assert vh[:1] == b"\x01"
+    assert vh[1:] == bls.hash(commitment)[1:]
+    assert len(vh) == 32
+
+
+def test_blob_commitment_limit_enforced():
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    state.slot = 1
+    ns = build(ctx.preset)
+    body = ns.BeaconBlockBody(
+        execution_payload=make_execution_payload_deneb(state, ctx),
+        blob_kzg_commitments=[b"\xc5" * 48] * (ctx.MAX_BLOBS_PER_BLOCK + 1),
+    )
+    with pytest.raises(InvalidBlobData):
+        process_execution_payload(state, body, ctx)
+
+
+def test_process_execution_payload_with_blobs():
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    state.slot = 1
+    ns = build(ctx.preset)
+    payload = make_execution_payload_deneb(state, ctx)
+    body = ns.BeaconBlockBody(
+        execution_payload=payload,
+        blob_kzg_commitments=[b"\xc5" * 48, b"\xc6" * 48],
+    )
+    process_execution_payload(state, body, ctx)
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+def test_deneb_exit_domain_pinned_to_capella(monkeypatch):
+    """EIP-7044: exits sign over the capella fork version even when the
+    state fork has moved on."""
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    # make validator 5 old enough to exit
+    state.slot = (ctx.shard_committee_period + 1) * ctx.SLOTS_PER_EPOCH
+    exit_msg = VoluntaryExit(epoch=1, validator_index=5)
+
+    capella_domain = dh.compute_domain(
+        DomainType.VOLUNTARY_EXIT,
+        ctx.capella_fork_version,
+        bytes(state.genesis_validators_root),
+        ctx,
+    )
+    root = compute_signing_root(VoluntaryExit, exit_msg, capella_domain)
+    ns = build(ctx.preset)
+    signed = ns.SignedVoluntaryExit(
+        message=exit_msg, signature=secret_key(5).sign(root).to_bytes()
+    )
+    process_voluntary_exit(state, signed, ctx)
+    assert state.validators[5].exit_epoch != 2**64 - 1
+
+    # a deneb-domain signature must NOT verify
+    state2, _ = fresh_genesis_deneb(16, "minimal")
+    state2 = state2.copy()
+    state2.slot = state.slot
+    deneb_domain = dh.compute_domain(
+        DomainType.VOLUNTARY_EXIT,
+        ctx.deneb_fork_version,
+        bytes(state2.genesis_validators_root),
+        ctx,
+    )
+    root2 = compute_signing_root(VoluntaryExit, exit_msg, deneb_domain)
+    signed2 = ns.SignedVoluntaryExit(
+        message=exit_msg, signature=secret_key(5).sign(root2).to_bytes()
+    )
+    with pytest.raises(InvalidVoluntaryExit):
+        process_voluntary_exit(state2, signed2, ctx)
+
+
+def test_blob_sidecar_inclusion_proof_roundtrip():
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    ns = build(ctx.preset)
+    commitments = [b"\xc5" * 48, b"\xc6" * 48]
+    block = produce_block_deneb(state, 1, ctx, blob_kzg_commitments=commitments)
+    body = block.message.body
+    header = ns.BeaconBlockHeader(
+        slot=block.message.slot,
+        proposer_index=block.message.proposer_index,
+        parent_root=block.message.parent_root,
+        state_root=block.message.state_root,
+        body_root=type(body).hash_tree_root(body),
+    )
+    signed_header = ns.SignedBeaconBlockHeader(
+        message=header, signature=block.signature
+    )
+    for index in range(2):
+        g_index = get_generalized_index(
+            type(body), "blob_kzg_commitments", index
+        )
+        proof = prove(type(body), body, g_index)
+        assert len(proof) == ctx.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+        sidecar = ns.BlobSidecar(
+            index=index,
+            kzg_commitment=commitments[index],
+            signed_block_header=signed_header,
+            kzg_commitment_inclusion_proof=proof,
+        )
+        assert verify_blob_sidecar_inclusion_proof(sidecar, type(body), ctx)
+        bad = sidecar.copy()
+        bad.kzg_commitment = b"\xff" * 48
+        assert not verify_blob_sidecar_inclusion_proof(bad, type(body), ctx)
+
+
+def test_upgrade_to_deneb_from_capella():
+    state, ctx = fresh_genesis_capella(16, "minimal")
+    state = state.copy()
+    state.next_withdrawal_index = 5
+    post = upgrade_to_deneb(state, ctx)
+    assert bytes(post.fork.current_version) == ctx.deneb_fork_version
+    assert post.latest_execution_payload_header.blob_gas_used == 0
+    assert post.latest_execution_payload_header.excess_blob_gas == 0
+    assert post.next_withdrawal_index == 5
+    assert (
+        post.latest_execution_payload_header.block_hash
+        == state.latest_execution_payload_header.block_hash
+    )
+
+
+def test_deneb_chain_runs_one_epoch_with_blobs():
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    pending_atts = []
+    for slot in range(1, ctx.SLOTS_PER_EPOCH + 1):
+        commitments = [bls.hash(b"blob-%d" % slot).ljust(48, b"\x00")]
+        block = produce_block_deneb(
+            state, slot, ctx,
+            attestations=pending_atts,
+            blob_kzg_commitments=commitments,
+        )
+        state_transition_block_in_slot(state, block, Validation.ENABLED, ctx)
+        pending_atts = [
+            make_attestation(state, slot, index, ctx)
+            for index in range(
+                h.get_committee_count_per_slot(
+                    state, h.get_current_epoch(state, ctx), ctx
+                )
+            )
+        ]
+    assert state.slot == ctx.SLOTS_PER_EPOCH
+    assert state.latest_execution_payload_header.block_number == ctx.SLOTS_PER_EPOCH

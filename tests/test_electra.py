@@ -1,0 +1,268 @@
+"""electra fork tests: EIP-7251 consolidations/compounding/balance churn,
+EIP-6110 deposit receipts, EIP-7002 withdrawal requests, EIP-7549
+committee-spanning attestations, deneb→electra upgrade, electra chain.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    fresh_genesis_deneb,
+    fresh_genesis_electra,
+    make_attestation_electra,
+    produce_block_electra,
+    public_key_bytes,
+    secret_key,
+    withdrawal_credentials,
+)
+
+from ethereum_consensus_tpu.crypto import bls  # noqa: E402
+from ethereum_consensus_tpu.domains import DomainType  # noqa: E402
+from ethereum_consensus_tpu.error import InvalidConsolidation  # noqa: E402
+from ethereum_consensus_tpu.models.electra import (  # noqa: E402
+    build,
+    helpers as eh,
+    upgrade_to_electra,
+)
+from ethereum_consensus_tpu.models.electra.block_processing import (  # noqa: E402
+    FULL_EXIT_REQUEST_AMOUNT,
+    process_attestation,
+    process_consolidation,
+    process_deposit_receipt,
+    process_execution_layer_withdrawal_request,
+)
+from ethereum_consensus_tpu.models.electra.containers import (  # noqa: E402
+    Consolidation,
+    DepositReceipt,
+    ExecutionLayerWithdrawalRequest,
+)
+from ethereum_consensus_tpu.models.electra.epoch_processing import (  # noqa: E402
+    process_pending_balance_deposits,
+    process_pending_consolidations,
+)
+from ethereum_consensus_tpu.models.electra.state_transition import (  # noqa: E402
+    Validation,
+    state_transition_block_in_slot,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.containers import (  # noqa: E402
+    DepositMessage,
+)
+from ethereum_consensus_tpu.primitives import (  # noqa: E402
+    COMPOUNDING_WITHDRAWAL_PREFIX,
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX,
+    FAR_FUTURE_EPOCH,
+    UNSET_DEPOSIT_RECEIPTS_START_INDEX,
+)
+from ethereum_consensus_tpu.signing import compute_signing_root  # noqa: E402
+
+
+def _eth1_credentials(address: bytes) -> bytes:
+    return ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+
+
+def _compounding_credentials(address: bytes) -> bytes:
+    return COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+
+
+def test_electra_genesis_is_live():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    assert state.deposit_receipts_start_index == UNSET_DEPOSIT_RECEIPTS_START_INDEX
+    assert len(state.pending_balance_deposits) == 0
+    # all bootstrap validators active at genesis with min activation balance
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert all(
+        v.effective_balance == ctx.MIN_ACTIVATION_BALANCE for v in state.validators
+    )
+    assert len(state.current_sync_committee.public_keys) == ctx.SYNC_COMMITTEE_SIZE
+
+
+def test_compounding_credential_helpers():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    v = state.validators[0]
+    assert not eh.has_compounding_withdrawal_credential(v)
+    v.withdrawal_credentials = _compounding_credentials(b"\x11" * 20)
+    assert eh.has_compounding_withdrawal_credential(v)
+    assert eh.has_execution_withdrawal_credential(v)
+    assert (
+        eh.get_validator_max_effective_balance(v, ctx)
+        == ctx.MAX_EFFECTIVE_BALANCE_ELECTRA
+    )
+
+
+def test_switch_to_compounding_queues_excess():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    state.validators[2].withdrawal_credentials = _eth1_credentials(b"\x22" * 20)
+    state.balances[2] = ctx.MIN_ACTIVATION_BALANCE + 7_000_000_000
+    eh.switch_to_compounding_validator(state, 2, ctx)
+    assert eh.has_compounding_withdrawal_credential(state.validators[2])
+    assert state.balances[2] == ctx.MIN_ACTIVATION_BALANCE
+    assert len(state.pending_balance_deposits) == 1
+    assert state.pending_balance_deposits[0].amount == 7_000_000_000
+
+    # settle the queue
+    process_pending_balance_deposits(state, ctx)
+    assert state.balances[2] == ctx.MIN_ACTIVATION_BALANCE + 7_000_000_000
+    assert len(state.pending_balance_deposits) == 0
+
+
+def test_deposit_receipt_tops_up_existing_validator():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    message = DepositMessage(
+        public_key=public_key_bytes(3),
+        withdrawal_credentials=withdrawal_credentials(3),
+        amount=5_000_000_000,
+    )
+    domain = eh.compute_domain(DomainType.DEPOSIT, None, None, ctx)
+    root = compute_signing_root(DepositMessage, message, domain)
+    receipt = DepositReceipt(
+        public_key=message.public_key,
+        withdrawal_credentials=message.withdrawal_credentials,
+        amount=message.amount,
+        signature=secret_key(3).sign(root).to_bytes(),
+        index=0,
+    )
+    process_deposit_receipt(state, receipt, ctx)
+    assert state.deposit_receipts_start_index == 0
+    assert len(state.pending_balance_deposits) == 1
+    assert state.pending_balance_deposits[0].index == 3
+
+
+def test_full_exit_withdrawal_request():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    addr = b"\x33" * 20
+    # old enough to exit
+    state.slot = (ctx.shard_committee_period + 1) * ctx.SLOTS_PER_EPOCH
+    state.validators[4].withdrawal_credentials = _eth1_credentials(addr)
+    request = ExecutionLayerWithdrawalRequest(
+        source_address=addr,
+        validator_public_key=public_key_bytes(4),
+        amount=FULL_EXIT_REQUEST_AMOUNT,
+    )
+    process_execution_layer_withdrawal_request(state, request, ctx)
+    assert state.validators[4].exit_epoch != FAR_FUTURE_EPOCH
+
+    # wrong source address is a silent no-op
+    request2 = ExecutionLayerWithdrawalRequest(
+        source_address=b"\x44" * 20,
+        validator_public_key=public_key_bytes(5),
+        amount=FULL_EXIT_REQUEST_AMOUNT,
+    )
+    state.validators[5].withdrawal_credentials = _eth1_credentials(addr)
+    process_execution_layer_withdrawal_request(state, request2, ctx)
+    assert state.validators[5].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def test_partial_withdrawal_request_compounding():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    addr = b"\x55" * 20
+    state.slot = (ctx.shard_committee_period + 1) * ctx.SLOTS_PER_EPOCH
+    state.validators[6].withdrawal_credentials = _compounding_credentials(addr)
+    state.balances[6] = ctx.MIN_ACTIVATION_BALANCE + 9_000_000_000
+    request = ExecutionLayerWithdrawalRequest(
+        source_address=addr,
+        validator_public_key=public_key_bytes(6),
+        amount=4_000_000_000,
+    )
+    process_execution_layer_withdrawal_request(state, request, ctx)
+    assert len(state.pending_partial_withdrawals) == 1
+    w = state.pending_partial_withdrawals[0]
+    assert w.index == 6 and w.amount == 4_000_000_000
+    assert state.validators[6].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def _signed_consolidation(state, ctx, source, target, epoch=0):
+    consolidation = Consolidation(
+        source_index=source, target_index=target, epoch=epoch
+    )
+    domain = eh.compute_domain(
+        DomainType.CONSOLIDATION, None, bytes(state.genesis_validators_root), ctx
+    )
+    root = compute_signing_root(Consolidation, consolidation, domain)
+    sig = bls.aggregate([secret_key(source).sign(root), secret_key(target).sign(root)])
+    ns = build(ctx.preset)
+    return ns.SignedConsolidation(message=consolidation, signature=sig.to_bytes())
+
+
+def test_consolidation_lifecycle():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    addr = b"\x66" * 20
+    for i in (7, 8):
+        state.validators[i].withdrawal_credentials = _eth1_credentials(addr)
+
+    # churn limit too small on a 16-validator toy chain → inflate balances
+    for i in range(len(state.validators)):
+        state.validators[i].effective_balance = ctx.MIN_ACTIVATION_BALANCE * 100
+
+    signed = _signed_consolidation(state, ctx, 7, 8)
+    process_consolidation(state, signed, ctx)
+    assert state.validators[7].exit_epoch != FAR_FUTURE_EPOCH
+    assert len(state.pending_consolidations) == 1
+
+    # once the source is withdrawable, the pending consolidation settles
+    state.slot = (state.validators[7].withdrawable_epoch) * ctx.SLOTS_PER_EPOCH
+    balance_before_target = state.balances[8]
+    process_pending_consolidations(state, ctx)
+    assert len(state.pending_consolidations) == 0
+    assert state.balances[8] > balance_before_target
+    assert eh.has_compounding_withdrawal_credential(state.validators[8])
+
+
+def test_consolidation_rejects_same_index():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    for i in range(len(state.validators)):
+        state.validators[i].effective_balance = ctx.MIN_ACTIVATION_BALANCE * 100
+    signed = _signed_consolidation(state, ctx, 9, 9)
+    with pytest.raises(InvalidConsolidation):
+        process_consolidation(state, signed, ctx)
+
+
+def test_electra_attestation_committee_bits():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    block = produce_block_electra(state, 1, ctx)  # advances to slot 1
+    state2 = state.copy()
+    state2.slot = 2
+    att = make_attestation_electra(state, 1, ctx)
+    assert att.data.index == 0
+    assert sum(att.committee_bits) >= 1
+    process_attestation(state2, att, ctx)
+    assert any(f != 0 for f in state2.current_epoch_participation)
+
+
+def test_upgrade_to_electra_from_deneb():
+    state, ctx = fresh_genesis_deneb(16, "minimal")
+    state = state.copy()
+    post = upgrade_to_electra(state, ctx)
+    assert bytes(post.fork.current_version) == ctx.electra_fork_version
+    assert post.deposit_receipts_start_index == UNSET_DEPOSIT_RECEIPTS_START_INDEX
+    assert post.earliest_exit_epoch >= 1
+    assert post.exit_balance_to_consume > 0
+    assert post.latest_execution_payload_header.deposit_receipts_root == b"\x00" * 32
+    # active validators keep their balances (none pre-activation here)
+    assert list(post.balances) == list(state.balances)
+
+
+def test_electra_chain_runs_one_epoch():
+    state, ctx = fresh_genesis_electra(16, "minimal")
+    state = state.copy()
+    pending_atts = []
+    for slot in range(1, ctx.SLOTS_PER_EPOCH + 1):
+        block = produce_block_electra(state, slot, ctx, attestations=pending_atts)
+        state_transition_block_in_slot(state, block, Validation.ENABLED, ctx)
+        pending_atts = [make_attestation_electra(state, slot, ctx)]
+    assert state.slot == ctx.SLOTS_PER_EPOCH
+    assert any(f != 0 for f in state.previous_epoch_participation) or any(
+        f != 0 for f in state.current_epoch_participation
+    )

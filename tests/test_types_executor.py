@@ -1,0 +1,156 @@
+"""Polymorphic types layer + Executor tests: fork-ordered deserialization,
+field accessor delegation, cross-fork block application with the inline
+upgrade chain (the reference's transition-runner shape,
+spec-tests/runners/transition.rs:90-120, at toy scale).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import (  # noqa: E402
+    fresh_genesis,
+    make_attestation,
+    produce_block,
+    produce_block_altair,
+)
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.error import (  # noqa: E402
+    IncompatibleForksError,
+    UnknownForkError,
+)
+from ethereum_consensus_tpu.executor import Executor, Validation  # noqa: E402
+from ethereum_consensus_tpu.fork import Fork  # noqa: E402
+from ethereum_consensus_tpu.models import altair, deneb, phase0  # noqa: E402
+from ethereum_consensus_tpu.models.altair.fork import upgrade_to_altair  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.slot_processing import (  # noqa: E402
+    process_slots,
+)
+from ethereum_consensus_tpu.types import (  # noqa: E402
+    BeaconState,
+    ExecutionPayload,
+    SignedBeaconBlock,
+)
+
+
+def test_wrap_detects_fork():
+    ctx = Context.for_minimal()
+    p0 = phase0.build(ctx.preset).BeaconState()
+    al = altair.build(ctx.preset).BeaconState()
+    assert BeaconState.wrap(p0, ctx.preset).version() == Fork.PHASE0
+    assert BeaconState.wrap(al, ctx.preset).version() == Fork.ALTAIR
+    with pytest.raises(UnknownForkError):
+        BeaconState.wrap(object(), ctx.preset)
+
+
+def test_accessors_delegate_across_forks():
+    ctx = Context.for_minimal()
+    dn = deneb.build(ctx.preset).BeaconState()
+    wrapped = BeaconState.wrap(dn, ctx.preset)
+    assert wrapped.slot == 0
+    assert wrapped.next_withdrawal_index == 0  # capella+ field
+    wrapped.slot = 9
+    assert dn.slot == 9
+    # phase0 has no withdrawal cursor — AttributeError like the generated
+    # accessors returning None→error
+    p0 = BeaconState.wrap(phase0.build(ctx.preset).BeaconState(), ctx.preset)
+    with pytest.raises(AttributeError):
+        _ = p0.next_withdrawal_index
+
+
+def test_deserialize_newest_fork_wins():
+    ctx = Context.for_minimal()
+    # a deneb state must come back as deneb, not as an older fork
+    dn = deneb.build(ctx.preset).BeaconState()
+    raw = deneb.build(ctx.preset).BeaconState.serialize(dn)
+    wrapped = BeaconState.deserialize(raw, ctx.preset)
+    assert wrapped.version() == Fork.DENEB
+    assert wrapped.serialize() == raw
+    # a phase0 state deserializes to phase0 (no newer variant matches)
+    p0 = phase0.build(ctx.preset).BeaconState()
+    raw0 = phase0.build(ctx.preset).BeaconState.serialize(p0)
+    assert BeaconState.deserialize(raw0, ctx.preset).version() == Fork.PHASE0
+
+
+def test_execution_payload_forks_start_at_bellatrix():
+    ctx = Context.for_minimal()
+    with pytest.raises(UnknownForkError):
+        ExecutionPayload.container_type(Fork.PHASE0, ctx.preset)
+    assert ExecutionPayload.container_type(Fork.BELLATRIX, ctx.preset) is not None
+
+
+def test_executor_rejects_older_block_fork():
+    state, ctx = fresh_genesis(16, "minimal")
+    # altair state + phase0 block → error
+    al_state = altair.build(ctx.preset).BeaconState(
+        genesis_time=1, validators=[], balances=[]
+    )
+    executor = Executor(BeaconState.from_fork(Fork.ALTAIR, al_state), ctx)
+    block = phase0.build(ctx.preset).SignedBeaconBlock()
+    with pytest.raises(IncompatibleForksError):
+        executor.apply_block(block)
+
+
+def test_executor_applies_phase0_chain():
+    state, ctx = fresh_genesis(16, "minimal")
+    executor = Executor(state.copy(), ctx)
+    scratch = state.copy()
+    from ethereum_consensus_tpu.models.phase0.state_transition import (
+        Validation as P0Validation,
+        state_transition_block_in_slot as p0_transition,
+    )
+
+    for slot in (1, 2):
+        block = produce_block(scratch, slot, ctx)
+        executor.apply_block(block)
+        p0_transition(scratch, block, P0Validation.ENABLED, ctx)
+    assert executor.state.version() == Fork.PHASE0
+    assert executor.state.slot == 2
+
+
+def test_executor_upgrades_across_altair_boundary():
+    """Cross-fork apply: phase0 chain through epoch 0, then an altair block
+    exactly on the upgrade slot (executor.rs:215-224 corner)."""
+    state, base_ctx = fresh_genesis(16, "minimal")
+    ctx = Context.for_minimal()
+    ctx.altair_fork_epoch = 1
+
+    executor = Executor(state.copy(), ctx)
+    scratch = state.copy()
+    pending_atts = []
+    from ethereum_consensus_tpu.models.phase0.state_transition import (
+        Validation as P0Validation,
+        state_transition_block_in_slot as p0_transition,
+    )
+
+    for slot in range(1, ctx.SLOTS_PER_EPOCH):
+        block = produce_block(scratch, slot, ctx, attestations=pending_atts)
+        executor.apply_block(block)
+        p0_transition(scratch, block, P0Validation.ENABLED, ctx)
+        pending_atts = [
+            make_attestation(scratch, slot, index, ctx)
+            for index in range(1)
+        ]
+    assert executor.state.version() == Fork.PHASE0
+
+    # build the altair block against a scratch upgraded the same way
+    fork_slot = ctx.SLOTS_PER_EPOCH
+    process_slots(scratch, fork_slot, ctx)
+    upgraded = upgrade_to_altair(scratch, ctx)
+    altair_block = produce_block_altair(upgraded, fork_slot, ctx)
+
+    executor.apply_block(altair_block)
+    assert executor.state.version() == Fork.ALTAIR
+    assert executor.state.slot == fork_slot
+    assert bytes(executor.state.fork.current_version) == ctx.altair_fork_version
+    # the two independently-derived states agree bit-for-bit
+    from ethereum_consensus_tpu.models.altair.state_transition import (
+        state_transition_block_in_slot,
+    )
+
+    state_transition_block_in_slot(upgraded, altair_block, Validation.ENABLED, ctx)
+    assert executor.state.hash_tree_root() == type(upgraded).hash_tree_root(upgraded)
