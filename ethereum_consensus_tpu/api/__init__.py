@@ -1,0 +1,31 @@
+"""Beacon-API client (C27-C31).
+
+Reference parity: beacon-api-client crate (1,804 LoC).
+"""
+
+from .client import CONSENSUS_VERSION_HEADER, Client  # noqa: F401
+from .errors import ApiError, IndexedError  # noqa: F401
+from .types import (  # noqa: F401
+    AttestationDuty,
+    BalanceSummary,
+    BeaconHeaderSummary,
+    BlockId,
+    BroadcastValidation,
+    CommitteeFilter,
+    CommitteeSummary,
+    CoordinateWithMetadata,
+    FinalityCheckpoints,
+    GenesisDetails,
+    HealthStatus,
+    NetworkIdentity,
+    PeerSummary,
+    ProposerDuty,
+    StateId,
+    SyncCommitteeDuty,
+    SyncCommitteeSummary,
+    SyncStatus,
+    ValidatorStatus,
+    ValidatorSummary,
+    Value,
+    VersionedValue,
+)

@@ -1,0 +1,53 @@
+"""Small beacon-api CLI.
+
+Reference parity: beacon-api-client/src/{main.rs,cli/} — ``beacon genesis``
+and ``beacon root`` subcommands against a given endpoint
+(cli/mod.rs:7-17). Run as ``python -m ethereum_consensus_tpu.api ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from .client import Client
+from .types import StateId
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="beacon-api-client", description="query a beacon node"
+    )
+    parser.add_argument("--endpoint", required=True, help="beacon node URL")
+    sub = parser.add_subparsers(dest="namespace", required=True)
+
+    beacon = sub.add_parser("beacon")
+    bsub = beacon.add_subparsers(dest="command", required=True)
+    bsub.add_parser("genesis", help="fetch genesis details")
+    root = bsub.add_parser("root", help="fetch a state root")
+    root.add_argument("state_id", nargs="?", default="head")
+
+    args = parser.parse_args(argv)
+    client = Client(args.endpoint)
+    if args.command == "genesis":
+        details = client.get_genesis_details()
+        print(
+            json.dumps(
+                {
+                    "genesis_time": str(details.genesis_time),
+                    "genesis_validators_root": "0x"
+                    + details.genesis_validators_root.hex(),
+                    "genesis_fork_version": "0x"
+                    + details.genesis_fork_version.hex(),
+                }
+            )
+        )
+    elif args.command == "root":
+        print("0x" + client.get_state_root(StateId(args.state_id)).hex())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

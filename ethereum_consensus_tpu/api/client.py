@@ -1,0 +1,568 @@
+"""Beacon-API HTTP client.
+
+Reference parity: beacon-api-client/src/api_client.rs (877 LoC) — the ~70
+standard Beacon-API endpoints: beacon state/blocks/headers/pool operations,
+validator duties (get_attester_duties:683, get_proposer_duties:700), block
+production (get_block_proposal:726), light-client (:428-466), blobs
+(get_blob_sidecars:395), node/debug/events (get_events:610 via SSE),
+post_signed_beacon_block_v2:355 with the Eth-Consensus-Version header
+(lib.rs:14). Synchronous `requests` transport (the reference uses async
+reqwest; the endpoint surface and semantics match 1:1).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator
+
+from ..serde import from_hex
+from .errors import ApiError
+from .types import (
+    AttestationDuty,
+    BalanceSummary,
+    BeaconHeaderSummary,
+    BlockId,
+    BroadcastValidation,
+    CommitteeFilter,
+    CommitteeSummary,
+    CoordinateWithMetadata,
+    FinalityCheckpoints,
+    GenesisDetails,
+    HealthStatus,
+    NetworkIdentity,
+    PeerSummary,
+    ProposerDuty,
+    StateId,
+    SyncCommitteeDuty,
+    SyncCommitteeSummary,
+    SyncStatus,
+    ValidatorStatus,
+    ValidatorSummary,
+    VersionedValue,
+)
+
+__all__ = ["Client", "CONSENSUS_VERSION_HEADER"]
+
+CONSENSUS_VERSION_HEADER = "Eth-Consensus-Version"  # (lib.rs:14)
+
+
+class Client:
+    """(api_client.rs:78) — a client bound to an endpoint; pass ``context``
+    to enable SSZ-typed block/state decoding helpers."""
+
+    def __init__(self, endpoint: str, context=None, session=None):
+        import requests
+
+        self.endpoint = endpoint.rstrip("/")
+        self.context = context
+        self.session = session or requests.Session()
+
+    # -- transport (api_client.rs:94-130) ------------------------------------
+    def _url(self, path: str) -> str:
+        return f"{self.endpoint}/{path.lstrip('/')}"
+
+    def _raise_for_api_error(self, response) -> None:
+        if response.status_code >= 400:
+            try:
+                error = ApiError.from_json(response.json())
+            except Exception:  # non-JSON / non-envelope error body
+                raise ApiError(response.status_code, response.text) from None
+            raise error
+
+    def http_get(self, path: str, params=None, headers=None):
+        response = self.session.get(self._url(path), params=params, headers=headers)
+        self._raise_for_api_error(response)
+        return response
+
+    def get(self, path: str, params=None):
+        """GET returning the ``data`` payload (api_client.rs:94)."""
+        return self.http_get(path, params=params).json()["data"]
+
+    def get_enveloped(self, path: str, params=None) -> VersionedValue:
+        """GET returning the full fork-versioned envelope."""
+        body = self.http_get(path, params=params).json()
+        meta = {k: v for k, v in body.items() if k not in ("version", "data")}
+        return VersionedValue(
+            version=body.get("version", ""), data=body["data"], meta=meta
+        )
+
+    def http_post(self, path: str, payload=None, headers=None):
+        response = self.session.post(self._url(path), json=payload, headers=headers)
+        self._raise_for_api_error(response)
+        return response
+
+    def post(self, path: str, payload=None, headers=None) -> None:
+        """POST expecting an empty-ok response (api_client.rs:111)."""
+        self.http_post(path, payload, headers=headers)
+
+    # -- beacon namespace ----------------------------------------------------
+    def get_genesis_details(self) -> GenesisDetails:
+        """(api_client.rs:131)"""
+        return GenesisDetails.from_json(self.get("eth/v1/beacon/genesis"))
+
+    def get_state_root(self, state_id: StateId | str) -> bytes:
+        return from_hex(self.get(f"eth/v1/beacon/states/{StateId(state_id)}/root")["root"], 32)
+
+    def get_fork(self, state_id: StateId | str) -> dict:
+        return self.get(f"eth/v1/beacon/states/{StateId(state_id)}/fork")
+
+    def get_finality_checkpoints(self, state_id: StateId | str) -> FinalityCheckpoints:
+        return FinalityCheckpoints.from_json(
+            self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/finality_checkpoints"
+            )
+        )
+
+    def get_validators(
+        self,
+        state_id: StateId | str,
+        indices=(),
+        statuses: tuple[ValidatorStatus, ...] = (),
+    ) -> list[ValidatorSummary]:
+        """(api_client.rs:157)"""
+        params = {}
+        if indices:
+            params["id"] = ",".join(str(i) for i in indices)
+        if statuses:
+            params["status"] = ",".join(s.value for s in statuses)
+        return [
+            ValidatorSummary.from_json(v)
+            for v in self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/validators", params
+            )
+        ]
+
+    def get_validator(self, state_id: StateId | str, validator_id) -> ValidatorSummary:
+        """(api_client.rs:183)"""
+        return ValidatorSummary.from_json(
+            self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/validators/{validator_id}"
+            )
+        )
+
+    def get_balances(self, state_id: StateId | str, indices=()) -> list[BalanceSummary]:
+        params = {"id": ",".join(str(i) for i in indices)} if indices else None
+        return [
+            BalanceSummary.from_json(b)
+            for b in self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/validator_balances", params
+            )
+        ]
+
+    def get_all_committees(self, state_id: StateId | str) -> list[CommitteeSummary]:
+        """(api_client.rs:215)"""
+        return self.get_committees(state_id, CommitteeFilter())
+
+    def get_committees(
+        self, state_id: StateId | str, committee_filter: CommitteeFilter
+    ) -> list[CommitteeSummary]:
+        params = {}
+        if committee_filter.epoch is not None:
+            params["epoch"] = str(committee_filter.epoch)
+        if committee_filter.index is not None:
+            params["index"] = str(committee_filter.index)
+        if committee_filter.slot is not None:
+            params["slot"] = str(committee_filter.slot)
+        return [
+            CommitteeSummary.from_json(c)
+            for c in self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/committees", params or None
+            )
+        ]
+
+    def get_sync_committees(
+        self, state_id: StateId | str, epoch: int | None = None
+    ) -> SyncCommitteeSummary:
+        """(api_client.rs:244)"""
+        params = {"epoch": str(epoch)} if epoch is not None else None
+        return SyncCommitteeSummary.from_json(
+            self.get(
+                f"eth/v1/beacon/states/{StateId(state_id)}/sync_committees", params
+            )
+        )
+
+    def get_randao(self, state_id: StateId | str, epoch: int | None = None) -> bytes:
+        """(api_client.rs:263)"""
+        params = {"epoch": str(epoch)} if epoch is not None else None
+        return from_hex(
+            self.get(f"eth/v1/beacon/states/{StateId(state_id)}/randao", params)[
+                "randao"
+            ],
+            32,
+        )
+
+    def get_beacon_header_at_head(self) -> BeaconHeaderSummary:
+        """(api_client.rs:279)"""
+        return self.get_beacon_header(BlockId.HEAD)
+
+    def get_beacon_header_for_slot(self, slot: int) -> list[BeaconHeaderSummary]:
+        return [
+            BeaconHeaderSummary.from_json(h)
+            for h in self.get("eth/v1/beacon/headers", {"slot": str(slot)})
+        ]
+
+    def get_beacon_header_for_parent_root(
+        self, parent_root: bytes
+    ) -> list[BeaconHeaderSummary]:
+        return [
+            BeaconHeaderSummary.from_json(h)
+            for h in self.get(
+                "eth/v1/beacon/headers", {"parent_root": "0x" + parent_root.hex()}
+            )
+        ]
+
+    def get_beacon_header(self, block_id: BlockId | str) -> BeaconHeaderSummary:
+        """(api_client.rs:314)"""
+        return BeaconHeaderSummary.from_json(
+            self.get(f"eth/v1/beacon/headers/{BlockId(block_id)}")
+        )
+
+    def post_signed_beacon_block(self, block) -> None:
+        """(api_client.rs:346)"""
+        self.post("eth/v1/beacon/blocks", self._block_json(block))
+
+    def post_signed_beacon_block_v2(
+        self,
+        block,
+        version: str,
+        broadcast_validation: BroadcastValidation | None = None,
+    ) -> None:
+        """(api_client.rs:355) — sets Eth-Consensus-Version."""
+        params = ""
+        if broadcast_validation is not None:
+            params = f"?broadcast_validation={broadcast_validation.value}"
+        self.post(
+            f"eth/v2/beacon/blocks{params}",
+            self._block_json(block),
+            headers={CONSENSUS_VERSION_HEADER: version},
+        )
+
+    def post_signed_blinded_beacon_block(self, block) -> None:
+        """(api_client.rs:320)"""
+        self.post("eth/v1/beacon/blinded_blocks", self._block_json(block))
+
+    def post_signed_blinded_beacon_block_v2(
+        self,
+        block,
+        version: str,
+        broadcast_validation: BroadcastValidation | None = None,
+    ) -> None:
+        """(api_client.rs:327)"""
+        params = ""
+        if broadcast_validation is not None:
+            params = f"?broadcast_validation={broadcast_validation.value}"
+        self.post(
+            f"eth/v2/beacon/blinded_blocks{params}",
+            self._block_json(block),
+            headers={CONSENSUS_VERSION_HEADER: version},
+        )
+
+    @staticmethod
+    def _block_json(block):
+        if hasattr(block, "to_json"):
+            return block.to_json()
+        return block
+
+    def get_beacon_block(self, block_id: BlockId | str) -> VersionedValue:
+        """(api_client.rs:375) — fork-versioned signed block; decodes to the
+        polymorphic SignedBeaconBlock when a context is bound."""
+        envelope = self.get_enveloped(f"eth/v2/beacon/blocks/{BlockId(block_id)}")
+        if self.context is not None:
+            from ..types import SignedBeaconBlock
+
+            envelope.data = SignedBeaconBlock.from_json(
+                envelope.data, self.context.preset
+            )
+        return envelope
+
+    def get_beacon_block_root(self, block_id: BlockId | str) -> bytes:
+        """(api_client.rs:381)"""
+        return bytes.fromhex(
+            self.get(f"eth/v1/beacon/blocks/{BlockId(block_id)}/root")["root"], 32)
+
+    def get_attestations_from_beacon_block(self, block_id: BlockId | str) -> list:
+        return self.get(f"eth/v1/beacon/blocks/{BlockId(block_id)}/attestations")
+
+    def get_blob_sidecars(self, block_id: BlockId | str, indices=()) -> list:
+        """(api_client.rs:395)"""
+        params = (
+            {"indices": ",".join(str(i) for i in indices)} if indices else None
+        )
+        return self.get(f"eth/v1/beacon/blob_sidecars/{BlockId(block_id)}", params)
+
+    def get_deposit_snapshot(self) -> dict:
+        """(api_client.rs:414)"""
+        return self.get("eth/v1/beacon/deposit_snapshot")
+
+    def get_blinded_block(self, block_id: BlockId | str) -> VersionedValue:
+        """(api_client.rs:419)"""
+        return self.get_enveloped(
+            f"eth/v1/beacon/blinded_blocks/{BlockId(block_id)}"
+        )
+
+    # -- light client (api_client.rs:428-466) --------------------------------
+    def get_light_client_bootstrap(self, block_root: bytes) -> VersionedValue:
+        return self.get_enveloped(
+            f"eth/v1/beacon/light_client/bootstrap/0x{block_root.hex()}"
+        )
+
+    def get_light_client_updates(self, start_period: int, count: int) -> list:
+        return self.http_get(
+            "eth/v1/beacon/light_client/updates",
+            params={"start_period": str(start_period), "count": str(count)},
+        ).json()
+
+    def get_light_client_finality_update(self) -> VersionedValue:
+        return self.get_enveloped("eth/v1/beacon/light_client/finality_update")
+
+    def get_light_client_optimistic_update(self) -> VersionedValue:
+        return self.get_enveloped("eth/v1/beacon/light_client/optimistic_update")
+
+    # -- pool (api_client.rs:468-557) ----------------------------------------
+    def get_attestations_from_pool(
+        self, slot: int | None = None, committee_index: int | None = None
+    ) -> list:
+        params = {}
+        if slot is not None:
+            params["slot"] = str(slot)
+        if committee_index is not None:
+            params["committee_index"] = str(committee_index)
+        return self.get("eth/v1/beacon/pool/attestations", params or None)
+
+    def post_attestations(self, attestations: list) -> None:
+        self.post("eth/v1/beacon/pool/attestations", attestations)
+
+    def get_attester_slashings_from_pool(self) -> list:
+        return self.get("eth/v1/beacon/pool/attester_slashings")
+
+    def post_attester_slashing(self, slashing) -> None:
+        self.post("eth/v1/beacon/pool/attester_slashings", slashing)
+
+    def get_proposer_slashings_from_pool(self) -> list:
+        return self.get("eth/v1/beacon/pool/proposer_slashings")
+
+    def post_proposer_slashing(self, slashing) -> None:
+        self.post("eth/v1/beacon/pool/proposer_slashings", slashing)
+
+    def post_sync_committee_messages(self, messages: list) -> None:
+        self.post("eth/v1/beacon/pool/sync_committees", messages)
+
+    def get_voluntary_exits_from_pool(self) -> list:
+        return self.get("eth/v1/beacon/pool/voluntary_exits")
+
+    def post_signed_voluntary_exit(self, exit_message) -> None:
+        self.post("eth/v1/beacon/pool/voluntary_exits", exit_message)
+
+    def get_bls_to_execution_changes(self) -> list:
+        return self.get("eth/v1/beacon/pool/bls_to_execution_changes")
+
+    def post_bls_to_execution_changes(self, changes: list) -> None:
+        self.post("eth/v1/beacon/pool/bls_to_execution_changes", changes)
+
+    # -- builder ------------------------------------------------------------
+    def get_expected_withdrawals(
+        self, state_id: StateId | str, proposal_slot: int | None = None
+    ) -> list:
+        """(api_client.rs:558)"""
+        params = (
+            {"proposal_slot": str(proposal_slot)}
+            if proposal_slot is not None
+            else None
+        )
+        return self.get(
+            f"eth/v1/builder/states/{StateId(state_id)}/expected_withdrawals", params
+        )
+
+    # -- config (api_client.rs:579-601) --------------------------------------
+    def get_fork_schedule(self) -> list:
+        return self.get("eth/v1/config/fork_schedule")
+
+    def get_spec(self) -> dict:
+        return self.get("eth/v1/config/spec")
+
+    def get_deposit_contract_address(self) -> dict:
+        return self.get("eth/v1/config/deposit_contract")
+
+    # -- debug ---------------------------------------------------------------
+    def get_state(self, state_id: StateId | str) -> VersionedValue:
+        """(api_client.rs:596) — decodes to the polymorphic BeaconState when
+        a context is bound."""
+        envelope = self.get_enveloped(f"eth/v2/debug/beacon/states/{StateId(state_id)}")
+        if self.context is not None:
+            from ..types import BeaconState
+
+            envelope.data = BeaconState.from_json(envelope.data, self.context.preset)
+        return envelope
+
+    def get_heads(self) -> list[CoordinateWithMetadata]:
+        """(api_client.rs:603)"""
+        return [
+            CoordinateWithMetadata.from_json(h)
+            for h in self.get("eth/v2/debug/beacon/heads")
+        ]
+
+    # -- events (api_client.rs:610) ------------------------------------------
+    def get_events(self, topics: list[str]) -> Iterator[tuple[str, dict]]:
+        """SSE stream of (event, data) pairs."""
+        response = self.session.get(
+            self._url("eth/v1/events"),
+            params={"topics": ",".join(topics)},
+            stream=True,
+            headers={"Accept": "text/event-stream"},
+        )
+        self._raise_for_api_error(response)
+        event = None
+        for raw in response.iter_lines():
+            line = raw.decode() if isinstance(raw, bytes) else raw
+            if line.startswith("event:"):
+                event = line.split(":", 1)[1].strip()
+            elif line.startswith("data:"):
+                payload = line.split(":", 1)[1].strip()
+                yield event or "message", json.loads(payload)
+            elif not line:
+                event = None
+
+    # -- node (api_client.rs:620-681) ----------------------------------------
+    def get_node_identity(self) -> NetworkIdentity:
+        return NetworkIdentity.from_json(self.get("eth/v1/node/identity"))
+
+    def get_node_peers(self, states=(), directions=()) -> list[PeerSummary]:
+        params = {}
+        if states:
+            params["state"] = ",".join(states)
+        if directions:
+            params["direction"] = ",".join(directions)
+        return [
+            PeerSummary.from_json(p)
+            for p in self.get("eth/v1/node/peers", params or None)
+        ]
+
+    def get_peer(self, peer_id: str) -> PeerSummary:
+        return PeerSummary.from_json(self.get(f"eth/v1/node/peers/{peer_id}"))
+
+    def get_peer_summary(self) -> dict:
+        return self.get("eth/v1/node/peer_count")
+
+    def get_node_version(self) -> str:
+        return self.get("eth/v1/node/version")["version"]
+
+    def get_sync_status(self) -> SyncStatus:
+        return SyncStatus.from_json(self.get("eth/v1/node/syncing"))
+
+    def get_health(self) -> HealthStatus:
+        """(api_client.rs:668)"""
+        response = self.session.get(self._url("eth/v1/node/health"))
+        return {
+            200: HealthStatus.READY,
+            206: HealthStatus.SYNCING,
+            503: HealthStatus.NOT_INITIALIZED,
+        }.get(response.status_code, HealthStatus.UNKNOWN)
+
+    # -- validator (api_client.rs:683-871) -----------------------------------
+    def get_attester_duties(
+        self, epoch: int, indices: list[int]
+    ) -> tuple[bytes, list[AttestationDuty]]:
+        """(api_client.rs:683) → (dependent_root, duties)"""
+        body = self.http_post(
+            f"eth/v1/validator/duties/attester/{epoch}",
+            [str(i) for i in indices],
+        ).json()
+        return (
+            from_hex(body["dependent_root"], 32),
+            [AttestationDuty.from_json(d) for d in body["data"]],
+        )
+
+    def get_proposer_duties(self, epoch: int) -> tuple[bytes, list[ProposerDuty]]:
+        """(api_client.rs:700)"""
+        body = self.http_get(f"eth/v1/validator/duties/proposer/{epoch}").json()
+        return (
+            from_hex(body["dependent_root"], 32),
+            [ProposerDuty.from_json(d) for d in body["data"]],
+        )
+
+    def get_sync_committee_duties(
+        self, epoch: int, indices: list[int]
+    ) -> list[SyncCommitteeDuty]:
+        """(api_client.rs:713)"""
+        body = self.http_post(
+            f"eth/v1/validator/duties/sync/{epoch}", [str(i) for i in indices]
+        ).json()
+        return [SyncCommitteeDuty.from_json(d) for d in body["data"]]
+
+    def get_block_proposal(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes | None = None
+    ) -> VersionedValue:
+        """(api_client.rs:726)"""
+        params = {"randao_reveal": "0x" + randao_reveal.hex()}
+        if graffiti is not None:
+            params["graffiti"] = "0x" + graffiti.hex()
+        return self.get_enveloped(f"eth/v3/validator/blocks/{slot}", params)
+
+    def get_blinded_block_proposal(
+        self, slot: int, randao_reveal: bytes, graffiti: bytes | None = None
+    ) -> VersionedValue:
+        """(api_client.rs:747)"""
+        params = {"randao_reveal": "0x" + randao_reveal.hex()}
+        if graffiti is not None:
+            params["graffiti"] = "0x" + graffiti.hex()
+        return self.get_enveloped(f"eth/v1/validator/blinded_blocks/{slot}", params)
+
+    def get_attestation_data(self, slot: int, committee_index: int) -> dict:
+        """(api_client.rs:768)"""
+        return self.get(
+            "eth/v1/validator/attestation_data",
+            {"slot": str(slot), "committee_index": str(committee_index)},
+        )
+
+    def get_attestation_aggregate(
+        self, attestation_data_root: bytes, slot: int
+    ) -> dict:
+        """(api_client.rs:785)"""
+        return self.get(
+            "eth/v1/validator/aggregate_attestation",
+            {
+                "attestation_data_root": "0x" + attestation_data_root.hex(),
+                "slot": str(slot),
+            },
+        )
+
+    def post_aggregates_with_proofs(self, aggregates_with_proofs: list) -> None:
+        self.post("eth/v1/validator/aggregate_and_proofs", aggregates_with_proofs)
+
+    def subscribe_subnets_for_attestation_committees(self, subscriptions: list) -> None:
+        self.post("eth/v1/validator/beacon_committee_subscriptions", subscriptions)
+
+    def subscribe_subnets_for_sync_committees(self, subscriptions: list) -> None:
+        self.post("eth/v1/validator/sync_committee_subscriptions", subscriptions)
+
+    def get_sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: bytes
+    ) -> dict:
+        """(api_client.rs:823)"""
+        return self.get(
+            "eth/v1/validator/sync_committee_contribution",
+            {
+                "slot": str(slot),
+                "subcommittee_index": str(subcommittee_index),
+                "beacon_block_root": "0x" + beacon_block_root.hex(),
+            },
+        )
+
+    def post_sync_committee_contributions_with_proofs(
+        self, contributions_with_proofs: list
+    ) -> None:
+        self.post("eth/v1/validator/contribution_and_proofs", contributions_with_proofs)
+
+    def prepare_proposers(self, registrations: list) -> None:
+        """(api_client.rs:849)"""
+        self.post("eth/v1/validator/prepare_beacon_proposer", registrations)
+
+    def register_validators_with_builders(self, registrations: list) -> None:
+        """(api_client.rs:857)"""
+        self.post("eth/v1/validator/register_validator", registrations)
+
+    def post_liveness(self, epoch: int, indices: list[int]) -> list:
+        """(api_client.rs:864)"""
+        return self.http_post(
+            f"eth/v1/validator/liveness/{epoch}", [str(i) for i in indices]
+        ).json()["data"]

@@ -1,0 +1,44 @@
+"""Beacon-API error schema.
+
+Reference parity: beacon-api-client/src/api_error.rs:9-27 — `ApiError` with
+the message and indexed-failure shapes of the standard error envelope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["ApiError", "IndexedError"]
+
+
+@dataclass
+class IndexedError:
+    index: int
+    message: str
+
+
+class ApiError(Exception):
+    """code+message error, optionally with per-item failures
+    (api_error.rs:9)."""
+
+    def __init__(self, code: int, message: str, failures: list | None = None):
+        self.code = code
+        self.message = message
+        self.failures = failures or []
+        detail = f"{message} ({code})"
+        if self.failures:
+            parts = ", ".join(f"[{f.index}] {f.message}" for f in self.failures)
+            detail += f": {parts}"
+        super().__init__(detail)
+
+    @classmethod
+    def from_json(cls, obj) -> "ApiError":
+        failures = [
+            IndexedError(index=int(f["index"]), message=f["message"])
+            for f in obj.get("failures", [])
+        ]
+        return cls(
+            code=int(obj.get("code", 0)),
+            message=obj.get("message", ""),
+            failures=failures,
+        )

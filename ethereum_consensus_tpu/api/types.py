@@ -1,0 +1,399 @@
+"""Beacon-API presentation types.
+
+Reference parity: beacon-api-client/src/types.rs (526 LoC) — StateId:59,
+BlockId:114, ValidatorStatus:150, summaries, duties, BroadcastValidation:267,
+event Topic:284, ApiResult:523, Value/VersionedValue:500-512.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+from ..serde import from_hex
+
+__all__ = [
+    "StateId",
+    "BlockId",
+    "ValidatorStatus",
+    "BroadcastValidation",
+    "GenesisDetails",
+    "FinalityCheckpoints",
+    "ValidatorSummary",
+    "BalanceSummary",
+    "CommitteeSummary",
+    "SyncCommitteeSummary",
+    "BeaconHeaderSummary",
+    "AttestationDuty",
+    "ProposerDuty",
+    "SyncCommitteeDuty",
+    "CommitteeFilter",
+    "Value",
+    "VersionedValue",
+    "PeerSummary",
+    "SyncStatus",
+    "HealthStatus",
+    "NetworkIdentity",
+    "CoordinateWithMetadata",
+]
+
+
+class _Identifier:
+    """head/genesis/finalized/justified | slot | 0x-root (types.rs:59)."""
+
+    NAMES: tuple = ()
+
+    def __init__(self, value):
+        if isinstance(value, _Identifier):
+            value = value.value
+        if isinstance(value, bytes):
+            if len(value) != 32:
+                raise ValueError("root identifier must be 32 bytes")
+        elif isinstance(value, int):
+            if value < 0:
+                raise ValueError("slot identifier must be non-negative")
+        elif isinstance(value, str):
+            if value in self.NAMES:
+                pass
+            elif value.startswith("0x"):
+                value = bytes.fromhex(value[2:])
+                if len(value) != 32:
+                    raise ValueError("root identifier must be 32 bytes")
+            elif value.isdigit():
+                value = int(value)
+            else:
+                raise ValueError(f"cannot parse identifier {value!r}")
+        else:
+            raise TypeError(f"bad identifier {value!r}")
+        self.value = value
+
+    def __str__(self) -> str:
+        if isinstance(self.value, bytes):
+            return "0x" + self.value.hex()
+        return str(self.value)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self})"
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other.value == self.value
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.value))
+
+
+class StateId(_Identifier):
+    NAMES = ("head", "genesis", "finalized", "justified")
+
+    HEAD: "StateId"
+    GENESIS: "StateId"
+    FINALIZED: "StateId"
+    JUSTIFIED: "StateId"
+
+
+StateId.HEAD = StateId("head")
+StateId.GENESIS = StateId("genesis")
+StateId.FINALIZED = StateId("finalized")
+StateId.JUSTIFIED = StateId("justified")
+
+
+class BlockId(_Identifier):
+    NAMES = ("head", "genesis", "finalized")
+
+    HEAD: "BlockId"
+    GENESIS: "BlockId"
+    FINALIZED: "BlockId"
+
+
+BlockId.HEAD = BlockId("head")
+BlockId.GENESIS = BlockId("genesis")
+BlockId.FINALIZED = BlockId("finalized")
+
+
+class ValidatorStatus(Enum):
+    """(types.rs:150) — the standard validator status taxonomy."""
+
+    PENDING_INITIALIZED = "pending_initialized"
+    PENDING_QUEUED = "pending_queued"
+    ACTIVE_ONGOING = "active_ongoing"
+    ACTIVE_EXITING = "active_exiting"
+    ACTIVE_SLASHED = "active_slashed"
+    EXITED_UNSLASHED = "exited_unslashed"
+    EXITED_SLASHED = "exited_slashed"
+    WITHDRAWAL_POSSIBLE = "withdrawal_possible"
+    WITHDRAWAL_DONE = "withdrawal_done"
+    # the aggregated filter statuses
+    ACTIVE = "active"
+    PENDING = "pending"
+    EXITED = "exited"
+    WITHDRAWAL = "withdrawal"
+
+
+class BroadcastValidation(Enum):
+    """(types.rs:267)"""
+
+    GOSSIP = "gossip"
+    CONSENSUS = "consensus"
+    CONSENSUS_AND_EQUIVOCATION = "consensus_and_equivocation"
+
+
+@dataclass
+class GenesisDetails:
+    genesis_time: int
+    genesis_validators_root: bytes
+    genesis_fork_version: bytes
+
+    @classmethod
+    def from_json(cls, obj) -> "GenesisDetails":
+        return cls(
+            genesis_time=int(obj["genesis_time"]),
+            genesis_validators_root=from_hex(obj["genesis_validators_root"]),
+            genesis_fork_version=from_hex(obj["genesis_fork_version"]),
+        )
+
+
+@dataclass
+class FinalityCheckpoints:
+    previous_justified: dict
+    current_justified: dict
+    finalized: dict
+
+    @classmethod
+    def from_json(cls, obj) -> "FinalityCheckpoints":
+        return cls(
+            previous_justified=obj["previous_justified"],
+            current_justified=obj["current_justified"],
+            finalized=obj["finalized"],
+        )
+
+
+@dataclass
+class ValidatorSummary:
+    index: int
+    balance: int
+    status: ValidatorStatus
+    validator: dict
+
+    @classmethod
+    def from_json(cls, obj) -> "ValidatorSummary":
+        return cls(
+            index=int(obj["index"]),
+            balance=int(obj["balance"]),
+            status=ValidatorStatus(obj["status"]),
+            validator=obj["validator"],
+        )
+
+
+@dataclass
+class BalanceSummary:
+    index: int
+    balance: int
+
+    @classmethod
+    def from_json(cls, obj) -> "BalanceSummary":
+        return cls(index=int(obj["index"]), balance=int(obj["balance"]))
+
+
+@dataclass
+class CommitteeSummary:
+    index: int
+    slot: int
+    validators: list[int]
+
+    @classmethod
+    def from_json(cls, obj) -> "CommitteeSummary":
+        return cls(
+            index=int(obj["index"]),
+            slot=int(obj["slot"]),
+            validators=[int(v) for v in obj["validators"]],
+        )
+
+
+@dataclass
+class SyncCommitteeSummary:
+    validators: list[int]
+    validator_aggregates: list[list[int]]
+
+    @classmethod
+    def from_json(cls, obj) -> "SyncCommitteeSummary":
+        return cls(
+            validators=[int(v) for v in obj["validators"]],
+            validator_aggregates=[
+                [int(v) for v in agg] for agg in obj["validator_aggregates"]
+            ],
+        )
+
+
+@dataclass
+class BeaconHeaderSummary:
+    root: bytes
+    canonical: bool
+    header: dict
+
+    @classmethod
+    def from_json(cls, obj) -> "BeaconHeaderSummary":
+        return cls(
+            root=from_hex(obj["root"]),
+            canonical=bool(obj["canonical"]),
+            header=obj["header"],
+        )
+
+
+@dataclass
+class AttestationDuty:
+    public_key: bytes
+    validator_index: int
+    committee_index: int
+    committee_length: int
+    committees_at_slot: int
+    validator_committee_index: int
+    slot: int
+
+    @classmethod
+    def from_json(cls, obj) -> "AttestationDuty":
+        return cls(
+            public_key=from_hex(obj["pubkey"]),
+            validator_index=int(obj["validator_index"]),
+            committee_index=int(obj["committee_index"]),
+            committee_length=int(obj["committee_length"]),
+            committees_at_slot=int(obj["committees_at_slot"]),
+            validator_committee_index=int(obj["validator_committee_index"]),
+            slot=int(obj["slot"]),
+        )
+
+
+@dataclass
+class ProposerDuty:
+    public_key: bytes
+    validator_index: int
+    slot: int
+
+    @classmethod
+    def from_json(cls, obj) -> "ProposerDuty":
+        return cls(
+            public_key=from_hex(obj["pubkey"]),
+            validator_index=int(obj["validator_index"]),
+            slot=int(obj["slot"]),
+        )
+
+
+@dataclass
+class SyncCommitteeDuty:
+    public_key: bytes
+    validator_index: int
+    validator_sync_committee_indices: list[int]
+
+    @classmethod
+    def from_json(cls, obj) -> "SyncCommitteeDuty":
+        return cls(
+            public_key=from_hex(obj["pubkey"]),
+            validator_index=int(obj["validator_index"]),
+            validator_sync_committee_indices=[
+                int(v) for v in obj["validator_sync_committee_indices"]
+            ],
+        )
+
+
+@dataclass
+class CommitteeFilter:
+    epoch: int | None = None
+    index: int | None = None
+    slot: int | None = None
+
+
+@dataclass
+class Value:
+    """data + flattened metadata (types.rs:500)."""
+
+    data: Any
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class VersionedValue:
+    """fork-versioned data envelope (types.rs:512)."""
+
+    version: str
+    data: Any
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class PeerSummary:
+    peer_id: str
+    enr: str | None
+    last_seen_p2p_address: str
+    state: str
+    direction: str
+
+    @classmethod
+    def from_json(cls, obj) -> "PeerSummary":
+        return cls(
+            peer_id=obj["peer_id"],
+            enr=obj.get("enr"),
+            last_seen_p2p_address=obj["last_seen_p2p_address"],
+            state=obj["state"],
+            direction=obj["direction"],
+        )
+
+
+@dataclass
+class SyncStatus:
+    head_slot: int
+    sync_distance: int
+    is_syncing: bool
+    is_optimistic: bool | None = None
+    el_offline: bool | None = None
+
+    @classmethod
+    def from_json(cls, obj) -> "SyncStatus":
+        return cls(
+            head_slot=int(obj["head_slot"]),
+            sync_distance=int(obj["sync_distance"]),
+            is_syncing=bool(obj["is_syncing"]),
+            is_optimistic=obj.get("is_optimistic"),
+            el_offline=obj.get("el_offline"),
+        )
+
+
+class HealthStatus(Enum):
+    READY = "ready"
+    SYNCING = "syncing"
+    NOT_INITIALIZED = "not_initialized"
+    UNKNOWN = "unknown"
+
+
+@dataclass
+class NetworkIdentity:
+    peer_id: str
+    enr: str
+    p2p_addresses: list[str]
+    discovery_addresses: list[str]
+    metadata: dict
+
+    @classmethod
+    def from_json(cls, obj) -> "NetworkIdentity":
+        return cls(
+            peer_id=obj["peer_id"],
+            enr=obj["enr"],
+            p2p_addresses=list(obj["p2p_addresses"]),
+            discovery_addresses=list(obj["discovery_addresses"]),
+            metadata=obj["metadata"],
+        )
+
+
+@dataclass
+class CoordinateWithMetadata:
+    """chain coordinate (root/slot) + metadata, used by /beacon/heads."""
+
+    root: bytes
+    slot: int
+    meta: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_json(cls, obj) -> "CoordinateWithMetadata":
+        meta = {k: v for k, v in obj.items() if k not in ("root", "slot")}
+        return cls(
+            root=from_hex(obj["root"]), slot=int(obj["slot"]), meta=meta
+        )

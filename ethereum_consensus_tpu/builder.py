@@ -1,0 +1,37 @@
+"""MEV builder types.
+
+Reference parity: ethereum-consensus/src/builder/mod.rs:9-30 —
+ValidatorRegistration, SignedValidatorRegistration, compute_builder_domain
+(DOMAIN_APPLICATION_BUILDER with genesis fork version and zeroed
+genesis_validators_root).
+"""
+
+from __future__ import annotations
+
+from .domains import DomainType
+from .models.phase0.helpers import compute_domain
+from .primitives import BlsPublicKey, BlsSignature, ExecutionAddress
+from .ssz import Container, uint64
+
+__all__ = [
+    "ValidatorRegistration",
+    "SignedValidatorRegistration",
+    "compute_builder_domain",
+]
+
+
+class ValidatorRegistration(Container):
+    fee_recipient: ExecutionAddress
+    gas_limit: uint64
+    timestamp: uint64
+    public_key: BlsPublicKey
+
+
+class SignedValidatorRegistration(Container):
+    message: ValidatorRegistration
+    signature: BlsSignature
+
+
+def compute_builder_domain(context) -> bytes:
+    """(builder/mod.rs:26)"""
+    return compute_domain(DomainType.APPLICATION_BUILDER, None, None, context)

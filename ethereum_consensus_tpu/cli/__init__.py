@@ -1,0 +1,8 @@
+"""`ec`-equivalent CLI (C25): validator mnemonic / EIP-2333-2334-2335 keys
+and keystores, BLS keygen, EIP-4844 blob encode/bundle/decode.
+
+Reference parity: ethereum-consensus/src/bin/ec/ (945 LoC).
+"""
+
+from . import blobs, keys, keystores, mnemonic  # noqa: F401
+from .main import main  # noqa: F401

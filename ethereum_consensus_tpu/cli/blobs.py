@@ -1,0 +1,147 @@
+"""EIP-4844 blob encoding: pack arbitrary bytes into blobs, bundle with KZG
+commitments/proofs, and decode back.
+
+Reference parity: ethereum-consensus/src/bin/ec/blobs/ — 254-bit packing
+into big-endian field elements (encode.rs:15: the top two bits of each
+32-byte field element are unusable), raw/sized framing (framing.rs: 1
+version byte + u32 big-endian payload size), bundling via
+blob_to_kzg_commitment + compute_blob_kzg_proof (bundler.rs), inverse
+unpacking (decode.rs).
+"""
+
+from __future__ import annotations
+
+from ..crypto.fields import R as BLS_MODULUS
+
+__all__ = [
+    "BYTES_PER_FIELD_ELEMENT",
+    "BITS_PER_FIELD_ELEMENT",
+    "SIZED_FRAMING_VERSION",
+    "HEADER_SIZE",
+    "pack_into_blobs",
+    "unpack_from_blobs",
+    "sized_header",
+    "payload_from_sized",
+    "encode",
+    "decode",
+    "bundle",
+]
+
+BYTES_PER_FIELD_ELEMENT = 32
+BITS_PER_FIELD_ELEMENT = 254  # usable bits per big-endian field element
+FIELD_ELEMENTS_PER_BLOB = 4096
+BYTES_PER_BLOB = BYTES_PER_FIELD_ELEMENT * FIELD_ELEMENTS_PER_BLOB
+MAX_BLOBS = 6
+
+SIZED_FRAMING_VERSION = 0
+HEADER_SIZE = 5
+
+
+def pack_into_blobs(buffer: bytes) -> list[bytes]:
+    """(encode.rs:29) — tightly pack a byte stream into 254-bit field
+    elements across however many blobs are needed. One big-int shift/mask
+    pass (no per-bit Python loop)."""
+    total_bits = len(buffer) * 8
+    stream = int.from_bytes(buffer, "big")
+    n_elements = max(1, -(-total_bits // BITS_PER_FIELD_ELEMENT))
+    blobs: list[bytes] = []
+    blob = bytearray()
+    for i in range(n_elements):
+        start = i * BITS_PER_FIELD_ELEMENT
+        width = min(BITS_PER_FIELD_ELEMENT, total_bits - start)
+        if width <= 0:
+            chunk = 0
+        else:
+            chunk = (stream >> (total_bits - start - width)) & ((1 << width) - 1)
+        # bits land after the two zero top bits of the 256-bit big-endian
+        # word (encode.rs:15)
+        value = chunk << (256 - 2 - start % BITS_PER_FIELD_ELEMENT - width)
+        if value >= BLS_MODULUS:
+            raise ValueError("packed field element exceeds the BLS modulus")
+        if len(blob) == BYTES_PER_BLOB:
+            blobs.append(bytes(blob))
+            blob.clear()
+        blob.extend(value.to_bytes(32, "big"))
+    blob.extend(b"\x00" * (BYTES_PER_BLOB - len(blob)))
+    blobs.append(bytes(blob))
+    return blobs
+
+
+def unpack_from_blobs(blobs: list[bytes]) -> bytes:
+    """(decode.rs:10) — inverse of pack_into_blobs (keeps padding bits;
+    apply framing to recover exact payloads)."""
+    out_bits = 0
+    n_bits = 0
+    for blob in blobs:
+        if len(blob) != BYTES_PER_BLOB:
+            raise ValueError(f"blob must be {BYTES_PER_BLOB} bytes")
+        for start in range(0, BYTES_PER_BLOB, BYTES_PER_FIELD_ELEMENT):
+            element = int.from_bytes(
+                blob[start : start + BYTES_PER_FIELD_ELEMENT], "big"
+            )
+            out_bits = (out_bits << BITS_PER_FIELD_ELEMENT) | element
+            n_bits += BITS_PER_FIELD_ELEMENT
+    out_len = len(blobs) * BYTES_PER_BLOB
+    # right-pad the recovered bit stream to the output byte length
+    out_bits <<= out_len * 8 - n_bits if out_len * 8 > n_bits else 0
+    return out_bits.to_bytes(out_len, "big")[:out_len]
+
+
+def sized_header(data_byte_length: int) -> bytes:
+    """(framing.rs:19)"""
+    if data_byte_length >= 2**32:
+        raise ValueError("payload too large for sized framing")
+    return bytes([SIZED_FRAMING_VERSION]) + data_byte_length.to_bytes(4, "big")
+
+
+def payload_from_sized(stream: bytes) -> bytes:
+    """(framing.rs:30)"""
+    if len(stream) < HEADER_SIZE:
+        raise ValueError("expected header for sized framing")
+    if stream[0] != SIZED_FRAMING_VERSION:
+        raise ValueError("unsupported sized-framing version")
+    size = int.from_bytes(stream[1:5], "big")
+    if size > len(stream) - HEADER_SIZE:
+        raise ValueError("invalid payload size")
+    return stream[HEADER_SIZE : HEADER_SIZE + size]
+
+
+def encode(data: bytes, framing: str = "sized") -> list[bytes]:
+    """(encode.rs:63 from_reader)"""
+    if framing == "sized":
+        data = sized_header(len(data)) + data
+    elif framing != "raw":
+        raise ValueError(f"unknown framing {framing!r}")
+    return pack_into_blobs(data)
+
+
+def decode(blobs: list[bytes], framing: str = "sized") -> bytes:
+    """(decode.rs:36 to_writer_from_json)"""
+    stream = unpack_from_blobs(blobs)
+    if framing == "sized":
+        return payload_from_sized(stream)
+    if framing != "raw":
+        raise ValueError(f"unknown framing {framing!r}")
+    return stream
+
+
+def bundle(blobs: list[bytes], kzg_settings=None):
+    """(bundler.rs) — per blob: commitment + proof → BlobsBundle-shaped
+    dict. Uses the insecure dev setup unless a ceremony ``kzg_settings``
+    is supplied."""
+    from ..crypto import kzg
+
+    if kzg_settings is None:
+        kzg_settings = kzg.KzgSettings.insecure_dev_setup(n=FIELD_ELEMENTS_PER_BLOB)
+    commitments = []
+    proofs = []
+    for blob in blobs:
+        commitment = kzg.blob_to_kzg_commitment(blob, kzg_settings)
+        proof = kzg.compute_blob_kzg_proof(blob, commitment, kzg_settings)
+        commitments.append(commitment)
+        proofs.append(proof)
+    return {
+        "commitments": commitments,
+        "proofs": proofs,
+        "blobs": blobs,
+    }

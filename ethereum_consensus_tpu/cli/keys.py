@@ -1,0 +1,142 @@
+"""EIP-2333/2334 hierarchical BLS key derivation.
+
+Reference parity: ethereum-consensus/src/bin/ec/validator/keys.rs:127 —
+hkdf_mod_r, lamport parent→child derivation, the EIP-2334 validator paths
+m/12381/3600/{i}/0 (withdrawal) and m/12381/3600/{i}/0/0 (signing), and
+parallel batch generation (rayon there, a process pool here).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+
+from ..crypto import bls
+from ..crypto.fields import R as BLS_MODULUS
+
+__all__ = [
+    "KeyPair",
+    "hkdf_mod_r",
+    "derive_master_sk",
+    "derive_child_key",
+    "derive_validator_keys",
+    "generate",
+]
+
+_SALT = b"BLS-SIG-KEYGEN-SALT-"
+_L = 48
+_K = 32
+_LAMPORT_COUNT = 255
+_LAMPORT_L = _K * _LAMPORT_COUNT
+
+
+@dataclass
+class KeyPair:
+    private_key: bls.SecretKey
+    public_key: bls.PublicKey
+    path: str
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    block = b""
+    counter = 1
+    while len(out) < length:
+        block = hmac.new(prk, block + info + bytes([counter]), hashlib.sha256).digest()
+        out += block
+        counter += 1
+    return out[:length]
+
+
+def _hkdf(salt: bytes, ikm: bytes, info: bytes, length: int) -> bytes:
+    return _hkdf_expand(_hkdf_extract(salt, ikm), info, length)
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def hkdf_mod_r(ikm: bytes) -> int:
+    """(keys.rs:68) — EIP-2333 hkdf_mod_r with re-salting on zero."""
+    key = 0
+    salt = _sha256(_SALT)
+    key_info = bytes([0, _L])
+    ikm = ikm + b"\x00"
+    while key == 0:
+        okm = _hkdf(salt, ikm, key_info, _L)
+        key = int.from_bytes(okm, "big") % BLS_MODULUS
+        salt = _sha256(salt)
+    return key
+
+
+def _ikm_to_lamport_secret_key(ikm: bytes, salt: bytes) -> list[bytes]:
+    okm = _hkdf(salt, ikm, b"", _LAMPORT_L)
+    return [okm[i * _K : (i + 1) * _K] for i in range(_LAMPORT_COUNT)]
+
+
+def _parent_key_to_lamport_public_key(parent_key: int, index: int) -> bytes:
+    """(keys.rs:47)"""
+    salt = index.to_bytes(4, "big")
+    ikm = parent_key.to_bytes(32, "big")
+    lamport_0 = _ikm_to_lamport_secret_key(ikm, salt)
+    not_ikm = bytes(b ^ 0xFF for b in ikm)
+    lamport_1 = _ikm_to_lamport_secret_key(not_ikm, salt)
+    lamport_public_key = b"".join(_sha256(k) for k in lamport_0) + b"".join(
+        _sha256(k) for k in lamport_1
+    )
+    return _sha256(lamport_public_key)
+
+
+def derive_child_key(parent_key: int, index: int) -> int:
+    """(keys.rs:96)"""
+    return hkdf_mod_r(_parent_key_to_lamport_public_key(parent_key, index))
+
+
+def derive_master_sk(seed: bytes) -> int:
+    """(keys.rs:101)"""
+    return hkdf_mod_r(seed)
+
+
+def _to_key_pair(key: int, path: str) -> KeyPair:
+    sk = bls.SecretKey(key)
+    return KeyPair(private_key=sk, public_key=sk.public_key(), path=path)
+
+
+def derive_validator_keys(root_key: int, index: int) -> tuple[KeyPair, KeyPair]:
+    """(keys.rs:117) → (signing, withdrawal) at the EIP-2334 paths."""
+    withdrawal_key = root_key
+    for step in (12381, 3600, index, 0):
+        withdrawal_key = derive_child_key(withdrawal_key, step)
+    signing_key = derive_child_key(withdrawal_key, 0)
+    return (
+        _to_key_pair(signing_key, f"m/12381/3600/{index}/0/0"),
+        _to_key_pair(withdrawal_key, f"m/12381/3600/{index}/0"),
+    )
+
+
+def generate(
+    seed: bytes, start: int, end: int, parallel: bool = True
+) -> tuple[list[KeyPair], list[KeyPair]]:
+    """(keys.rs:127) — batch keygen; data-parallel like the reference's
+    rayon path when ``parallel`` and the range is big enough."""
+    root_key = derive_master_sk(seed)
+    indices = range(start, end)
+    if parallel and len(indices) > 4:
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor() as pool:
+            pairs = list(pool.map(_derive_for, [(root_key, i) for i in indices]))
+    else:
+        pairs = [derive_validator_keys(root_key, i) for i in indices]
+    signing = [p[0] for p in pairs]
+    withdrawal = [p[1] for p in pairs]
+    return signing, withdrawal
+
+
+def _derive_for(args: tuple[int, int]) -> tuple[KeyPair, KeyPair]:
+    return derive_validator_keys(*args)

@@ -1,0 +1,153 @@
+"""EIP-2335 BLS keystores (scrypt KDF + AES-128-CTR).
+
+Reference parity: ethereum-consensus/src/bin/ec/validator/keystores.rs:221 —
+version-4 keystore JSON with scrypt kdf, sha256 checksum and aes-128-ctr
+cipher; NFKD + control-character stripping of passphrases.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import unicodedata
+import uuid as uuid_module
+
+from ..crypto import bls
+
+__all__ = ["Keystore", "encrypt", "decrypt", "generate_passphrase"]
+
+VERSION = 4
+SCRYPT_N = 2**15  # scrypt "recommended" params (keystores.rs:97)
+SCRYPT_R = 8
+SCRYPT_P = 1
+SCRYPT_DKLEN = 32
+SALT_LEN = 16
+IV_LEN = 16
+
+
+def _normalize(passphrase: str) -> bytes:
+    text = unicodedata.normalize("NFKD", passphrase)
+    text = "".join(c for c in text if not unicodedata.category(c).startswith("C"))
+    return text.encode()
+
+
+def _scrypt(passphrase: str, salt: bytes) -> bytes:
+    return hashlib.scrypt(
+        _normalize(passphrase),
+        salt=salt,
+        n=SCRYPT_N,
+        r=SCRYPT_R,
+        p=SCRYPT_P,
+        maxmem=2**27,
+        dklen=SCRYPT_DKLEN,
+    )
+
+
+def _aes_128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+    enc = cipher.encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+class Keystore(dict):
+    """An EIP-2335 keystore document (a dict with helpers)."""
+
+    @property
+    def public_key(self) -> str:
+        return self["pubkey"]
+
+    def to_json(self) -> str:
+        return json.dumps(self, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Keystore":
+        return cls(json.loads(text))
+
+
+def encrypt(
+    secret_key: bls.SecretKey,
+    passphrase: str,
+    path: str = "",
+    salt: bytes | None = None,
+    iv: bytes | None = None,
+) -> Keystore:
+    """(keystores.rs encrypt path)"""
+    salt = os.urandom(SALT_LEN) if salt is None else salt
+    iv = os.urandom(IV_LEN) if iv is None else iv
+    decryption_key = _scrypt(passphrase, salt)
+    secret_bytes = secret_key.to_bytes()
+    cipher_text = _aes_128_ctr(decryption_key[:16], iv, secret_bytes)
+    checksum = hashlib.sha256(decryption_key[16:32] + cipher_text).digest()
+    public_key = secret_key.public_key().to_bytes()
+    return Keystore(
+        {
+            "crypto": {
+                "kdf": {
+                    "function": "scrypt",
+                    "params": {
+                        "dklen": SCRYPT_DKLEN,
+                        "n": SCRYPT_N,
+                        "p": SCRYPT_P,
+                        "r": SCRYPT_R,
+                        "salt": salt.hex(),
+                    },
+                    "message": "",
+                },
+                "checksum": {
+                    "function": "sha256",
+                    "params": {},
+                    "message": checksum.hex(),
+                },
+                "cipher": {
+                    "function": "aes-128-ctr",
+                    "params": {"iv": iv.hex()},
+                    "message": cipher_text.hex(),
+                },
+            },
+            "description": "",
+            "pubkey": public_key.hex(),
+            "path": path,
+            "uuid": str(uuid_module.uuid4()),
+            "version": VERSION,
+        }
+    )
+
+
+def decrypt(keystore: Keystore | dict, passphrase: str) -> bls.SecretKey:
+    """(keystores.rs decrypt path) — verifies the checksum before
+    decrypting; raises ValueError on a wrong passphrase."""
+    crypto = keystore["crypto"]
+    kdf = crypto["kdf"]
+    if kdf["function"] != "scrypt":
+        raise ValueError(f"unsupported kdf {kdf['function']!r}")
+    params = kdf["params"]
+    decryption_key = hashlib.scrypt(
+        _normalize(passphrase),
+        salt=bytes.fromhex(params["salt"]),
+        n=params["n"],
+        r=params["r"],
+        p=params["p"],
+        maxmem=2**27,
+        dklen=params["dklen"],
+    )
+    cipher = crypto["cipher"]
+    if cipher["function"] != "aes-128-ctr":
+        raise ValueError(f"unsupported cipher {cipher['function']!r}")
+    cipher_text = bytes.fromhex(cipher["message"])
+    checksum = hashlib.sha256(decryption_key[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise ValueError("keystore checksum mismatch (wrong passphrase?)")
+    secret_bytes = _aes_128_ctr(
+        decryption_key[:16], bytes.fromhex(cipher["params"]["iv"]), cipher_text
+    )
+    return bls.SecretKey(int.from_bytes(secret_bytes, "big"))
+
+
+def generate_passphrase(length: int = 32) -> str:
+    """Random url-safe passphrase (keystores.rs PASSPHRASE_LEN)."""
+    import secrets
+
+    return secrets.token_urlsafe(length)[:length]

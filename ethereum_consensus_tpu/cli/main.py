@@ -1,0 +1,189 @@
+"""The `ec` CLI: validator keys/keystores, BLS utilities, blob tooling.
+
+Reference parity: ethereum-consensus/src/bin/ec/main.rs:7-29 — subcommands
+``validator`` (mnemonic/HD keys/keystores), ``bls`` (random keypair),
+``blobs`` (encode/bundle/decode). Run as
+``python -m ethereum_consensus_tpu.cli ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["main"]
+
+
+def _cmd_bls(args) -> int:
+    """(bin/ec/bls.rs:14) — random keypair to stdout."""
+    import secrets
+
+    from ..crypto import bls
+    from ..crypto.fields import R
+
+    sk = bls.SecretKey(secrets.randbelow(R - 1) + 1)
+    print(
+        json.dumps(
+            {
+                "secret_key": "0x" + sk.to_bytes().hex(),
+                "public_key": "0x" + sk.public_key().to_bytes().hex(),
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def _cmd_validator_mnemonic(args) -> int:
+    from . import mnemonic
+
+    if args.wordlist:
+        mnemonic.load_wordlist(args.wordlist)
+    print(mnemonic.generate_random_from_system_entropy())
+    return 0
+
+
+def _cmd_validator_keys(args) -> int:
+    from . import keys, mnemonic
+
+    if args.wordlist:
+        mnemonic.load_wordlist(args.wordlist)
+        phrase = mnemonic.recover_from_phrase(args.phrase)
+    else:
+        phrase = args.phrase  # seed derivation needs no wordlist
+    seed = mnemonic.to_seed(phrase, args.passphrase)
+    signing, withdrawal = keys.generate(seed, args.start, args.end, parallel=not args.serial)
+    out = [
+        {
+            "path": s.path,
+            "signing_public_key": "0x" + s.public_key.to_bytes().hex(),
+            "withdrawal_path": w.path,
+            "withdrawal_public_key": "0x" + w.public_key.to_bytes().hex(),
+        }
+        for s, w in zip(signing, withdrawal)
+    ]
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_validator_keystores(args) -> int:
+    from . import keys, keystores, mnemonic
+
+    seed = mnemonic.to_seed(args.phrase, args.passphrase)
+    signing, _ = keys.generate(seed, args.start, args.end, parallel=not args.serial)
+    documents = []
+    for pair in signing:
+        passphrase = args.keystore_passphrase or keystores.generate_passphrase()
+        store = keystores.encrypt(pair.private_key, passphrase, path=pair.path)
+        documents.append({"keystore": store, "passphrase": passphrase})
+    print(json.dumps(documents, indent=2))
+    return 0
+
+
+def _read_input(args) -> bytes:
+    if args.input == "-":
+        return sys.stdin.buffer.read()
+    with open(args.input, "rb") as f:
+        return f.read()
+
+
+def _cmd_blobs_encode(args) -> int:
+    """(bin/ec/blobs/encode.rs)"""
+    from . import blobs
+
+    data = _read_input(args)
+    packed = blobs.encode(data, framing=args.framing)
+    print(json.dumps(["0x" + b.hex() for b in packed]))
+    return 0
+
+
+def _cmd_blobs_decode(args) -> int:
+    """(bin/ec/blobs/decode.rs)"""
+    from . import blobs
+
+    packed = [
+        bytes.fromhex(b.removeprefix("0x"))
+        for b in json.loads(_read_input(args).decode())
+    ]
+    sys.stdout.buffer.write(blobs.decode(packed, framing=args.framing))
+    return 0
+
+
+def _cmd_blobs_bundle(args) -> int:
+    """(bin/ec/blobs/bundler.rs)"""
+    from . import blobs
+
+    packed = [
+        bytes.fromhex(b.removeprefix("0x"))
+        for b in json.loads(_read_input(args).decode())
+    ]
+    bundle = blobs.bundle(packed)
+    print(
+        json.dumps(
+            {
+                "commitments": ["0x" + bytes(c).hex() for c in bundle["commitments"]],
+                "proofs": ["0x" + bytes(p).hex() for p in bundle["proofs"]],
+                "blobs": ["0x" + b.hex() for b in bundle["blobs"]],
+            }
+        )
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="ec", description="utilities for ethereum consensus"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    validator = sub.add_parser("validator", help="validator key utilities")
+    vsub = validator.add_subparsers(dest="subcommand", required=True)
+
+    vm = vsub.add_parser("generate-mnemonic", help="random BIP-39 mnemonic")
+    vm.add_argument("--wordlist", help="path to the BIP-39 english wordlist")
+    vm.set_defaults(fn=_cmd_validator_mnemonic)
+
+    vk = vsub.add_parser("keys", help="derive EIP-2334 validator keys")
+    vk.add_argument("phrase", help="BIP-39 mnemonic phrase")
+    vk.add_argument("--passphrase", default=None)
+    vk.add_argument("--start", type=int, default=0)
+    vk.add_argument("--end", type=int, default=1)
+    vk.add_argument("--serial", action="store_true")
+    vk.add_argument("--wordlist", help="validate the phrase against this wordlist")
+    vk.set_defaults(fn=_cmd_validator_keys)
+
+    vs = vsub.add_parser("keystores", help="derive keys into EIP-2335 keystores")
+    vs.add_argument("phrase")
+    vs.add_argument("--passphrase", default=None)
+    vs.add_argument("--start", type=int, default=0)
+    vs.add_argument("--end", type=int, default=1)
+    vs.add_argument("--serial", action="store_true")
+    vs.add_argument("--keystore-passphrase", default=None)
+    vs.set_defaults(fn=_cmd_validator_keystores)
+
+    blscmd = sub.add_parser("bls", help="random BLS keypair")
+    blscmd.set_defaults(fn=_cmd_bls)
+
+    blobs_cmd = sub.add_parser("blobs", help="EIP-4844 blob tooling")
+    bsub = blobs_cmd.add_subparsers(dest="subcommand", required=True)
+    for name, fn in (
+        ("encode", _cmd_blobs_encode),
+        ("decode", _cmd_blobs_decode),
+        ("bundle", _cmd_blobs_bundle),
+    ):
+        cmd = bsub.add_parser(name)
+        cmd.add_argument("--input", default="-", help="file path or - for stdin")
+        cmd.add_argument("--framing", choices=("raw", "sized"), default="sized")
+        cmd.set_defaults(fn=fn)
+
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

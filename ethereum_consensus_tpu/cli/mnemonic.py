@@ -1,0 +1,111 @@
+"""BIP-39 mnemonics.
+
+Reference parity: ethereum-consensus/src/bin/ec/validator/mnemonic.rs:9-22
+(generate from system entropy, recover from phrase, seed derivation).
+
+Seed derivation (PBKDF2-HMAC-SHA512, 2048 rounds, salt "mnemonic"+pass)
+needs no wordlist and always works. Phrase generation/validation needs the
+standard 2048-word english list, which is data this environment does not
+ship — provide it via ``set_wordlist``/``load_wordlist`` (gated otherwise).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import unicodedata
+
+__all__ = [
+    "Seed",
+    "set_wordlist",
+    "load_wordlist",
+    "wordlist_available",
+    "generate_random_from_system_entropy",
+    "entropy_to_phrase",
+    "recover_from_phrase",
+    "to_seed",
+]
+
+Seed = bytes  # 64 bytes
+
+_WORDLIST: list[str] | None = None
+_WORD_INDEX: dict[str, int] | None = None
+
+
+def set_wordlist(words: list[str]) -> None:
+    """Install the BIP-39 wordlist (2048 words, index order)."""
+    global _WORDLIST, _WORD_INDEX
+    if len(words) != 2048:
+        raise ValueError(f"BIP-39 wordlist must have 2048 words, got {len(words)}")
+    _WORDLIST = [unicodedata.normalize("NFKD", w.strip()) for w in words]
+    _WORD_INDEX = {w: i for i, w in enumerate(_WORDLIST)}
+
+
+def load_wordlist(path: str) -> None:
+    with open(path, encoding="utf-8") as f:
+        set_wordlist([line for line in f.read().split() if line])
+
+
+def wordlist_available() -> bool:
+    return _WORDLIST is not None
+
+
+def _require_wordlist() -> None:
+    if _WORDLIST is None:
+        raise RuntimeError(
+            "BIP-39 wordlist not installed: call load_wordlist(path) or "
+            "set_wordlist(words) first (the standard english.txt, 2048 words)"
+        )
+
+
+def entropy_to_phrase(entropy: bytes) -> str:
+    """entropy (16/20/24/28/32 bytes) → mnemonic phrase."""
+    _require_wordlist()
+    if len(entropy) not in (16, 20, 24, 28, 32):
+        raise ValueError("entropy must be 128-256 bits in 32-bit steps")
+    checksum_bits = len(entropy) * 8 // 32
+    checksum = hashlib.sha256(entropy).digest()
+    value = int.from_bytes(entropy, "big")
+    value = (value << checksum_bits) | (checksum[0] >> (8 - checksum_bits))
+    total_bits = len(entropy) * 8 + checksum_bits
+    n_words = total_bits // 11
+    indices = [
+        (value >> (11 * (n_words - 1 - i))) & 0x7FF for i in range(n_words)
+    ]
+    return " ".join(_WORDLIST[i] for i in indices)
+
+
+def generate_random_from_system_entropy(strength_bytes: int = 32) -> str:
+    """(mnemonic.rs:9)"""
+    return entropy_to_phrase(os.urandom(strength_bytes))
+
+
+def recover_from_phrase(phrase: str) -> str:
+    """Validate a phrase's words + checksum; returns the normalized phrase
+    (mnemonic.rs:16)."""
+    _require_wordlist()
+    words = unicodedata.normalize("NFKD", phrase).split()
+    if len(words) not in (12, 15, 18, 21, 24):
+        raise ValueError(f"invalid mnemonic length {len(words)}")
+    value = 0
+    for word in words:
+        if word not in _WORD_INDEX:
+            raise ValueError(f"unknown mnemonic word {word!r}")
+        value = (value << 11) | _WORD_INDEX[word]
+    checksum_bits = len(words) // 3
+    entropy_bits = len(words) * 11 - checksum_bits
+    checksum = value & ((1 << checksum_bits) - 1)
+    entropy = (value >> checksum_bits).to_bytes(entropy_bits // 8, "big")
+    expected = hashlib.sha256(entropy).digest()[0] >> (8 - checksum_bits)
+    if checksum != expected:
+        raise ValueError("mnemonic checksum mismatch")
+    return " ".join(words)
+
+
+def to_seed(phrase: str, passphrase: str | None = None) -> Seed:
+    """(mnemonic.rs:20) — PBKDF2-HMAC-SHA512(phrase, "mnemonic"+pass, 2048)."""
+    normalized = unicodedata.normalize("NFKD", phrase)
+    salt = "mnemonic" + unicodedata.normalize("NFKD", passphrase or "")
+    return hashlib.pbkdf2_hmac(
+        "sha512", normalized.encode(), salt.encode(), 2048, dklen=64
+    )

@@ -1,0 +1,256 @@
+// Native SHA-256 merkle kernels (the CPU-side hot path of SSZ
+// hash_tree_root).
+//
+// Fills the role the reference fills with native crypto (sha2 crate /
+// blst's C, SURVEY.md L0): a from-scratch C++ SHA-256 specialized for the
+// 64-byte two-children message of binary merkleization, with whole-level
+// and whole-tree entry points so the Python merkleizer can hand off entire
+// reductions in one call.
+//
+// Build: g++ -O3 -march=native -shared -fPIC sha256_merkle.cpp -o ...
+// ABI (ctypes):
+//   void ec_hash_level(const uint8_t* in, uint8_t* out, size_t n_pairs);
+//   void ec_merkle_root(const uint8_t* chunks, size_t count, uint32_t depth,
+//                       const uint8_t* zero_hashes, uint8_t* out32);
+//   uint64_t ec_version(void);
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(EC_USE_SHA_NI) && defined(__SHA__) && defined(__x86_64__)
+#define EC_SHA_NI_ACTIVE 1
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+inline uint32_t load_be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline void store_be32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24);
+  p[1] = uint8_t(v >> 16);
+  p[2] = uint8_t(v >> 8);
+  p[3] = uint8_t(v);
+}
+
+inline void compress(uint32_t state[8], const uint32_t w_in[16]) {
+  uint32_t w[64];
+  std::memcpy(w, w_in, 16 * sizeof(uint32_t));
+  for (int t = 16; t < 64; ++t) {
+    uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+    uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int t = 0; t < 64; ++t) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[t] + w[t];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g;
+    g = f;
+    f = e;
+    e = d + t1;
+    d = c;
+    c = b;
+    b = a;
+    a = t1 + t2;
+  }
+  state[0] += a;
+  state[1] += b;
+  state[2] += c;
+  state[3] += d;
+  state[4] += e;
+  state[5] += f;
+  state[6] += g;
+  state[7] += h;
+}
+
+// the constant second block of a 64-byte message (0x80 pad + bit length 512)
+constexpr uint32_t PAD_BLOCK[16] = {0x80000000, 0, 0, 0, 0, 0, 0, 0,
+                                    0,          0, 0, 0, 0, 0, 0, 512};
+
+// SHA-256 of exactly 64 bytes (one merkle pair) — two compressions, the
+// second over a constant schedule.
+inline void sha256_64(const uint8_t* in, uint8_t* out) {
+  uint32_t state[8];
+  std::memcpy(state, H0, sizeof(H0));
+  uint32_t w[16];
+  for (int i = 0; i < 16; ++i) w[i] = load_be32(in + 4 * i);
+  compress(state, w);
+  compress(state, PAD_BLOCK);
+  for (int i = 0; i < 8; ++i) store_be32(out + 4 * i, state[i]);
+}
+
+#ifdef EC_SHA_NI_ACTIVE
+// SHA-NI two-compression digest of a 64-byte message. State is carried in
+// the (ABEF, CDGH) register layout the sha256rnds2 instruction expects.
+inline void sha256_64_ni(const uint8_t* in, uint8_t* out) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  // H0 in ABEF/CDGH layout
+  __m128i abef = _mm_set_epi32(0x6a09e667, 0xbb67ae85, 0x510e527f, 0x9b05688c);
+  __m128i cdgh = _mm_set_epi32(0x3c6ef372, 0xa54ff53a, 0x1f83d9ab, 0x5be0cd19);
+
+  for (int block = 0; block < 2; ++block) {
+    __m128i msg0, msg1, msg2, msg3;
+    if (block == 0) {
+      msg0 = _mm_shuffle_epi8(
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 0)), MASK);
+      msg1 = _mm_shuffle_epi8(
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 16)), MASK);
+      msg2 = _mm_shuffle_epi8(
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 32)), MASK);
+      msg3 = _mm_shuffle_epi8(
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 48)), MASK);
+    } else {
+      // constant pad block: 0x80 then zeros, length 512 bits
+      msg0 = _mm_set_epi32(0, 0, 0, int(0x80000000));
+      msg1 = _mm_setzero_si128();
+      msg2 = _mm_setzero_si128();
+      msg3 = _mm_set_epi32(512, 0, 0, 0);
+    }
+    const __m128i save_abef = abef;
+    const __m128i save_cdgh = cdgh;
+    __m128i msg;
+
+#define ROUNDS4(m, k_hi, k_lo)                                         \
+  msg = _mm_add_epi32(m, _mm_set_epi64x(k_hi, k_lo));                  \
+  cdgh = _mm_sha256rnds2_epu32(cdgh, abef, msg);                       \
+  msg = _mm_shuffle_epi32(msg, 0x0E);                                  \
+  abef = _mm_sha256rnds2_epu32(abef, cdgh, msg);
+
+#define SCHED(m0, m1, m2, m3)                                          \
+  m0 = _mm_sha256msg1_epu32(m0, m1);                                   \
+  m0 = _mm_add_epi32(m0, _mm_alignr_epi8(m3, m2, 4));                  \
+  m0 = _mm_sha256msg2_epu32(m0, m3);
+
+    ROUNDS4(msg0, 0xe9b5dba5b5c0fbcfULL, 0x71374491428a2f98ULL)
+    ROUNDS4(msg1, 0xab1c5ed5923f82a4ULL, 0x59f111f13956c25bULL)
+    ROUNDS4(msg2, 0x550c7dc3243185beULL, 0x12835b01d807aa98ULL)
+    ROUNDS4(msg3, 0xc19bf1749bdc06a7ULL, 0x80deb1fe72be5d74ULL)
+    SCHED(msg0, msg1, msg2, msg3)
+    ROUNDS4(msg0, 0x240ca1cc0fc19dc6ULL, 0xefbe4786e49b69c1ULL)
+    SCHED(msg1, msg2, msg3, msg0)
+    ROUNDS4(msg1, 0x76f988da5cb0a9dcULL, 0x4a7484aa2de92c6fULL)
+    SCHED(msg2, msg3, msg0, msg1)
+    ROUNDS4(msg2, 0xbf597fc7b00327c8ULL, 0xa831c66d983e5152ULL)
+    SCHED(msg3, msg0, msg1, msg2)
+    ROUNDS4(msg3, 0x1429296706ca6351ULL, 0xd5a79147c6e00bf3ULL)
+    SCHED(msg0, msg1, msg2, msg3)
+    ROUNDS4(msg0, 0x53380d134d2c6dfcULL, 0x2e1b213827b70a85ULL)
+    SCHED(msg1, msg2, msg3, msg0)
+    ROUNDS4(msg1, 0x92722c8581c2c92eULL, 0x766a0abb650a7354ULL)
+    SCHED(msg2, msg3, msg0, msg1)
+    ROUNDS4(msg2, 0xc76c51a3c24b8b70ULL, 0xa81a664ba2bfe8a1ULL)
+    SCHED(msg3, msg0, msg1, msg2)
+    ROUNDS4(msg3, 0x106aa070f40e3585ULL, 0xd6990624d192e819ULL)
+    SCHED(msg0, msg1, msg2, msg3)
+    ROUNDS4(msg0, 0x34b0bcb52748774cULL, 0x1e376c0819a4c116ULL)
+    SCHED(msg1, msg2, msg3, msg0)
+    ROUNDS4(msg1, 0x682e6ff35b9cca4fULL, 0x4ed8aa4a391c0cb3ULL)
+    SCHED(msg2, msg3, msg0, msg1)
+    ROUNDS4(msg2, 0x8cc7020884c87814ULL, 0x78a5636f748f82eeULL)
+    SCHED(msg3, msg0, msg1, msg2)
+    ROUNDS4(msg3, 0xc67178f2bef9a3f7ULL, 0xa4506ceb90befffaULL)
+
+#undef ROUNDS4
+#undef SCHED
+
+    abef = _mm_add_epi32(abef, save_abef);
+    cdgh = _mm_add_epi32(cdgh, save_cdgh);
+  }
+
+  // unpack ABEF/CDGH → big-endian digest
+  uint32_t a = uint32_t(_mm_extract_epi32(abef, 3));
+  uint32_t b = uint32_t(_mm_extract_epi32(abef, 2));
+  uint32_t e = uint32_t(_mm_extract_epi32(abef, 1));
+  uint32_t f = uint32_t(_mm_extract_epi32(abef, 0));
+  uint32_t c = uint32_t(_mm_extract_epi32(cdgh, 3));
+  uint32_t d = uint32_t(_mm_extract_epi32(cdgh, 2));
+  uint32_t g = uint32_t(_mm_extract_epi32(cdgh, 1));
+  uint32_t h = uint32_t(_mm_extract_epi32(cdgh, 0));
+  store_be32(out + 0, a);
+  store_be32(out + 4, b);
+  store_be32(out + 8, c);
+  store_be32(out + 12, d);
+  store_be32(out + 16, e);
+  store_be32(out + 20, f);
+  store_be32(out + 24, g);
+  store_be32(out + 28, h);
+}
+#endif  // EC_SHA_NI_ACTIVE
+
+}  // namespace
+
+extern "C" {
+
+// Hash one merkle level: in = n_pairs 64-byte messages, out = n_pairs
+// 32-byte digests. in/out may not alias.
+void ec_hash_level(const uint8_t* in, uint8_t* out, size_t n_pairs) {
+#ifdef EC_SHA_NI_ACTIVE
+  for (size_t i = 0; i < n_pairs; ++i) {
+    sha256_64_ni(in + 64 * i, out + 32 * i);
+  }
+#else
+  for (size_t i = 0; i < n_pairs; ++i) {
+    sha256_64(in + 64 * i, out + 32 * i);
+  }
+#endif
+}
+
+// Full tree reduction: `chunks` holds `count` populated 32-byte leaves of a
+// depth-`depth` virtual tree; `zero_hashes` is depth+1 cached zero-subtree
+// roots (32 bytes each). Writes the 32-byte root to `out32`. Matches the
+// Python merkleizer bit-for-bit (zero-padding odd levels with the level's
+// zero hash).
+void ec_merkle_root(const uint8_t* chunks, size_t count, uint32_t depth,
+                    const uint8_t* zero_hashes, uint8_t* out32) {
+  if (count == 0) {
+    std::memcpy(out32, zero_hashes + 32 * size_t(depth), 32);
+    return;
+  }
+  std::vector<uint8_t> nodes(chunks, chunks + 32 * count);
+  std::vector<uint8_t> next;
+  for (uint32_t level = 0; level < depth; ++level) {
+    size_t n = nodes.size() / 32;
+    if (n % 2 == 1) {
+      nodes.insert(nodes.end(), zero_hashes + 32 * size_t(level),
+                   zero_hashes + 32 * size_t(level) + 32);
+      ++n;
+    }
+    next.resize(32 * (n / 2));
+    ec_hash_level(nodes.data(), next.data(), n / 2);
+    nodes.swap(next);
+  }
+  std::memcpy(out32, nodes.data(), 32);
+}
+
+uint64_t ec_version(void) { return 1; }
+
+}  // extern "C"

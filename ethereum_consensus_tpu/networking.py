@@ -1,0 +1,226 @@
+"""Networking identity types and gossip constants.
+
+Reference parity: ethereum-consensus/src/networking.rs (~160 LoC) — `PeerId`
+reimplemented over base58(multihash) (networking.rs:13), `Multiaddr`, `Enr`
+alias, gossip `MessageDomain`; per-fork constants from
+src/{phase0,altair,deneb}/networking.rs. Pure from-scratch implementations —
+no libp2p dependency.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+from .ssz import Bitvector, Container, uint64
+
+__all__ = [
+    "MAX_INLINE_KEY_LENGTH",
+    "PeerId",
+    "Multiaddr",
+    "Enr",
+    "MessageDomain",
+    "ATTESTATION_SUBNET_COUNT",
+    "GOSSIP_MAX_SIZE",
+    "MAX_REQUEST_BLOCKS",
+    "MIN_EPOCHS_FOR_BLOCK_REQUESTS",
+    "MAX_CHUNK_SIZE",
+    "TTFB_TIMEOUT",
+    "RESP_TIMEOUT",
+    "ATTESTATION_PROPAGATION_SLOT_RANGE",
+    "MAXIMUM_GOSSIP_CLOCK_DISPARITY",
+    "MetaData",
+    "MetaDataAltair",
+    "MAX_REQUEST_BLOCKS_DENEB",
+    "MAX_REQUEST_BLOB_SIDECARS",
+    "MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS",
+    "BLOB_SIDECAR_SUBNET_COUNT",
+]
+
+MAX_INLINE_KEY_LENGTH = 42
+
+_B58_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_B58_INDEX = {c: i for i, c in enumerate(_B58_ALPHABET)}
+
+# multihash codes accepted for peer ids (networking.rs:38-44)
+_MH_IDENTITY = 0x00
+_MH_SHA2_256 = 0x12
+
+
+def _b58encode(data: bytes) -> str:
+    n = int.from_bytes(data, "big")
+    out = []
+    while n:
+        n, rem = divmod(n, 58)
+        out.append(_B58_ALPHABET[rem])
+    pad = 0
+    for b in data:
+        if b == 0:
+            pad += 1
+        else:
+            break
+    return "1" * pad + "".join(reversed(out))
+
+
+def _b58decode(text: str) -> bytes:
+    n = 0
+    for c in text:
+        if c not in _B58_INDEX:
+            raise ValueError(f"invalid base58 character {c!r}")
+        n = n * 58 + _B58_INDEX[c]
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    pad = 0
+    for c in text:
+        if c == "1":
+            pad += 1
+        else:
+            break
+    return b"\x00" * pad + raw
+
+
+def _varint_encode(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        byte = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _varint_decode(data: bytes, offset: int = 0) -> tuple[int, int]:
+    shift = 0
+    value = 0
+    while True:
+        if offset >= len(data):
+            raise ValueError("truncated varint")
+        byte = data[offset]
+        value |= (byte & 0x7F) << shift
+        offset += 1
+        if not byte & 0x80:
+            return value, offset
+        shift += 7
+
+
+class PeerId:
+    """libp2p peer id: base58(multihash) (networking.rs:13)."""
+
+    __slots__ = ("code", "digest")
+
+    def __init__(self, code: int, digest: bytes):
+        if code == _MH_SHA2_256:
+            pass
+        elif code == _MH_IDENTITY and len(digest) <= MAX_INLINE_KEY_LENGTH:
+            pass
+        else:
+            raise ValueError(f"unsupported multihash code {code:#x} for PeerId")
+        self.code = code
+        self.digest = bytes(digest)
+
+    def to_bytes(self) -> bytes:
+        return _varint_encode(self.code) + _varint_encode(len(self.digest)) + self.digest
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "PeerId":
+        code, offset = _varint_decode(data)
+        size, offset = _varint_decode(data, offset)
+        digest = data[offset : offset + size]
+        if len(digest) != size or offset + size != len(data):
+            raise ValueError("malformed multihash")
+        return cls(code, digest)
+
+    def to_base58(self) -> str:
+        return _b58encode(self.to_bytes())
+
+    @classmethod
+    def from_str(cls, text: str) -> "PeerId":
+        return cls.from_bytes(_b58decode(text))
+
+    def __str__(self) -> str:
+        return self.to_base58()
+
+    def __repr__(self) -> str:
+        return f"PeerId({self.to_base58()!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PeerId)
+            and self.code == other.code
+            and self.digest == other.digest
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.code, self.digest))
+
+
+class Multiaddr:
+    """Opaque multiaddr (string form), sufficient for API presentation."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: str):
+        if not value.startswith("/"):
+            raise ValueError("multiaddr must start with '/'")
+        self.value = value
+
+    def __str__(self) -> str:
+        return self.value
+
+    def __repr__(self) -> str:
+        return f"Multiaddr({self.value!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Multiaddr) and self.value == other.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+
+# ENR: presented as its textual "enr:..." form (networking.rs Enr alias)
+Enr = str
+
+
+class MessageDomain(Enum):
+    """Gossip message-id domains (networking.rs MessageDomain)."""
+
+    INVALID_SNAPPY = b"\x00\x00\x00\x00"
+    VALID_SNAPPY = b"\x01\x00\x00\x00"
+
+
+# -- phase0 gossip constants (phase0/networking.rs) --------------------------
+ATTESTATION_SUBNET_COUNT = 64
+GOSSIP_MAX_SIZE = 2**20
+MAX_REQUEST_BLOCKS = 2**10
+MIN_EPOCHS_FOR_BLOCK_REQUESTS = 33024
+MAX_CHUNK_SIZE = 2**20
+TTFB_TIMEOUT = 5.0  # seconds
+RESP_TIMEOUT = 10.0  # seconds
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+MAXIMUM_GOSSIP_CLOCK_DISPARITY = 0.5  # seconds
+
+
+class MetaData(Container):
+    """(phase0/networking.rs MetaData)"""
+
+    seq_number: uint64
+    attnets: Bitvector[ATTESTATION_SUBNET_COUNT]
+
+
+# altair adds sync-committee subnets (altair/networking.rs)
+from .models.altair.constants import SYNC_COMMITTEE_SUBNET_COUNT  # noqa: E402
+
+
+class MetaDataAltair(Container):
+    """(altair/networking.rs MetaData)"""
+
+    seq_number: uint64
+    attnets: Bitvector[ATTESTATION_SUBNET_COUNT]
+    syncnets: Bitvector[SYNC_COMMITTEE_SUBNET_COUNT]
+
+
+# -- deneb blob gossip constants (deneb/networking.rs) -----------------------
+MAX_REQUEST_BLOCKS_DENEB = 2**7
+MAX_REQUEST_BLOB_SIDECARS = 768
+MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS = 2**12
+BLOB_SIDECAR_SUBNET_COUNT = 6

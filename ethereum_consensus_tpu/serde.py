@@ -1,0 +1,58 @@
+"""Presentation-serde helpers: the hex / decimal-string JSON conventions.
+
+Reference parity: ethereum-consensus/src/serde.rs (238 LoC) — `as_hex`
+(0x-prefixed byte strings), `as_str` (u64 as decimal string, the
+consensus-specs JSON convention), `seq_of_str` (sequences thereof). The SSZ
+descriptors' to_json/from_json already apply these conventions per type;
+these helpers are for ad-hoc values (API payloads, YAML configs).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "as_hex",
+    "from_hex",
+    "as_str",
+    "from_str",
+    "seq_of_str",
+    "seq_from_str",
+]
+
+
+def as_hex(data: bytes) -> str:
+    """bytes → "0x..." (serde.rs as_hex::serialize)."""
+    return "0x" + bytes(data).hex()
+
+
+def from_hex(text: str, expected_length: int | None = None) -> bytes:
+    """"0x..." → bytes; enforces length when given (serde.rs try_bytes_from_hex_str)."""
+    if not isinstance(text, str) or not text.startswith("0x"):
+        raise ValueError(f"expected 0x-prefixed hex string, got {text!r}")
+    data = bytes.fromhex(text[2:])
+    if expected_length is not None and len(data) != expected_length:
+        raise ValueError(
+            f"expected {expected_length} bytes, decoded {len(data)} from {text!r}"
+        )
+    return data
+
+
+def as_str(value: int) -> str:
+    """u64 → decimal string (serde.rs as_str::serialize)."""
+    return str(int(value))
+
+
+def from_str(text) -> int:
+    """decimal string (or int for lenient inputs) → u64 (serde.rs as_str)."""
+    value = int(text)
+    if not 0 <= value < 2**64:
+        raise ValueError(f"{value} out of u64 range")
+    return value
+
+
+def seq_of_str(values) -> list[str]:
+    """sequence of u64 → decimal strings (serde.rs seq_of_str)."""
+    return [as_str(v) for v in values]
+
+
+def seq_from_str(texts) -> list[int]:
+    return [from_str(t) for t in texts]

@@ -1,0 +1,217 @@
+"""Beacon-API client tests against a local mock HTTP server (the analogue
+of the reference's reqwest-based client driven by canned endpoint JSON)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ethereum_consensus_tpu.api import (
+    ApiError,
+    BlockId,
+    BroadcastValidation,
+    Client,
+    HealthStatus,
+    StateId,
+    ValidatorStatus,
+)
+
+GENESIS = {
+    "genesis_time": "1606824023",
+    "genesis_validators_root": "0x" + "ab" * 32,
+    "genesis_fork_version": "0x00000000",
+}
+
+ROUTES = {
+    "/eth/v1/beacon/genesis": {"data": GENESIS},
+    "/eth/v1/beacon/states/head/root": {"data": {"root": "0x" + "cd" * 32}},
+    "/eth/v1/beacon/states/head/fork": {
+        "data": {
+            "previous_version": "0x00000000",
+            "current_version": "0x01000000",
+            "epoch": "74240",
+        }
+    },
+    "/eth/v1/beacon/states/finalized/finality_checkpoints": {
+        "data": {
+            "previous_justified": {"epoch": "1", "root": "0x" + "01" * 32},
+            "current_justified": {"epoch": "2", "root": "0x" + "02" * 32},
+            "finalized": {"epoch": "1", "root": "0x" + "01" * 32},
+        }
+    },
+    "/eth/v1/beacon/states/head/validators": {
+        "data": [
+            {
+                "index": "7",
+                "balance": "32000000000",
+                "status": "active_ongoing",
+                "validator": {"pubkey": "0x" + "aa" * 48},
+            }
+        ]
+    },
+    "/eth/v1/beacon/headers/head": {
+        "data": {
+            "root": "0x" + "ee" * 32,
+            "canonical": True,
+            "header": {"message": {"slot": "123"}},
+        }
+    },
+    "/eth/v2/beacon/blocks/head": {
+        "version": "deneb",
+        "data": {"message": {"slot": "9"}},
+        "execution_optimistic": False,
+    },
+    "/eth/v1/node/syncing": {
+        "data": {"head_slot": "100", "sync_distance": "0", "is_syncing": False}
+    },
+    "/eth/v1/node/version": {"data": {"version": "tpu/0.1.0"}},
+    "/eth/v2/debug/beacon/heads": {
+        "data": [{"root": "0x" + "99" * 32, "slot": "42", "execution_optimistic": False}]
+    },
+    "/eth/v1/validator/duties/proposer/3": {
+        "dependent_root": "0x" + "11" * 32,
+        "data": [
+            {"pubkey": "0x" + "aa" * 48, "validator_index": "5", "slot": "97"}
+        ],
+    },
+}
+
+
+class Handler(BaseHTTPRequestHandler):
+    posts = []
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def _respond(self, code, body):
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path == "/eth/v1/node/health":
+            self.send_response(206)
+            self.end_headers()
+            return
+        if path in ROUTES:
+            self._respond(200, ROUTES[path])
+        else:
+            self._respond(404, {"code": 404, "message": "not found"})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length) or b"null")
+        Handler.posts.append(
+            (self.path, body, dict(self.headers))
+        )
+        if self.path.startswith("/eth/v1/beacon/pool/attestations") and body == []:
+            self._respond(
+                400,
+                {
+                    "code": 400,
+                    "message": "invalid attestations",
+                    "failures": [{"index": 0, "message": "empty"}],
+                },
+            )
+            return
+        if self.path.startswith("/eth/v1/validator/duties/proposer"):
+            self._respond(200, ROUTES["/eth/v1/validator/duties/proposer/3"])
+            return
+        self._respond(200, {})
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_genesis_and_state_endpoints(server):
+    client = Client(server)
+    details = client.get_genesis_details()
+    assert details.genesis_time == 1606824023
+    assert details.genesis_validators_root == b"\xab" * 32
+
+    assert client.get_state_root(StateId.HEAD) == b"\xcd" * 32
+    fork = client.get_fork("head")
+    assert fork["epoch"] == "74240"
+    checkpoints = client.get_finality_checkpoints(StateId.FINALIZED)
+    assert checkpoints.finalized["epoch"] == "1"
+
+    validators = client.get_validators(
+        StateId.HEAD, statuses=(ValidatorStatus.ACTIVE_ONGOING,)
+    )
+    assert validators[0].index == 7
+    assert validators[0].status is ValidatorStatus.ACTIVE_ONGOING
+
+
+def test_headers_blocks_and_debug(server):
+    client = Client(server)
+    header = client.get_beacon_header_at_head()
+    assert header.canonical and header.root == b"\xee" * 32
+
+    block = client.get_beacon_block(BlockId.HEAD)
+    assert block.version == "deneb"
+    assert block.data["message"]["slot"] == "9"
+    assert block.meta["execution_optimistic"] is False
+
+    heads = client.get_heads()
+    assert heads[0].slot == 42
+
+    assert client.get_node_version() == "tpu/0.1.0"
+    status = client.get_sync_status()
+    assert status.head_slot == 100 and not status.is_syncing
+    assert client.get_health() is HealthStatus.SYNCING
+
+
+def test_post_block_sets_consensus_version_header(server):
+    client = Client(server)
+    Handler.posts.clear()
+    client.post_signed_beacon_block_v2(
+        {"message": {"slot": "1"}},
+        version="capella",
+        broadcast_validation=BroadcastValidation.GOSSIP,
+    )
+    path, body, headers = Handler.posts[-1]
+    assert path == "/eth/v2/beacon/blocks?broadcast_validation=gossip"
+    assert headers.get("Eth-Consensus-Version") == "capella"
+    assert body["message"]["slot"] == "1"
+
+
+def test_proposer_duties(server):
+    client = Client(server)
+    # mock returns the canned duties for any epoch via GET
+    ROUTES["/eth/v1/validator/duties/proposer/3"]["data"][0]["slot"] = "97"
+    dependent_root, duties = client.get_proposer_duties(3)
+    assert dependent_root == b"\x11" * 32
+    assert duties[0].validator_index == 5 and duties[0].slot == 97
+
+
+def test_api_error_schema(server):
+    client = Client(server)
+    with pytest.raises(ApiError) as err:
+        client.get("eth/v1/no/such/route")
+    assert err.value.code == 404
+
+    with pytest.raises(ApiError) as err:
+        client.post_attestations([])
+    assert err.value.failures[0].message == "empty"
+
+
+def test_identifier_parsing():
+    assert str(StateId("head")) == "head"
+    assert str(StateId(1234)) == "1234"
+    assert str(StateId("0x" + "ab" * 32)) == "0x" + "ab" * 32
+    assert str(BlockId(b"\x01" * 32)) == "0x" + "01" * 32
+    with pytest.raises(ValueError):
+        StateId("justified-nonsense")
+    with pytest.raises(ValueError):
+        BlockId("0x1234")  # wrong length

@@ -1,0 +1,99 @@
+"""Aux-layer tests: networking identities (PeerId base58/multihash round
+trips matching the reference's own test vectors, networking.rs:131-146),
+builder types, serde presentation helpers."""
+
+import pytest
+
+from ethereum_consensus_tpu.builder import (
+    SignedValidatorRegistration,
+    ValidatorRegistration,
+    compute_builder_domain,
+)
+from ethereum_consensus_tpu.config import Context
+from ethereum_consensus_tpu.networking import (
+    ATTESTATION_SUBNET_COUNT,
+    MetaData,
+    MetaDataAltair,
+    Multiaddr,
+    PeerId,
+)
+from ethereum_consensus_tpu.serde import (
+    as_hex,
+    as_str,
+    from_hex,
+    from_str,
+    seq_from_str,
+    seq_of_str,
+)
+
+
+def test_peer_id_base58_roundtrip_reference_vector():
+    # the reference's own test vector (networking.rs:142)
+    text = "QmYyQSo1c1Ym7orWxLYvCrM2EmxFTANf8wXmmE7DWjhx5N"
+    peer = PeerId.from_str(text)
+    assert str(peer) == text
+    assert PeerId.from_bytes(peer.to_bytes()) == peer
+
+    # identity-keyed peer (networking.rs:131 vector)
+    text2 = "16Uiu2HAmVDji3ShrqL9DLnQo3teJcEWiKqy9qKefFFFxrz2EYwde"
+    peer2 = PeerId.from_str(text2)
+    assert peer2.to_base58() == text2
+
+
+def test_peer_id_rejects_bad_codes():
+    with pytest.raises(ValueError):
+        PeerId(0x13, b"\x00" * 32)  # sha2-512 unsupported
+    with pytest.raises(ValueError):
+        PeerId(0x00, b"\x00" * 64)  # identity too long
+    with pytest.raises(ValueError):
+        PeerId.from_str("not!base58!!")
+
+
+def test_multiaddr():
+    addr = Multiaddr("/ip4/127.0.0.1/tcp/9000")
+    assert str(addr) == "/ip4/127.0.0.1/tcp/9000"
+    with pytest.raises(ValueError):
+        Multiaddr("ip4/127.0.0.1")
+
+
+def test_metadata_ssz():
+    md = MetaData(seq_number=3, attnets=[True] + [False] * 63)
+    raw = MetaData.serialize(md)
+    back = MetaData.deserialize(raw)
+    assert back.seq_number == 3 and back.attnets[0] and not back.attnets[1]
+    md2 = MetaDataAltair(seq_number=1, syncnets=[True, False, True, False])
+    assert MetaDataAltair.deserialize(
+        MetaDataAltair.serialize(md2)
+    ).syncnets == [True, False, True, False]
+    assert len(md.attnets) == ATTESTATION_SUBNET_COUNT
+
+
+def test_builder_domain_and_registration():
+    ctx = Context.for_minimal()
+    domain = compute_builder_domain(ctx)
+    assert len(domain) == 32
+    assert domain[:4] == bytes([0, 0, 0, 1])  # APPLICATION_BUILDER LE encoding
+
+    reg = ValidatorRegistration(
+        fee_recipient=b"\x11" * 20, gas_limit=30_000_000, timestamp=12, public_key=b"\xaa" * 48
+    )
+    signed = SignedValidatorRegistration(message=reg, signature=b"\xbb" * 96)
+    raw = SignedValidatorRegistration.serialize(signed)
+    assert SignedValidatorRegistration.deserialize(raw) == signed
+    js = SignedValidatorRegistration.to_json(signed)
+    assert js["message"]["gas_limit"] == "30000000"
+
+
+def test_serde_helpers():
+    assert as_hex(b"\x01\xff") == "0x01ff"
+    assert from_hex("0x01ff") == b"\x01\xff"
+    with pytest.raises(ValueError):
+        from_hex("01ff")
+    with pytest.raises(ValueError):
+        from_hex("0x01ff", expected_length=3)
+    assert as_str(7) == "7"
+    assert from_str("18446744073709551615") == 2**64 - 1
+    with pytest.raises(ValueError):
+        from_str("-1")
+    assert seq_of_str([1, 2]) == ["1", "2"]
+    assert seq_from_str(["1", "2"]) == [1, 2]

@@ -1,0 +1,127 @@
+"""ec-CLI tests: EIP-2333 derivation against the public test vector
+(the reference's own keys.rs:140 vector), EIP-2335 keystore roundtrip,
+BIP-39 seeds, blob encode/decode framing roundtrips, CLI entry points.
+"""
+
+import json
+
+import pytest
+
+from ethereum_consensus_tpu.cli import blobs, keys, keystores, mnemonic
+from ethereum_consensus_tpu.cli.main import main
+from ethereum_consensus_tpu.crypto import bls
+
+TEST_PHRASE = (
+    "abandon abandon abandon abandon abandon abandon abandon abandon "
+    "abandon abandon abandon about"
+)
+
+
+def test_bip39_seed_matches_reference_vector():
+    # keys.rs:143 expected seed for the TREZOR passphrase
+    seed = mnemonic.to_seed(TEST_PHRASE, "TREZOR")
+    expected = bytes(
+        [197, 82, 87, 195, 96, 192, 124, 114, 2, 154, 235, 193, 181, 60, 5, 237,
+         3, 98, 173, 163, 142, 173, 62, 62, 158, 250, 55, 8, 229, 52, 149, 83,
+         31, 9, 166, 152, 117, 153, 209, 130, 100, 193, 225, 201, 47, 44, 241,
+         65, 99, 12, 122, 60, 74, 183, 200, 27, 47, 0, 22, 152, 231, 70, 59, 4]
+    )
+    assert seed == expected
+
+
+def test_eip2333_derivation_matches_reference_vector():
+    # keys.rs:151-162: master + first child key from the TREZOR seed
+    seed = mnemonic.to_seed(TEST_PHRASE, "TREZOR")
+    root = keys.derive_master_sk(seed)
+    assert root == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+    child = keys.derive_child_key(root, 0)
+    assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_validator_key_paths():
+    seed = mnemonic.to_seed(TEST_PHRASE, None)
+    signing, withdrawal = keys.generate(seed, 0, 2, parallel=False)
+    assert [k.path for k in signing] == ["m/12381/3600/0/0/0", "m/12381/3600/1/0/0"]
+    assert [k.path for k in withdrawal] == ["m/12381/3600/0/0", "m/12381/3600/1/0"]
+    # deterministic: regeneration matches
+    signing2, _ = keys.generate(seed, 0, 2, parallel=False)
+    assert signing2[0].public_key.to_bytes() == signing[0].public_key.to_bytes()
+
+
+def test_keystore_roundtrip():
+    sk = bls.SecretKey(0x1234567890ABCDEF)
+    store = keystores.encrypt(sk, "correct horse battery staple", path="m/12381/3600/0/0/0")
+    assert store["version"] == 4
+    assert store["pubkey"] == sk.public_key().to_bytes().hex()
+    recovered = keystores.decrypt(store, "correct horse battery staple")
+    assert recovered.to_bytes() == sk.to_bytes()
+    with pytest.raises(ValueError, match="checksum"):
+        keystores.decrypt(store, "wrong passphrase")
+    # document JSON round-trips
+    doc = keystores.Keystore.from_json(store.to_json())
+    assert keystores.decrypt(doc, "correct horse battery staple").to_bytes() == sk.to_bytes()
+
+
+def test_blob_pack_roundtrip():
+    payload = b"hello blob world" * 100
+    packed = blobs.encode(payload, framing="sized")
+    assert all(len(b) == blobs.BYTES_PER_BLOB for b in packed)
+    # every field element is canonical (< modulus, top 2 bits clear)
+    for b in packed:
+        for i in range(0, len(b), 32):
+            assert b[i] >> 6 == 0
+    assert blobs.decode(packed, framing="sized") == payload
+
+    raw_packed = blobs.encode(payload, framing="raw")
+    recovered = blobs.decode(raw_packed, framing="raw")
+    assert recovered[: len(payload)] == payload  # raw keeps padding
+
+
+def test_blob_framing_errors():
+    with pytest.raises(ValueError):
+        blobs.payload_from_sized(b"\x01\x00\x00\x00\x05hello")  # bad version
+    with pytest.raises(ValueError):
+        blobs.payload_from_sized(b"\x00\xff\xff\xff\xff")  # size too large
+
+
+def test_cli_bls_and_blobs(capsys, tmp_path):
+    assert main(["bls"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["public_key"].startswith("0x") and len(out["public_key"]) == 98
+
+    data = tmp_path / "payload.bin"
+    data.write_bytes(b"tpu consensus")
+    assert main(["blobs", "encode", "--input", str(data)]) == 0
+    encoded = capsys.readouterr().out
+    blob_list = json.loads(encoded)
+    assert len(blob_list) == 1
+
+    enc_file = tmp_path / "blobs.json"
+    enc_file.write_text(encoded)
+    assert main(["blobs", "decode", "--input", str(enc_file)]) == 0
+    assert capsys.readouterr().out.encode().startswith(b"tpu consensus")
+
+
+def test_cli_validator_keys(capsys):
+    assert main(["validator", "keys", TEST_PHRASE, "--serial", "--end", "1"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out[0]["path"] == "m/12381/3600/0/0/0"
+    assert out[0]["signing_public_key"].startswith("0x")
+
+
+def test_mnemonic_gating():
+    assert not mnemonic.wordlist_available()
+    with pytest.raises(RuntimeError, match="wordlist"):
+        mnemonic.generate_random_from_system_entropy()
+    # with a (toy, invalid-content) wordlist installed the machinery runs
+    words = [f"w{i:04d}" for i in range(2048)]
+    mnemonic.set_wordlist(words)
+    try:
+        phrase = mnemonic.entropy_to_phrase(bytes(range(16)))
+        assert len(phrase.split()) == 12
+        assert mnemonic.recover_from_phrase(phrase) == phrase
+        with pytest.raises(ValueError):
+            mnemonic.recover_from_phrase("w0000 " * 12)  # checksum fails
+    finally:
+        mnemonic._WORDLIST = None
+        mnemonic._WORD_INDEX = None

@@ -1,0 +1,60 @@
+"""Native C++ SHA-256 merkle backend tests: bit-identical to hashlib and to
+the host merkleizer, and the dispatch wiring in ssz.hash."""
+
+import hashlib
+import os
+
+import pytest
+
+from ethereum_consensus_tpu import native
+from ethereum_consensus_tpu.ssz import hash as hash_dispatch
+from ethereum_consensus_tpu.ssz.merkle import merkleize_chunks, zero_hash
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native backend"
+)
+
+
+def test_hash_level_matches_hashlib():
+    data = os.urandom(64 * 999)
+    expect = b"".join(
+        hashlib.sha256(data[i : i + 64]).digest() for i in range(0, len(data), 64)
+    )
+    assert native.hash_level_native(data) == expect
+
+
+def test_merkle_root_matches_host_merkleizer():
+    for count, depth in [(1, 0), (5, 3), (1000, 10), (12345, 40)]:
+        chunks = os.urandom(32 * count)
+        zh = b"".join(zero_hash(i) for i in range(depth + 1))
+        assert native.merkle_root_native(chunks, depth, zh) == merkleize_chunks(
+            chunks, limit=2**depth
+        ), (count, depth)
+    # empty tree
+    zh = b"".join(zero_hash(i) for i in range(11))
+    assert native.merkle_root_native(b"", 10, zh) == zero_hash(10)
+
+
+def test_install_registers_dispatch():
+    previous = hash_dispatch._native_hasher
+    try:
+        assert native.install()
+        data = os.urandom(64 * 64)
+        assert hash_dispatch.hash_level(data) == hash_dispatch.hash_level_host(data)
+    finally:
+        hash_dispatch._native_hasher = previous
+
+
+def test_container_roots_unchanged_with_native_hasher():
+    from ethereum_consensus_tpu.config import Context
+    from ethereum_consensus_tpu.models import phase0
+
+    ns = phase0.build(Context.for_minimal().preset)
+    state = ns.BeaconState(genesis_time=42)
+    root_host = ns.BeaconState.hash_tree_root(state)
+    previous = hash_dispatch._native_hasher
+    try:
+        native.install()
+        assert ns.BeaconState.hash_tree_root(state) == root_host
+    finally:
+        hash_dispatch._native_hasher = previous
