@@ -277,8 +277,9 @@ class Client:
 
     def get_beacon_block_root(self, block_id: BlockId | str) -> bytes:
         """(api_client.rs:381)"""
-        return bytes.fromhex(
-            self.get(f"eth/v1/beacon/blocks/{BlockId(block_id)}/root")["root"], 32)
+        return from_hex(
+            self.get(f"eth/v1/beacon/blocks/{BlockId(block_id)}/root")["root"], 32
+        )
 
     def get_attestations_from_beacon_block(self, block_id: BlockId | str) -> list:
         return self.get(f"eth/v1/beacon/blocks/{BlockId(block_id)}/attestations")

@@ -64,6 +64,11 @@ def pack_into_blobs(buffer: bytes) -> list[bytes]:
         blob.extend(value.to_bytes(32, "big"))
     blob.extend(b"\x00" * (BYTES_PER_BLOB - len(blob)))
     blobs.append(bytes(blob))
+    if len(blobs) > MAX_BLOBS:
+        raise ValueError(
+            f"payload needs {len(blobs)} blobs, exceeding the per-block "
+            f"limit of {MAX_BLOBS}"
+        )
     return blobs
 
 

@@ -1,0 +1,100 @@
+"""Vectorized swap-or-not shuffle on device.
+
+Reference parity: the optimized whole-list shuffle behind the reference's
+`shuffling` feature (ethereum-consensus/src/phase0/helpers.rs:287, "cribbed
+from lighthouse") — here as a TPU-shaped kernel: the per-round pivot and
+source-byte material is tiny and data-independent, so it is precomputed
+host-side (SHUFFLE_ROUND_COUNT × ⌈count/256⌉ SHA-256 calls), uploaded once,
+and the per-index permutation runs as a `lax.fori_loop` of pure integer
+vector ops over all indices at once — no gather-scatter, no dynamic shapes.
+
+Bit-identical to models.phase0.helpers.compute_shuffled_index(s).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["shuffle_sources", "shuffled_indices_device", "compute_shuffled_indices_device"]
+
+
+def shuffle_sources(count: int, seed: bytes, rounds: int):
+    """Host-side precompute: per-round pivots and source-byte tables.
+
+    Returns (pivots: (rounds,) uint32, sources: (rounds, n_chunks*32) uint8)
+    where sources[r] concatenates sha256(seed + r + chunk) for every 256-
+    index chunk (helpers.rs:287's hash schedule).
+    """
+    if count == 0:
+        raise ValueError("empty index list")
+    n_chunks = (count + 255) // 256
+    pivots = np.empty(rounds, dtype=np.uint32)
+    sources = np.empty((rounds, n_chunks * 32), dtype=np.uint8)
+    for r in range(rounds):
+        round_byte = r.to_bytes(1, "little")
+        pivots[r] = (
+            int.from_bytes(
+                hashlib.sha256(seed + round_byte).digest()[:8], "little"
+            )
+            % count
+        )
+        for chunk in range(n_chunks):
+            digest = hashlib.sha256(
+                seed + round_byte + chunk.to_bytes(4, "little")
+            ).digest()
+            sources[r, chunk * 32 : (chunk + 1) * 32] = np.frombuffer(
+                digest, dtype=np.uint8
+            )
+    return pivots, sources
+
+
+def _shuffle_rounds(indices, pivots, sources, count: int, forward: bool):
+    """fori_loop over rounds; each round is one vectorized swap-or-not pass.
+
+    ``forward`` applies rounds 0..R-1 (the per-index map direction of
+    compute_shuffled_index); reversed order gives the inverse permutation.
+    """
+    count32 = jnp.uint32(count)
+    rounds = pivots.shape[0]
+
+    def body(i, idx):
+        r = i if forward else rounds - 1 - i
+        pivot = pivots[r]
+        flip = (pivot + count32 - idx) % count32
+        position = jnp.maximum(idx, flip)
+        byte = sources[r, position // jnp.uint32(8)]
+        bit = (byte >> (position % jnp.uint32(8)).astype(jnp.uint8)) & jnp.uint8(1)
+        return jnp.where(bit == 1, flip, idx)
+
+    return jax.lax.fori_loop(0, rounds, body, indices)
+
+
+def shuffled_indices_device(count: int, seed: bytes, rounds: int) -> jax.Array:
+    """Map every index through the swap-or-not permutation on device:
+    out[i] == compute_shuffled_index(i, count, seed)."""
+    pivots, sources = shuffle_sources(count, seed, rounds)
+    indices = jnp.arange(count, dtype=jnp.uint32)
+    return _shuffle_rounds(
+        indices,
+        jnp.asarray(pivots),
+        jnp.asarray(sources),
+        count,
+        forward=True,
+    )
+
+
+def compute_shuffled_indices_device(indices: list[int], seed: bytes, context) -> list[int]:
+    """Drop-in device twin of helpers.compute_shuffled_indices: permutes the
+    *list* so that out[i] == indices[compute_shuffled_index(i, ...)]."""
+    count = len(indices)
+    if count == 0:
+        return []
+    mapping = np.asarray(
+        shuffled_indices_device(count, seed, context.SHUFFLE_ROUND_COUNT)
+    )
+    arr = np.asarray(indices)
+    return arr[mapping].tolist()

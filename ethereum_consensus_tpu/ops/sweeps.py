@@ -1,0 +1,261 @@
+"""Device epoch-processing sweeps — the whole-registry data-parallel loops.
+
+Reference parity: the per-validator epoch loops the reference runs scalar
+(altair flag-delta rewards ethereum-consensus/src/altair/helpers.rs:265,
+inactivity updates/penalties altair/epoch_processing.rs:104, effective-
+balance hysteresis phase0/epoch_processing.rs) — re-expressed as exact-u64
+`jnp` vector ops over the packed registry, the "embarrassingly data-parallel
+integer ops, ideal XLA material" of SURVEY.md §7. Bit-identical to the host
+spec functions; cross-checked in tests.
+
+Inputs are packed registry arrays (uint64/uint8/bool). All arithmetic is
+integer; callers enable ``jax_enable_x64``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.altair.constants import (
+    PARTICIPATION_FLAG_WEIGHTS,
+    TIMELY_HEAD_FLAG_INDEX,
+    WEIGHT_DENOMINATOR,
+)
+
+__all__ = [
+    "pack_registry",
+    "flag_deltas_device",
+    "inactivity_updates_device",
+    "inactivity_penalties_device",
+    "effective_balance_updates_device",
+]
+
+
+def pack_registry(state, previous_epoch: int) -> dict:
+    """Host→device packing of the registry fields the sweeps touch.
+    Activity/eligibility are evaluated at ``previous_epoch`` (the epoch the
+    deltas reward/penalize, altair helpers.rs:265)."""
+    n = len(state.validators)
+    out = {
+        "effective_balance": np.fromiter(
+            (v.effective_balance for v in state.validators), np.uint64, n
+        ),
+        "slashed": np.fromiter(
+            (bool(v.slashed) for v in state.validators), np.bool_, n
+        ),
+        "active_previous": np.fromiter(
+            (
+                v.activation_epoch <= previous_epoch < v.exit_epoch
+                for v in state.validators
+            ),
+            np.bool_,
+            n,
+        ),
+        "eligible": np.fromiter(
+            (
+                (v.activation_epoch <= previous_epoch < v.exit_epoch)
+                or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+                for v in state.validators
+            ),
+            np.bool_,
+            n,
+        ),
+        "previous_participation": np.fromiter(
+            (int(f) for f in state.previous_epoch_participation), np.uint8, n
+        ),
+        "inactivity_scores": np.fromiter(
+            (int(s) for s in state.inactivity_scores), np.uint64, n
+        ),
+        "balances": np.fromiter((int(b) for b in state.balances), np.uint64, n),
+    }
+    return out
+
+
+def _isqrt_u64(x):
+    """Integer sqrt of a uint64 scalar array (Newton, fixed 6 iters from a
+    float seed — exact for the total-balance magnitudes involved)."""
+    guess = jnp.sqrt(x.astype(jnp.float64)).astype(jnp.uint64) + jnp.uint64(1)
+
+    def body(_, g):
+        g = jnp.maximum(g, jnp.uint64(1))
+        return (g + x // g) >> jnp.uint64(1)
+
+    g = jax.lax.fori_loop(0, 6, body, guess)
+    # clamp to floor(sqrt(x))
+    g = jnp.where(g * g > x, g - jnp.uint64(1), g)
+    return jnp.where((g + 1) * (g + 1) <= x, g + jnp.uint64(1), g)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "flag_index", "increment", "base_reward_factor", "weight_denominator",
+        "is_leaking",
+    ),
+)
+def _flag_deltas(
+    effective_balance,
+    participating,  # bool: unslashed & active & has_flag
+    eligible,
+    total_active_balance,
+    flag_weight,
+    flag_index: int,
+    increment: int,
+    base_reward_factor: int,
+    weight_denominator: int,
+    is_leaking: bool,
+):
+    base_reward_per_increment = (
+        jnp.uint64(increment)
+        * jnp.uint64(base_reward_factor)
+        // _isqrt_u64(total_active_balance)
+    )
+    base_reward = (
+        effective_balance // jnp.uint64(increment)
+    ) * base_reward_per_increment
+
+    unslashed_participating_balance = jnp.sum(
+        jnp.where(participating, effective_balance, jnp.uint64(0))
+    )
+    unslashed_increments = unslashed_participating_balance // jnp.uint64(increment)
+    # spec: max(EFFECTIVE_BALANCE_INCREMENT, total) guard is already applied
+    # by the caller for total_active_balance
+    active_increments = total_active_balance // jnp.uint64(increment)
+
+    reward_numerator = base_reward * flag_weight * unslashed_increments
+    rewards = jnp.where(
+        participating & eligible & jnp.bool_(not is_leaking),
+        reward_numerator // (active_increments * jnp.uint64(weight_denominator)),
+        jnp.uint64(0),
+    )
+    penalize = eligible & ~participating
+    if flag_index == TIMELY_HEAD_FLAG_INDEX:
+        penalties = jnp.zeros_like(rewards)
+    else:
+        penalties = jnp.where(
+            penalize,
+            base_reward * flag_weight // jnp.uint64(weight_denominator),
+            jnp.uint64(0),
+        )
+    return rewards, penalties
+
+
+def flag_deltas_device(packed: dict, flag_index: int, total_active_balance: int, context, is_leaking: bool):
+    """Device twin of altair get_flag_index_deltas (helpers.rs:265)."""
+    participating = (
+        ((packed["previous_participation"] >> np.uint8(flag_index)) & 1).astype(bool)
+        & ~packed["slashed"]
+        & packed["active_previous"]
+    )
+    rewards, penalties = _flag_deltas(
+        jnp.asarray(packed["effective_balance"]),
+        jnp.asarray(participating),
+        jnp.asarray(packed["eligible"]),
+        jnp.uint64(total_active_balance),
+        jnp.uint64(PARTICIPATION_FLAG_WEIGHTS[flag_index]),
+        flag_index,
+        context.EFFECTIVE_BALANCE_INCREMENT,
+        context.BASE_REWARD_FACTOR,
+        WEIGHT_DENOMINATOR,
+        is_leaking,
+    )
+    return np.asarray(rewards), np.asarray(penalties)
+
+
+@functools.partial(jax.jit, static_argnames=("bias", "recovery_rate", "is_leaking"))
+def _inactivity_updates(scores, participating, eligible, bias: int, recovery_rate: int, is_leaking: bool):
+    decreased = scores - jnp.minimum(jnp.uint64(1), scores)
+    increased = scores + jnp.uint64(bias)
+    scores = jnp.where(
+        eligible, jnp.where(participating, decreased, increased), scores
+    )
+    if not is_leaking:
+        scores = jnp.where(
+            eligible, scores - jnp.minimum(jnp.uint64(recovery_rate), scores), scores
+        )
+    return scores
+
+
+def inactivity_updates_device(packed: dict, context, is_leaking: bool):
+    """Device twin of altair process_inactivity_updates
+    (epoch_processing.rs:104)."""
+    participating = (
+        ((packed["previous_participation"] >> np.uint8(1)) & 1).astype(bool)
+        & ~packed["slashed"]
+        & packed["active_previous"]
+    )
+    return np.asarray(
+        _inactivity_updates(
+            jnp.asarray(packed["inactivity_scores"]),
+            jnp.asarray(participating),
+            jnp.asarray(packed["eligible"]),
+            context.inactivity_score_bias,
+            context.inactivity_score_recovery_rate,
+            is_leaking,
+        )
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("bias", "quotient"))
+def _inactivity_penalties(effective_balance, scores, not_target, bias: int, quotient: int):
+    numerator = effective_balance * scores
+    denominator = jnp.uint64(bias) * jnp.uint64(quotient)
+    return jnp.where(not_target, numerator // denominator, jnp.uint64(0))
+
+
+def inactivity_penalties_device(packed: dict, context, quotient: int):
+    """Device twin of get_inactivity_penalty_deltas (per-fork quotient)."""
+    participating = (
+        ((packed["previous_participation"] >> np.uint8(1)) & 1).astype(bool)
+        & ~packed["slashed"]
+        & packed["active_previous"]
+    )
+    not_target = packed["eligible"] & ~participating
+    return np.asarray(
+        _inactivity_penalties(
+            jnp.asarray(packed["effective_balance"]),
+            jnp.asarray(packed["inactivity_scores"]),
+            jnp.asarray(not_target),
+            context.inactivity_score_bias,
+            quotient,
+        )
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "increment", "max_effective", "quotient", "down_mult", "up_mult",
+    ),
+)
+def _effective_balance_updates(
+    balances, effective, increment: int, max_effective: int, quotient: int,
+    down_mult: int, up_mult: int,
+):
+    hysteresis_increment = jnp.uint64(increment // quotient)
+    downward = hysteresis_increment * jnp.uint64(down_mult)
+    upward = hysteresis_increment * jnp.uint64(up_mult)
+    candidate = jnp.minimum(
+        balances - balances % jnp.uint64(increment), jnp.uint64(max_effective)
+    )
+    update = (balances + downward < effective) | (effective + upward < balances)
+    return jnp.where(update, candidate, effective)
+
+
+def effective_balance_updates_device(packed: dict, context):
+    """Device twin of phase0 process_effective_balance_updates."""
+    return np.asarray(
+        _effective_balance_updates(
+            jnp.asarray(packed["balances"]),
+            jnp.asarray(packed["effective_balance"]),
+            context.EFFECTIVE_BALANCE_INCREMENT,
+            context.MAX_EFFECTIVE_BALANCE,
+            context.HYSTERESIS_QUOTIENT,
+            context.HYSTERESIS_DOWNWARD_MULTIPLIER,
+            context.HYSTERESIS_UPWARD_MULTIPLIER,
+        )
+    )

@@ -57,6 +57,7 @@ ROUTES = {
             "header": {"message": {"slot": "123"}},
         }
     },
+    "/eth/v1/beacon/blocks/head/root": {"data": {"root": "0x" + "fe" * 32}},
     "/eth/v2/beacon/blocks/head": {
         "version": "deneb",
         "data": {"message": {"slot": "9"}},
@@ -158,6 +159,7 @@ def test_headers_blocks_and_debug(server):
     header = client.get_beacon_header_at_head()
     assert header.canonical and header.root == b"\xee" * 32
 
+    assert client.get_beacon_block_root(BlockId.HEAD) == b"\xfe" * 32
     block = client.get_beacon_block(BlockId.HEAD)
     assert block.version == "deneb"
     assert block.data["message"]["slot"] == "9"

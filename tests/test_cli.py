@@ -77,6 +77,12 @@ def test_blob_pack_roundtrip():
     assert recovered[: len(payload)] == payload  # raw keeps padding
 
 
+def test_blob_limit_enforced():
+    too_big = b"\x00" * (blobs.BYTES_PER_BLOB * 6 + 1)
+    with pytest.raises(ValueError, match="per-block"):
+        blobs.encode(too_big, framing="raw")
+
+
 def test_blob_framing_errors():
     with pytest.raises(ValueError):
         blobs.payload_from_sized(b"\x01\x00\x00\x00\x05hello")  # bad version

@@ -1,0 +1,111 @@
+"""Device shuffle + epoch-sweep kernels vs the host spec functions —
+bit-identical results on real altair states with mixed validator shapes."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import numpy as np  # noqa: E402
+from chain_utils import fresh_genesis_altair  # noqa: E402
+
+from ethereum_consensus_tpu.models.altair import helpers as ah  # noqa: E402
+from ethereum_consensus_tpu.models.altair.constants import (  # noqa: E402
+    PARTICIPATION_FLAG_WEIGHTS,
+)
+from ethereum_consensus_tpu.models.altair.epoch_processing import (  # noqa: E402
+    process_inactivity_updates,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.epoch_processing import (  # noqa: E402
+    process_effective_balance_updates,
+)
+from ethereum_consensus_tpu.ops import shuffle, sweeps  # noqa: E402
+
+
+def _scrambled_state():
+    """An altair state at epoch 2 with mixed participation/slashing/balances."""
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    state = state.copy()
+    state.slot = 2 * ctx.SLOTS_PER_EPOCH
+    rng = np.random.default_rng(11)
+    for i in range(16):
+        state.previous_epoch_participation[i] = int(rng.integers(0, 8))
+        state.inactivity_scores[i] = int(rng.integers(0, 50))
+        state.balances[i] = int(rng.integers(15, 40)) * 10**9
+    state.validators[3].slashed = True
+    state.validators[3].withdrawable_epoch = 100
+    state.validators[5].exit_epoch = 1  # exited before previous epoch
+    state.validators[9].effective_balance = 17 * 10**9
+    return state, ctx
+
+
+def test_shuffle_device_matches_host():
+    state, ctx = fresh_genesis_altair(16, "minimal")
+    seed = b"\x37" * 32
+    for count in (1, 2, 16, 100, 257):
+        indices = list(range(count))
+        host = h.compute_shuffled_indices(indices, seed, ctx)
+        device = shuffle.compute_shuffled_indices_device(indices, seed, ctx)
+        assert device == host, count
+        # spot-check per-index parity too
+        mapping = np.asarray(
+            shuffle.shuffled_indices_device(count, seed, ctx.SHUFFLE_ROUND_COUNT)
+        )
+        for i in (0, count // 2, count - 1):
+            assert mapping[i] == h.compute_shuffled_index(i, count, seed, ctx)
+
+
+def test_flag_deltas_device_matches_host():
+    state, ctx = _scrambled_state()
+    previous_epoch = h.get_previous_epoch(state, ctx)
+    packed = sweeps.pack_registry(state, previous_epoch)
+    total_active = h.get_total_active_balance(state, ctx)
+    is_leaking = ah.is_in_inactivity_leak(state, ctx)
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        host_rewards, host_penalties = ah.get_flag_index_deltas(
+            state, flag_index, ctx
+        )
+        dev_rewards, dev_penalties = sweeps.flag_deltas_device(
+            packed, flag_index, total_active, ctx, is_leaking
+        )
+        assert dev_rewards.tolist() == host_rewards, flag_index
+        assert dev_penalties.tolist() == host_penalties, flag_index
+
+
+def test_inactivity_updates_device_matches_host():
+    state, ctx = _scrambled_state()
+    previous_epoch = h.get_previous_epoch(state, ctx)
+    packed = sweeps.pack_registry(state, previous_epoch)
+    is_leaking = ah.is_in_inactivity_leak(state, ctx)
+    expected_state = state.copy()
+    process_inactivity_updates(expected_state, ctx)
+    got = sweeps.inactivity_updates_device(packed, ctx, is_leaking)
+    assert got.tolist() == list(expected_state.inactivity_scores)
+
+
+def test_inactivity_penalties_device_matches_host():
+    state, ctx = _scrambled_state()
+    previous_epoch = h.get_previous_epoch(state, ctx)
+    packed = sweeps.pack_registry(state, previous_epoch)
+    host_rewards, host_penalties = ah.get_inactivity_penalty_deltas(state, ctx)
+    got = sweeps.inactivity_penalties_device(
+        packed, ctx, ctx.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    )
+    assert got.tolist() == host_penalties
+    assert host_rewards == [0] * 16
+
+
+def test_effective_balance_updates_device_matches_host():
+    state, ctx = _scrambled_state()
+    packed = sweeps.pack_registry(state, h.get_previous_epoch(state, ctx))
+    expected_state = state.copy()
+    process_effective_balance_updates(expected_state, ctx)
+    got = sweeps.effective_balance_updates_device(packed, ctx)
+    assert got.tolist() == [
+        v.effective_balance for v in expected_state.validators
+    ]
