@@ -1,0 +1,102 @@
+"""Raw-snappy decompression, from scratch.
+
+Reference parity: the `snap` crate used only for spec-test-vector
+decompression (spec-tests/test_utils.rs:30-37). The official
+`consensus-spec-tests` vectors ship as `.ssz_snappy` files in snappy's RAW
+block format (not the framed streaming format): a uvarint uncompressed
+length followed by literal/copy tagged elements.
+"""
+
+from __future__ import annotations
+
+__all__ = ["decompress", "compress"]
+
+
+def _read_uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    value = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated snappy varint")
+        byte = data[pos]
+        pos += 1
+        value |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return value, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("snappy varint too long")
+
+
+def decompress(data: bytes) -> bytes:
+    """Decode a raw-format snappy block."""
+    expected_length, pos = _read_uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        element_type = tag & 0b11
+        if element_type == 0b00:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if extra > 4:
+                    raise ValueError("invalid literal length encoding")
+                length = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            if pos + length > n:
+                raise ValueError("truncated snappy literal")
+            out += data[pos : pos + length]
+            pos += length
+            continue
+        if element_type == 0b01:  # copy, 1-byte offset
+            length = ((tag >> 2) & 0b111) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif element_type == 0b10:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos : pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("invalid snappy copy offset")
+        # copies may overlap their own output (run-length behaviour)
+        start = len(out) - offset
+        for i in range(length):
+            out.append(out[start + i])
+    if len(out) != expected_length:
+        raise ValueError(
+            f"snappy length mismatch: header {expected_length}, got {len(out)}"
+        )
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    """Encode ``data`` as raw snappy using only literal elements — valid
+    (if uncompressed) output, enough to write fixtures for the harness."""
+    out = bytearray()
+    length = len(data)
+    while True:
+        byte = length & 0x7F
+        length >>= 7
+        if length:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            break
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos : pos + 65536]
+        clen = len(chunk) - 1
+        if clen < 60:
+            out.append(clen << 2)
+        else:  # tag 61 = two-byte little-endian length
+            out.append(61 << 2)
+            out += clen.to_bytes(2, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)

@@ -1,0 +1,40 @@
+"""BLS signing walkthrough.
+
+Reference parity: ethereum-consensus/examples/bls.rs — keygen, sign,
+verify, aggregate, aggregate-verify.
+"""
+
+import secrets
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ethereum_consensus_tpu.crypto import bls  # noqa: E402
+from ethereum_consensus_tpu.crypto.fields import R  # noqa: E402
+
+
+def main() -> None:
+    secret_keys = [bls.SecretKey(secrets.randbelow(R - 1) + 1) for _ in range(3)]
+    public_keys = [sk.public_key() for sk in secret_keys]
+    message = b"a message to sign"
+
+    signatures = [sk.sign(message) for sk in secret_keys]
+    for pk, sig in zip(public_keys, signatures):
+        assert bls.verify_signature(pk, message, sig)
+    print("3 individual signatures verify")
+
+    aggregate = bls.aggregate(signatures)
+    assert bls.fast_aggregate_verify(public_keys, message, aggregate)
+    print("fast_aggregate_verify over the shared message verifies")
+
+    messages = [b"msg-%d" % i for i in range(3)]
+    distinct = bls.aggregate(
+        [sk.sign(m) for sk, m in zip(secret_keys, messages)]
+    )
+    assert bls.aggregate_verify(public_keys, messages, distinct)
+    print("aggregate_verify over distinct messages verifies")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Deserialize a BeaconState from SSZ bytes (any fork, auto-detected).
+
+Reference parity: ethereum-consensus/examples/read_ssz.rs.
+
+Usage: ``python examples/read_ssz.py <state.ssz> [mainnet|minimal]``
+(without a file it round-trips a freshly built state).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.models import deneb  # noqa: E402
+from ethereum_consensus_tpu.types import BeaconState  # noqa: E402
+
+
+def main() -> None:
+    preset_name = sys.argv[2] if len(sys.argv) > 2 else "mainnet"
+    context = (
+        Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    )
+    if len(sys.argv) > 1:
+        raw = Path(sys.argv[1]).read_bytes()
+    else:
+        ns = deneb.build(context.preset)
+        raw = ns.BeaconState.serialize(ns.BeaconState(genesis_time=1234))
+        print(f"(no file given; using a synthetic {len(raw)}-byte deneb state)")
+
+    # fork detection tries newest→oldest, like the reference's serde
+    state = BeaconState.deserialize(raw, context.preset)
+    print(f"fork: {state.version()}")
+    print(f"slot: {state.slot}")
+    print(f"validators: {len(state.validators)}")
+    print(f"hash_tree_root: 0x{state.hash_tree_root().hex()}")
+
+
+if __name__ == "__main__":
+    main()

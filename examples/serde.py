@@ -1,0 +1,41 @@
+"""JSON presentation serde for chain containers.
+
+Reference parity: ethereum-consensus/examples/serde.rs — the
+consensus-specs JSON conventions (decimal-string u64s, 0x-hex byte strings)
+round-tripping through a container.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from ethereum_consensus_tpu.models.phase0.containers import (  # noqa: E402
+    Checkpoint,
+    Validator,
+)
+
+
+def main() -> None:
+    validator = Validator(
+        public_key=b"\xaa" * 48,
+        withdrawal_credentials=b"\x01" + b"\x00" * 31,
+        effective_balance=32_000_000_000,
+        activation_epoch=7,
+        exit_epoch=2**64 - 1,
+        withdrawable_epoch=2**64 - 1,
+    )
+    encoded = json.dumps(Validator.to_json(validator), indent=2)
+    print(encoded)
+    assert Validator.from_json(json.loads(encoded)) == validator
+
+    checkpoint = Checkpoint(epoch=3, root=b"\x0c" * 32)
+    blob = Checkpoint.to_json(checkpoint)
+    assert blob["epoch"] == "3"  # u64s are decimal strings
+    assert blob["root"].startswith("0x")
+    print(json.dumps(blob))
+
+
+if __name__ == "__main__":
+    main()

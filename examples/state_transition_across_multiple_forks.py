@@ -1,0 +1,65 @@
+"""Executor usage across a fork boundary.
+
+Reference parity: ethereum-consensus/examples/
+state_transition_across_multiple_forks.rs — build a chain on one fork, flip
+the fork epoch, and let `Executor.apply_block` run the upgrade inline.
+
+Run from the repo root: ``python examples/state_transition_across_multiple_forks.py``
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+sys.path.insert(0, str(Path(__file__).parent.parent / "tests"))
+
+from chain_utils import (  # noqa: E402 — shared toy-chain scaffolding
+    fresh_genesis,
+    produce_block,
+    produce_block_altair,
+)
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.executor import Executor  # noqa: E402
+from ethereum_consensus_tpu.models.altair import upgrade_to_altair  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.slot_processing import (  # noqa: E402
+    process_slots,
+)
+from ethereum_consensus_tpu.models.phase0.state_transition import (  # noqa: E402
+    Validation,
+    state_transition_block_in_slot,
+)
+
+
+def main() -> None:
+    state, _ = fresh_genesis(16, "minimal")
+    context = Context.for_minimal()
+    context.altair_fork_epoch = 1  # upgrade at epoch 1
+
+    executor = Executor(state.copy(), context)
+    scratch = state.copy()
+
+    # epoch 0 under phase0 rules
+    for slot in range(1, context.SLOTS_PER_EPOCH):
+        block = produce_block(scratch, slot, context)
+        executor.apply_block(block)
+        state_transition_block_in_slot(scratch, block, Validation.ENABLED, context)
+        print(f"applied phase0 block at slot {slot}")
+
+    # the first altair block lands exactly on the upgrade slot; the executor
+    # runs process_slots + upgrade_to_altair inline
+    fork_slot = context.SLOTS_PER_EPOCH
+    process_slots(scratch, fork_slot, context)
+    upgraded = upgrade_to_altair(scratch, context)
+    altair_block = produce_block_altair(upgraded, fork_slot, context)
+    executor.apply_block(altair_block)
+
+    print(
+        f"applied altair block at slot {fork_slot}; state is now "
+        f"{executor.state.version()} with root "
+        f"{executor.state.hash_tree_root().hex()[:16]}…"
+    )
+
+
+if __name__ == "__main__":
+    main()

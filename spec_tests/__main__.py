@@ -1,0 +1,30 @@
+"""CLI: ``SPEC_TEST_ROOT=/path/to/consensus-spec-tests python -m spec_tests``."""
+
+import json
+import os
+import sys
+
+from .harness import run_all
+
+
+def main() -> int:
+    root = os.environ.get("SPEC_TEST_ROOT", "consensus-spec-tests")
+    pattern = sys.argv[1] if len(sys.argv) > 1 else None
+    if not os.path.isdir(os.path.join(root, "tests")):
+        print(
+            f"no vectors at {root!r} (set SPEC_TEST_ROOT to a "
+            "consensus-spec-tests checkout)",
+            file=sys.stderr,
+        )
+        return 2
+    results = run_all(root, pattern)
+    print(json.dumps(
+        {k: v for k, v in results.items() if k != "failures"}, indent=2
+    ))
+    for failure in results["failures"][:50]:
+        print("FAIL:", failure, file=sys.stderr)
+    return 1 if results["fail"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,151 @@
+"""Discovery + dispatch for consensus-spec-tests vectors.
+
+See package docstring. Each leaf directory under
+``tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>`` becomes one
+``TestCase``; ``execute`` dispatches on the runner name like the
+reference's TestCase::execute (spec-tests/test_case.rs:36-56).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import lru_cache
+
+import yaml
+
+from ethereum_consensus_tpu.config import Context
+from ethereum_consensus_tpu.utils import snappy
+
+__all__ = ["TestCase", "collect_tests", "run_all", "SKIPPED_RUNNERS", "IGNORED_RUNNERS"]
+
+FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb", "electra")
+
+# the reference's policy (test_meta.rs:85-92,205-219): fork_choice and sync
+# are collected but ignored (not implemented), ssz_generic and unknown fork
+# dirs are skipped outright
+IGNORED_RUNNERS = ("fork_choice", "sync")
+SKIPPED_RUNNERS = ("ssz_generic",)
+SKIPPED_FORKS = ("eip6110", "whisk", "eip7594", "fulu")
+# light client: only single_merkle_proof is supported (test_meta.rs:207-209)
+LIGHT_CLIENT_HANDLED = ("single_merkle_proof",)
+
+
+@lru_cache(maxsize=None)
+def _context(config: str) -> Context:
+    return Context.for_minimal() if config == "minimal" else Context.for_mainnet()
+
+
+@dataclass
+class TestCase:
+    """(test_case.rs:20) — one leaf vector directory."""
+
+    config: str
+    fork: str
+    runner: str
+    handler: str
+    suite: str
+    case: str
+    path: str
+
+    @property
+    def name(self) -> str:
+        return "::".join(
+            (self.config, self.fork, self.runner, self.handler, self.suite, self.case)
+        )
+
+    @property
+    def context(self) -> Context:
+        return _context(self.config)
+
+    # -- fixture loading (test_utils.rs:30-49) -------------------------------
+    def ssz_snappy(self, name: str) -> bytes | None:
+        path = os.path.join(self.path, f"{name}.ssz_snappy")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return snappy.decompress(f.read())
+
+    def yaml(self, name: str):
+        path = os.path.join(self.path, f"{name}.yaml")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return yaml.safe_load(f)
+
+    def fork_module(self):
+        import importlib
+
+        return importlib.import_module(f"ethereum_consensus_tpu.models.{self.fork}")
+
+    def containers(self):
+        return self.fork_module().build(self.context.preset)
+
+    # -- dispatch (test_case.rs:37-56) ---------------------------------------
+    def execute(self) -> str:
+        """Run the case; returns "pass"/"ignored"; raises on failure."""
+        from . import runners
+
+        if self.runner in IGNORED_RUNNERS:
+            return "ignored"
+        if self.runner == "light_client" and self.handler not in LIGHT_CLIENT_HANDLED:
+            return "ignored"
+        dispatch = getattr(runners, self.runner, None)
+        if dispatch is None:
+            raise NotImplementedError(f"no runner for {self.runner}")
+        dispatch.run(self)
+        return "pass"
+
+
+def collect_tests(root: str) -> list[TestCase]:
+    """Walk ``root``/tests/** into TestCases (main.rs:56-102)."""
+    tests: list[TestCase] = []
+    base = os.path.join(root, "tests")
+    if not os.path.isdir(base):
+        return tests
+    for config in sorted(os.listdir(base)):
+        config_dir = os.path.join(base, config)
+        if not os.path.isdir(config_dir):
+            continue
+        for fork in sorted(os.listdir(config_dir)):
+            if fork in SKIPPED_FORKS or fork not in FORKS:
+                continue
+            fork_dir = os.path.join(config_dir, fork)
+            for runner in sorted(os.listdir(fork_dir)):
+                if runner in SKIPPED_RUNNERS:
+                    continue
+                runner_dir = os.path.join(fork_dir, runner)
+                for handler in sorted(os.listdir(runner_dir)):
+                    handler_dir = os.path.join(runner_dir, handler)
+                    for suite in sorted(os.listdir(handler_dir)):
+                        suite_dir = os.path.join(handler_dir, suite)
+                        if not os.path.isdir(suite_dir):
+                            continue
+                        for case in sorted(os.listdir(suite_dir)):
+                            case_dir = os.path.join(suite_dir, case)
+                            if os.path.isdir(case_dir):
+                                tests.append(
+                                    TestCase(
+                                        config, fork, runner, handler, suite,
+                                        case, case_dir,
+                                    )
+                                )
+    return tests
+
+
+def run_all(root: str, pattern: str | None = None) -> dict:
+    """Run every collected case; returns {pass, fail, ignored, failures}."""
+    results = {"pass": 0, "fail": 0, "ignored": 0, "failures": []}
+    for test in collect_tests(root):
+        if pattern and pattern not in test.name:
+            continue
+        try:
+            outcome = test.execute()
+        except NotImplementedError:
+            results["ignored"] += 1
+        except Exception as exc:  # noqa: BLE001 — report, keep running
+            results["fail"] += 1
+            results["failures"].append(f"{test.name}: {exc}")
+        else:
+            results[outcome if outcome in results else "pass"] += 1
+    return results

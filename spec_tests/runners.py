@@ -1,0 +1,472 @@
+"""Per-runner dispatch for the conformance harness.
+
+Reference parity: spec-tests/runners/*.rs (2,927 LoC, 16 runners). Each
+``run(test)`` raises on mismatch. Negative vectors (no post fixture) must
+error (runners/operations.rs:93-103).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from ethereum_consensus_tpu.crypto import bls as bls_crypto
+from ethereum_consensus_tpu.error import StateTransitionError
+from ethereum_consensus_tpu.ssz import prove as ssz_prove
+
+__all__ = [
+    "operations", "sanity", "epoch_processing", "finality", "random", "fork",
+    "genesis", "shuffling", "ssz_static", "rewards", "transition", "bls",
+    "kzg", "merkle_proof", "light_client",
+]
+
+
+def _load_state(test, name: str):
+    data = test.ssz_snappy(name)
+    if data is None:
+        return None
+    return test.containers().BeaconState.deserialize(data)
+
+
+def _assert_states_equal(state, expected) -> None:
+    if type(state).hash_tree_root(state) != type(expected).hash_tree_root(expected):
+        raise AssertionError("post state root mismatch")
+
+
+def _expect_error(fn) -> None:
+    try:
+        fn()
+    except (StateTransitionError, Exception):
+        return
+    raise AssertionError("expected the transition to error, but it succeeded")
+
+
+# -- operations (runners/operations.rs) --------------------------------------
+
+_OPERATION_FIXTURES = {
+    "attestation": ("attestation", "Attestation", "process_attestation"),
+    "attester_slashing": ("attester_slashing", "AttesterSlashing", "process_attester_slashing"),
+    "block_header": ("block", "BeaconBlock", "process_block_header"),
+    "deposit": ("deposit", "Deposit", "process_deposit"),
+    "proposer_slashing": ("proposer_slashing", "ProposerSlashing", "process_proposer_slashing"),
+    "voluntary_exit": ("voluntary_exit", "SignedVoluntaryExit", "process_voluntary_exit"),
+    "sync_aggregate": ("sync_aggregate", "SyncAggregate", "process_sync_aggregate"),
+    "execution_payload": ("execution_payload", "BeaconBlockBody", "process_execution_payload"),
+    "withdrawals": ("execution_payload", "ExecutionPayload", "process_withdrawals"),
+    "bls_to_execution_change": ("address_change", "SignedBlsToExecutionChange", "process_bls_to_execution_change"),
+    "deposit_receipt": ("deposit_receipt", "DepositReceipt", "process_deposit_receipt"),
+    "withdrawal_request": ("execution_layer_withdrawal_request", "ExecutionLayerWithdrawalRequest", "process_execution_layer_withdrawal_request"),
+    "consolidation": ("consolidation", "SignedConsolidation", "process_consolidation"),
+}
+
+
+class operations(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        if test.handler not in _OPERATION_FIXTURES:
+            raise NotImplementedError(f"operations handler {test.handler}")
+        fixture, container_name, fn_name = _OPERATION_FIXTURES[test.handler]
+        ns = test.containers()
+        mod = test.fork_module()
+        pre = _load_state(test, "pre")
+        post = _load_state(test, "post")
+        operation = getattr(ns, container_name).deserialize(
+            test.ssz_snappy(fixture)
+        )
+        context = test.context
+        if test.handler == "execution_payload":
+            meta = test.yaml("execution") or {}
+            context.execution_engine = bool(meta.get("execution_valid", True))
+        process = getattr(mod.block_processing, fn_name)
+        try:
+            if post is None:
+                _expect_error(lambda: process(pre, operation, context))
+            else:
+                process(pre, operation, context)
+                _assert_states_equal(pre, post)
+        finally:
+            context.execution_engine = True
+
+
+# -- sanity (runners/sanity.rs:25-50) ----------------------------------------
+
+
+class sanity(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        mod = test.fork_module()
+        ns = test.containers()
+        pre = _load_state(test, "pre")
+        post = _load_state(test, "post")
+        if test.handler == "slots":
+            slots = test.yaml("slots")
+            target = pre.slot + int(slots)
+            mod.slot_processing.process_slots(pre, target, test.context)
+            _assert_states_equal(pre, post)
+            return
+        if test.handler == "blocks":
+            meta = test.yaml("meta") or {}
+            count = int(meta.get("blocks_count", 0))
+            blocks = [
+                ns.SignedBeaconBlock.deserialize(test.ssz_snappy(f"blocks_{i}"))
+                for i in range(count)
+            ]
+            transition = mod.state_transition
+
+            def apply_all():
+                for block in blocks:
+                    transition.state_transition(pre, block, test.context)
+
+            if post is None:
+                _expect_error(apply_all)
+            else:
+                apply_all()
+                _assert_states_equal(pre, post)
+            return
+        raise NotImplementedError(f"sanity handler {test.handler}")
+
+
+# -- epoch_processing (runners/epoch_processing.rs:44-235) -------------------
+
+
+class epoch_processing(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        mod = test.fork_module()
+        fn = getattr(mod.epoch_processing, f"process_{test.handler}", None)
+        if fn is None:
+            raise NotImplementedError(f"epoch_processing handler {test.handler}")
+        pre = _load_state(test, "pre")
+        post = _load_state(test, "post")
+        if post is None:
+            _expect_error(lambda: fn(pre, test.context))
+        else:
+            fn(pre, test.context)
+            _assert_states_equal(pre, post)
+
+
+# -- finality / random (multi-block sanity shapes) ---------------------------
+
+
+class finality(SimpleNamespace):
+    run = staticmethod(lambda test: sanity.run(_as_blocks(test)))
+
+
+class random(SimpleNamespace):
+    run = staticmethod(lambda test: sanity.run(_as_blocks(test)))
+
+
+def _as_blocks(test):
+    clone = SimpleNamespace(**vars(test))
+    clone.handler = "blocks"
+    clone.containers = test.containers
+    clone.fork_module = test.fork_module
+    clone.ssz_snappy = test.ssz_snappy
+    clone.yaml = test.yaml
+    clone.context = test.context
+    return clone
+
+
+# -- fork upgrades (runners/fork.rs) -----------------------------------------
+
+
+class fork(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        import importlib
+
+        meta = test.yaml("meta")
+        post_fork = meta["post_fork"]
+        pre_mod = {
+            "altair": "phase0", "bellatrix": "altair", "capella": "bellatrix",
+            "deneb": "capella", "electra": "deneb",
+        }[post_fork]
+        pre_module = importlib.import_module(
+            f"ethereum_consensus_tpu.models.{pre_mod}"
+        )
+        post_module = importlib.import_module(
+            f"ethereum_consensus_tpu.models.{post_fork}"
+        )
+        pre = pre_module.build(test.context.preset).BeaconState.deserialize(
+            test.ssz_snappy("pre")
+        )
+        post = post_module.build(test.context.preset).BeaconState.deserialize(
+            test.ssz_snappy("post")
+        )
+        upgrade = getattr(post_module, f"upgrade_to_{post_fork}")
+        upgraded = upgrade(pre, test.context)
+        _assert_states_equal(upgraded, post)
+
+
+# -- genesis (runners/genesis.rs:65,292) -------------------------------------
+
+
+class genesis(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        mod = test.fork_module()
+        ns = test.containers()
+        if test.handler == "validity":
+            state = _load_state(test, "genesis")
+            expected = bool(test.yaml("is_valid"))
+            got = mod.genesis.is_valid_genesis_state(state, test.context)
+            if got != expected:
+                raise AssertionError(f"genesis validity {got} != {expected}")
+            return
+        if test.handler == "initialization":
+            eth1 = test.yaml("eth1.yaml") or test.yaml("eth1")
+            meta = test.yaml("meta") or {}
+            count = int(meta.get("deposits_count", 0))
+            deposits = [
+                ns.Deposit.deserialize(test.ssz_snappy(f"deposits_{i}"))
+                for i in range(count)
+            ]
+            kwargs = {}
+            header_bytes = test.ssz_snappy("execution_payload_header")
+            if header_bytes is not None:
+                kwargs["execution_payload_header"] = (
+                    ns.ExecutionPayloadHeader.deserialize(header_bytes)
+                )
+            state = mod.genesis.initialize_beacon_state_from_eth1(
+                bytes.fromhex(str(eth1["eth1_block_hash"]).removeprefix("0x")),
+                int(eth1["eth1_timestamp"]),
+                deposits,
+                test.context,
+                **kwargs,
+            )
+            expected = _load_state(test, "state")
+            _assert_states_equal(state, expected)
+            return
+        raise NotImplementedError(f"genesis handler {test.handler}")
+
+
+# -- shuffling (runners/shuffling.rs:33-43) ----------------------------------
+
+
+class shuffling(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        from ethereum_consensus_tpu.models.phase0 import helpers as h
+
+        mapping = test.yaml("mapping")
+        seed = bytes.fromhex(str(mapping["seed"]).removeprefix("0x"))
+        count = int(mapping["count"])
+        expected = [int(x) for x in mapping["mapping"]]
+        # both shuffle implementations, like the reference
+        # (runners/shuffling.rs:33-43)
+        per_index = [
+            h.compute_shuffled_index(i, count, seed, test.context)
+            for i in range(count)
+        ]
+        whole = h.compute_shuffled_indices(list(range(count)), seed, test.context)
+        if whole != per_index:
+            raise AssertionError("whole-list shuffle disagrees with per-index")
+        if per_index != expected:
+            raise AssertionError("shuffle mapping mismatch")
+
+
+# -- ssz_static (runners/ssz_static.rs:26-36) --------------------------------
+
+
+class ssz_static(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        ns = test.containers()
+        typ = getattr(ns, test.handler, None)
+        if typ is None:
+            raise NotImplementedError(f"ssz_static type {test.handler}")
+        roots = test.yaml("roots")
+        raw = test.ssz_snappy("serialized")
+        value = typ.deserialize(raw)
+        if typ.serialize(value) != raw:
+            raise AssertionError("serialize roundtrip mismatch")
+        expected_root = bytes.fromhex(str(roots["root"]).removeprefix("0x"))
+        if typ.hash_tree_root(value) != expected_root:
+            raise AssertionError("hash_tree_root mismatch")
+
+
+# -- rewards (runners/rewards.rs) --------------------------------------------
+
+
+class rewards(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        raise NotImplementedError("rewards runner: Deltas comparison")
+
+
+# -- transition (runners/transition.rs:90-120) -------------------------------
+
+
+class transition(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        import importlib
+
+        from ethereum_consensus_tpu.executor import Executor
+        from ethereum_consensus_tpu.types import BeaconState, SignedBeaconBlock
+
+        meta = test.yaml("meta")
+        post_fork = meta["post_fork"]
+        fork_epoch = int(meta["fork_epoch"])
+        count = int(meta["blocks_count"])
+        fork_block = meta.get("fork_block")
+
+        pre_mod = {
+            "altair": "phase0", "bellatrix": "altair", "capella": "bellatrix",
+            "deneb": "capella", "electra": "deneb",
+        }[post_fork]
+        context = test.context
+        # inject the fork epoch (runners/transition.rs set_fork_epochs:62)
+        saved = {}
+        for name in ("altair", "bellatrix", "capella", "deneb", "electra"):
+            saved[name] = getattr(context, f"{name}_fork_epoch")
+        order = ["altair", "bellatrix", "capella", "deneb", "electra"]
+        for name in order:
+            setattr(
+                context,
+                f"{name}_fork_epoch",
+                0 if order.index(name) < order.index(post_fork) else 2**64 - 1,
+            )
+        setattr(context, f"{post_fork}_fork_epoch", fork_epoch)
+        try:
+            pre_ns = importlib.import_module(
+                f"ethereum_consensus_tpu.models.{pre_mod}"
+            ).build(context.preset)
+            post_ns = test.containers()
+            pre = pre_ns.BeaconState.deserialize(test.ssz_snappy("pre"))
+            executor = Executor(
+                BeaconState.wrap(pre, context.preset), context
+            )
+            for i in range(count):
+                raw = test.ssz_snappy(f"blocks_{i}")
+                if fork_block is not None and i <= int(fork_block):
+                    block = pre_ns.SignedBeaconBlock.deserialize(raw)
+                else:
+                    block = post_ns.SignedBeaconBlock.deserialize(raw)
+                executor.apply_block(block)
+            expected = post_ns.BeaconState.deserialize(test.ssz_snappy("post"))
+            _assert_states_equal(executor.state.data, expected)
+        finally:
+            for name, value in saved.items():
+                setattr(context, f"{name}_fork_epoch", value)
+
+
+# -- bls (runners/bls.rs) ----------------------------------------------------
+
+
+class bls(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        data = test.yaml("data")
+        if data is None:
+            raise NotImplementedError("bls vector without data.yaml")
+        handler = test.handler
+        inp, expected = data["input"], data["output"]
+
+        def pk(x):
+            return bls_crypto.PublicKey.from_bytes(
+                bytes.fromhex(str(x).removeprefix("0x"))
+            )
+
+        def sig(x):
+            return bls_crypto.Signature.from_bytes(
+                bytes.fromhex(str(x).removeprefix("0x"))
+            )
+
+        def msg(x):
+            return bytes.fromhex(str(x).removeprefix("0x"))
+
+        try:
+            if handler == "sign":
+                got = (
+                    bls_crypto.SecretKey(
+                        int(str(inp["privkey"]).removeprefix("0x"), 16)
+                    )
+                    .sign(msg(inp["message"]))
+                    .to_bytes()
+                )
+                ok = got == bytes.fromhex(str(expected).removeprefix("0x"))
+            elif handler == "verify":
+                ok = bls_crypto.verify_signature(
+                    pk(inp["pubkey"]), msg(inp["message"]), sig(inp["signature"])
+                ) == bool(expected)
+            elif handler == "aggregate":
+                got = bls_crypto.aggregate([sig(s) for s in inp]).to_bytes()
+                ok = got == bytes.fromhex(str(expected).removeprefix("0x"))
+            elif handler == "aggregate_verify":
+                ok = bls_crypto.aggregate_verify(
+                    [pk(p) for p in inp["pubkeys"]],
+                    [msg(m) for m in inp["messages"]],
+                    sig(inp["signature"]),
+                ) == bool(expected)
+            elif handler == "fast_aggregate_verify":
+                ok = bls_crypto.fast_aggregate_verify(
+                    [pk(p) for p in inp["pubkeys"]],
+                    msg(inp["message"]),
+                    sig(inp["signature"]),
+                ) == bool(expected)
+            elif handler == "eth_aggregate_pubkeys":
+                got = bls_crypto.eth_aggregate_public_keys(
+                    [pk(p) for p in inp]
+                ).to_bytes()
+                ok = got == bytes.fromhex(str(expected).removeprefix("0x"))
+            elif handler == "eth_fast_aggregate_verify":
+                ok = bls_crypto.eth_fast_aggregate_verify(
+                    [pk(p) for p in inp["pubkeys"]],
+                    msg(inp["message"]),
+                    sig(inp["signature"]),
+                ) == bool(expected)
+            else:
+                raise NotImplementedError(f"bls handler {handler}")
+        except NotImplementedError:
+            raise
+        except Exception:
+            # invalid-input vectors expect output null/false
+            ok = expected in (None, False)
+        if not ok:
+            raise AssertionError(f"bls {handler} mismatch")
+
+
+# -- kzg (runners/kzg.rs:18-23) ----------------------------------------------
+
+
+class kzg(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        raise NotImplementedError(
+            "kzg runner needs the ceremony trusted setup loaded"
+        )
+
+
+# -- merkle / light-client proofs (runners/{merkle_proof,light_client}.rs) ---
+
+
+class merkle_proof(SimpleNamespace):
+    @staticmethod
+    def run(test) -> None:
+        from ethereum_consensus_tpu.ssz import (
+            is_valid_merkle_branch_for_generalized_index,
+        )
+
+        proof = test.yaml("proof")
+        ns = test.containers()
+        typ = getattr(ns, test.handler, None) or getattr(
+            ns, "BeaconBlockBody", None
+        )
+        obj = typ.deserialize(test.ssz_snappy("object"))
+        leaf = bytes.fromhex(str(proof["leaf"]).removeprefix("0x"))
+        branch = [
+            bytes.fromhex(str(b).removeprefix("0x")) for b in proof["branch"]
+        ]
+        gindex = int(proof["leaf_index"])
+        root = typ.hash_tree_root(obj)
+        if not is_valid_merkle_branch_for_generalized_index(
+            leaf, branch, gindex, root
+        ):
+            raise AssertionError("merkle branch does not verify")
+        # and our own prover reproduces the branch
+        if ssz_prove(typ, obj, gindex) != branch:
+            raise AssertionError("ssz.prove branch mismatch")
+
+
+class light_client(SimpleNamespace):
+    run = staticmethod(merkle_proof.run)

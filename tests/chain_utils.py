@@ -193,24 +193,51 @@ def _block_root_at_or_latest(state, slot: int) -> bytes:
 
 
 # ---------------------------------------------------------------------------
-# altair
+# post-phase0 forks — one generic genesis/payload/block factory
+# (forks differ only in module, genesis payload header, and body extras)
 # ---------------------------------------------------------------------------
 
+GENESIS_PAYLOAD_BLOCK_HASH = b"\x77" * 32
 
-@functools.lru_cache(maxsize=4)
-def cached_genesis_altair(validator_count: int, preset_name: str):
-    from ethereum_consensus_tpu.models.altair import genesis as altair_genesis
+# forks whose genesis takes an execution payload header
+_PAYLOAD_FORKS = ("bellatrix", "capella", "deneb", "electra")
 
+
+def _fork_module(fork_name: str):
+    import importlib
+
+    return importlib.import_module(f"ethereum_consensus_tpu.models.{fork_name}")
+
+
+def make_genesis_payload_header(context, fork_name: str = "bellatrix"):
+    """A non-default genesis ExecutionPayloadHeader (post-merge genesis)."""
+    ns = _fork_module(fork_name).build(context.preset)
+    return ns.ExecutionPayloadHeader(
+        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
+        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
+        prev_randao=ETH1_BLOCK_HASH,
+    )
+
+
+@functools.lru_cache(maxsize=24)
+def _cached_genesis_fork(fork_name: str, validator_count: int, preset_name: str):
+    mod = _fork_module(fork_name)
     context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
     deposits = make_deposits(validator_count, context)
-    state = altair_genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context
+    kwargs = {}
+    if fork_name in _PAYLOAD_FORKS:
+        kwargs["execution_payload_header"] = make_genesis_payload_header(
+            context, fork_name
+        )
+    state = mod.genesis.initialize_beacon_state_from_eth1(
+        ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context, **kwargs
     )
     return state, context
 
 
-def fresh_genesis_altair(validator_count: int = 64, preset_name: str = "minimal"):
-    state, context = cached_genesis_altair(validator_count, preset_name)
+def fresh_genesis_fork(fork_name: str, validator_count: int = 64,
+                       preset_name: str = "minimal"):
+    state, context = _cached_genesis_fork(fork_name, validator_count, preset_name)
     return state.copy(), context
 
 
@@ -249,24 +276,62 @@ def make_sync_aggregate(state, context, participation=1.0):
     )
 
 
-def produce_block_altair(state, slot: int, context, attestations=()):
-    """altair produce_block: advances state, builds body with attestations +
-    a full sync aggregate, fills the post-state root, and signs."""
-    from ethereum_consensus_tpu.models.altair import build as altair_build
-    from ethereum_consensus_tpu.models.altair.block_processing import process_block
-    from ethereum_consensus_tpu.models.altair.slot_processing import process_slots
+def make_execution_payload_fork(fork_name: str, state, context, block_number=1,
+                                **extra_fields):
+    """A payload valid for ``state`` at its current slot: parent hash chains,
+    prev_randao matches, timestamp matches; capella+ carries the expected
+    withdrawals."""
+    mod = _fork_module(fork_name)
+    ns = mod.build(context.preset)
+    epoch = state.slot // context.SLOTS_PER_EPOCH
+    fields = dict(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=h.get_randao_mix(state, epoch),
+        block_number=block_number,
+        timestamp=mod.helpers.compute_timestamp_at_slot(state, state.slot, context),
+        block_hash=bls.hash(b"exec-block-%s-%d" % (fork_name.encode(), int(state.slot))),
+    )
+    if fork_name == "capella" or fork_name == "deneb":
+        from ethereum_consensus_tpu.models.capella.block_processing import (
+            get_expected_withdrawals,
+        )
+
+        fields["withdrawals"] = get_expected_withdrawals(state, context)
+    elif fork_name == "electra":
+        from ethereum_consensus_tpu.models.electra.block_processing import (
+            get_expected_withdrawals as electra_withdrawals,
+        )
+
+        fields["withdrawals"] = electra_withdrawals(state, context)[0]
+    fields.update(extra_fields)
+    return ns.ExecutionPayload(**fields)
+
+
+def produce_block_fork(fork_name: str, state, slot: int, context,
+                       attestations=(), payload_fields=None, **body_extras):
+    """Generic produce_block for altair+ forks: advances the state, builds a
+    body with attestations + a full sync aggregate (+ a chained execution
+    payload on bellatrix+ and any fork-specific ``body_extras``), fills the
+    post-state root on a scratch copy, and signs."""
     from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
 
-    ns = altair_build(context.preset)
+    mod = _fork_module(fork_name)
+    ns = mod.build(context.preset)
     if state.slot < slot:
-        process_slots(state, slot, context)
+        mod.slot_processing.process_slots(state, slot, context)
     proposer_index = h.get_beacon_proposer_index(state, context)
-    body = ns.BeaconBlockBody(
+    body_kwargs = dict(
         randao_reveal=make_randao_reveal(state, slot, context),
         eth1_data=state.eth1_data.copy(),
         attestations=list(attestations),
         sync_aggregate=make_sync_aggregate(state, context),
     )
+    if fork_name in _PAYLOAD_FORKS:
+        body_kwargs["execution_payload"] = make_execution_payload_fork(
+            fork_name, state, context, block_number=slot, **(payload_fields or {})
+        )
+    body_kwargs.update(body_extras)
+    body = ns.BeaconBlockBody(**body_kwargs)
     block = ns.BeaconBlock(
         slot=slot,
         proposer_index=proposer_index,
@@ -274,7 +339,7 @@ def produce_block_altair(state, slot: int, context, attestations=()):
         body=body,
     )
     scratch = state.copy()
-    process_block(scratch, block, context)
+    mod.block_processing.process_block(scratch, block, context)
     block.state_root = type(scratch).hash_tree_root(scratch)
 
     domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
@@ -283,342 +348,96 @@ def produce_block_altair(state, slot: int, context, attestations=()):
     return ns.SignedBeaconBlock(message=block, signature=signature)
 
 
-# ---------------------------------------------------------------------------
-# bellatrix
-# ---------------------------------------------------------------------------
-
-GENESIS_PAYLOAD_BLOCK_HASH = b"\x77" * 32
+# -- per-fork conveniences (the names the test suites import) ----------------
 
 
-def make_genesis_payload_header(context):
-    """A non-default genesis ExecutionPayloadHeader (post-merge genesis)."""
-    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
-
-    ns = bellatrix_build(context.preset)
-    return ns.ExecutionPayloadHeader(
-        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
-        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
-        prev_randao=ETH1_BLOCK_HASH,
-    )
-
-
-@functools.lru_cache(maxsize=4)
-def cached_genesis_bellatrix(validator_count: int, preset_name: str):
-    from ethereum_consensus_tpu.models.bellatrix import genesis as bellatrix_genesis
-
-    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    deposits = make_deposits(validator_count, context)
-    state = bellatrix_genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH,
-        ETH1_TIMESTAMP,
-        deposits,
-        context,
-        execution_payload_header=make_genesis_payload_header(context),
-    )
-    return state, context
+def fresh_genesis_altair(validator_count: int = 64, preset_name: str = "minimal"):
+    return fresh_genesis_fork("altair", validator_count, preset_name)
 
 
 def fresh_genesis_bellatrix(validator_count: int = 64, preset_name: str = "minimal"):
-    state, context = cached_genesis_bellatrix(validator_count, preset_name)
-    return state.copy(), context
-
-
-def make_execution_payload(state, context, block_number=1):
-    """A payload valid for ``state`` at its current slot (bellatrix checks:
-    parent hash chains, prev_randao matches, timestamp matches)."""
-    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
-    from ethereum_consensus_tpu.models.bellatrix import helpers as bh
-
-    ns = bellatrix_build(context.preset)
-    epoch = state.slot // context.SLOTS_PER_EPOCH
-    return ns.ExecutionPayload(
-        parent_hash=state.latest_execution_payload_header.block_hash,
-        prev_randao=h.get_randao_mix(state, epoch),
-        block_number=block_number,
-        timestamp=bh.compute_timestamp_at_slot(state, state.slot, context),
-        block_hash=bls.hash(b"exec-block-%d" % int(state.slot)),
-    )
-
-
-def produce_block_bellatrix(state, slot: int, context, attestations=()):
-    """bellatrix produce_block: attestations + sync aggregate + a chained
-    execution payload."""
-    from ethereum_consensus_tpu.models.bellatrix import build as bellatrix_build
-    from ethereum_consensus_tpu.models.bellatrix.block_processing import process_block
-    from ethereum_consensus_tpu.models.bellatrix.slot_processing import process_slots
-    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
-
-    ns = bellatrix_build(context.preset)
-    if state.slot < slot:
-        process_slots(state, slot, context)
-    proposer_index = h.get_beacon_proposer_index(state, context)
-    body = ns.BeaconBlockBody(
-        randao_reveal=make_randao_reveal(state, slot, context),
-        eth1_data=state.eth1_data.copy(),
-        attestations=list(attestations),
-        sync_aggregate=make_sync_aggregate(state, context),
-        execution_payload=make_execution_payload(state, context, block_number=slot),
-    )
-    block = ns.BeaconBlock(
-        slot=slot,
-        proposer_index=proposer_index,
-        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
-        body=body,
-    )
-    scratch = state.copy()
-    process_block(scratch, block, context)
-    block.state_root = type(scratch).hash_tree_root(scratch)
-
-    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
-    root = compute_signing_root(ns.BeaconBlock, block, domain)
-    signature = secret_key(proposer_index).sign(root).to_bytes()
-    return ns.SignedBeaconBlock(message=block, signature=signature)
-
-
-# ---------------------------------------------------------------------------
-# capella
-# ---------------------------------------------------------------------------
-
-
-def make_genesis_payload_header_capella(context):
-    from ethereum_consensus_tpu.models.capella import build as capella_build
-
-    ns = capella_build(context.preset)
-    return ns.ExecutionPayloadHeader(
-        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
-        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
-        prev_randao=ETH1_BLOCK_HASH,
-    )
-
-
-@functools.lru_cache(maxsize=4)
-def cached_genesis_capella(validator_count: int, preset_name: str):
-    from ethereum_consensus_tpu.models.capella import genesis as capella_genesis
-
-    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    deposits = make_deposits(validator_count, context)
-    state = capella_genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH,
-        ETH1_TIMESTAMP,
-        deposits,
-        context,
-        execution_payload_header=make_genesis_payload_header_capella(context),
-    )
-    return state, context
+    return fresh_genesis_fork("bellatrix", validator_count, preset_name)
 
 
 def fresh_genesis_capella(validator_count: int = 64, preset_name: str = "minimal"):
-    state, context = cached_genesis_capella(validator_count, preset_name)
-    return state.copy(), context
+    return fresh_genesis_fork("capella", validator_count, preset_name)
+
+
+def fresh_genesis_deneb(validator_count: int = 64, preset_name: str = "minimal"):
+    return fresh_genesis_fork("deneb", validator_count, preset_name)
+
+
+def fresh_genesis_electra(validator_count: int = 64, preset_name: str = "minimal"):
+    return fresh_genesis_fork("electra", validator_count, preset_name)
+
+
+def make_genesis_payload_header_capella(context):
+    return make_genesis_payload_header(context, "capella")
+
+
+def make_genesis_payload_header_deneb(context):
+    return make_genesis_payload_header(context, "deneb")
+
+
+def make_genesis_payload_header_electra(context):
+    return make_genesis_payload_header(context, "electra")
+
+
+def make_execution_payload(state, context, block_number=1):
+    return make_execution_payload_fork("bellatrix", state, context, block_number)
 
 
 def make_execution_payload_capella(state, context, block_number=1):
-    """Capella payload: bellatrix checks + the expected-withdrawals list."""
-    from ethereum_consensus_tpu.models.capella import build as capella_build
-    from ethereum_consensus_tpu.models.capella import helpers as ch
-    from ethereum_consensus_tpu.models.capella.block_processing import (
-        get_expected_withdrawals,
+    return make_execution_payload_fork("capella", state, context, block_number)
+
+
+def make_execution_payload_deneb(state, context, block_number=1):
+    return make_execution_payload_fork("deneb", state, context, block_number)
+
+
+def make_execution_payload_electra(state, context, block_number=1,
+                                   deposit_receipts=(), withdrawal_requests=()):
+    return make_execution_payload_fork(
+        "electra", state, context, block_number,
+        deposit_receipts=list(deposit_receipts),
+        withdrawal_requests=list(withdrawal_requests),
     )
 
-    ns = capella_build(context.preset)
-    epoch = state.slot // context.SLOTS_PER_EPOCH
-    return ns.ExecutionPayload(
-        parent_hash=state.latest_execution_payload_header.block_hash,
-        prev_randao=h.get_randao_mix(state, epoch),
-        block_number=block_number,
-        timestamp=ch.compute_timestamp_at_slot(state, state.slot, context),
-        block_hash=bls.hash(b"exec-block-capella-%d" % int(state.slot)),
-        withdrawals=get_expected_withdrawals(state, context),
-    )
+
+def produce_block_altair(state, slot: int, context, attestations=()):
+    return produce_block_fork("altair", state, slot, context, attestations)
+
+
+def produce_block_bellatrix(state, slot: int, context, attestations=()):
+    return produce_block_fork("bellatrix", state, slot, context, attestations)
 
 
 def produce_block_capella(state, slot: int, context, attestations=(),
                           bls_to_execution_changes=()):
-    from ethereum_consensus_tpu.models.capella import build as capella_build
-    from ethereum_consensus_tpu.models.capella.block_processing import process_block
-    from ethereum_consensus_tpu.models.capella.slot_processing import process_slots
-    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
-
-    ns = capella_build(context.preset)
-    if state.slot < slot:
-        process_slots(state, slot, context)
-    proposer_index = h.get_beacon_proposer_index(state, context)
-    body = ns.BeaconBlockBody(
-        randao_reveal=make_randao_reveal(state, slot, context),
-        eth1_data=state.eth1_data.copy(),
-        attestations=list(attestations),
-        sync_aggregate=make_sync_aggregate(state, context),
-        execution_payload=make_execution_payload_capella(
-            state, context, block_number=slot
-        ),
+    return produce_block_fork(
+        "capella", state, slot, context, attestations,
         bls_to_execution_changes=list(bls_to_execution_changes),
-    )
-    block = ns.BeaconBlock(
-        slot=slot,
-        proposer_index=proposer_index,
-        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
-        body=body,
-    )
-    scratch = state.copy()
-    process_block(scratch, block, context)
-    block.state_root = type(scratch).hash_tree_root(scratch)
-
-    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
-    root = compute_signing_root(ns.BeaconBlock, block, domain)
-    signature = secret_key(proposer_index).sign(root).to_bytes()
-    return ns.SignedBeaconBlock(message=block, signature=signature)
-
-
-# ---------------------------------------------------------------------------
-# deneb
-# ---------------------------------------------------------------------------
-
-
-def make_genesis_payload_header_deneb(context):
-    from ethereum_consensus_tpu.models.deneb import build as deneb_build
-
-    ns = deneb_build(context.preset)
-    return ns.ExecutionPayloadHeader(
-        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
-        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
-        prev_randao=ETH1_BLOCK_HASH,
-    )
-
-
-@functools.lru_cache(maxsize=4)
-def cached_genesis_deneb(validator_count: int, preset_name: str):
-    from ethereum_consensus_tpu.models.deneb import genesis as deneb_genesis
-
-    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    deposits = make_deposits(validator_count, context)
-    state = deneb_genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH,
-        ETH1_TIMESTAMP,
-        deposits,
-        context,
-        execution_payload_header=make_genesis_payload_header_deneb(context),
-    )
-    return state, context
-
-
-def fresh_genesis_deneb(validator_count: int = 64, preset_name: str = "minimal"):
-    state, context = cached_genesis_deneb(validator_count, preset_name)
-    return state.copy(), context
-
-
-def make_execution_payload_deneb(state, context, block_number=1):
-    from ethereum_consensus_tpu.models.deneb import build as deneb_build
-    from ethereum_consensus_tpu.models.deneb import helpers as dh
-    from ethereum_consensus_tpu.models.capella.block_processing import (
-        get_expected_withdrawals,
-    )
-
-    ns = deneb_build(context.preset)
-    epoch = state.slot // context.SLOTS_PER_EPOCH
-    return ns.ExecutionPayload(
-        parent_hash=state.latest_execution_payload_header.block_hash,
-        prev_randao=h.get_randao_mix(state, epoch),
-        block_number=block_number,
-        timestamp=dh.compute_timestamp_at_slot(state, state.slot, context),
-        block_hash=bls.hash(b"exec-block-deneb-%d" % int(state.slot)),
-        withdrawals=get_expected_withdrawals(state, context),
     )
 
 
 def produce_block_deneb(state, slot: int, context, attestations=(),
                         blob_kzg_commitments=()):
-    from ethereum_consensus_tpu.models.deneb import build as deneb_build
-    from ethereum_consensus_tpu.models.deneb.block_processing import process_block
-    from ethereum_consensus_tpu.models.deneb.slot_processing import process_slots
-    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
-
-    ns = deneb_build(context.preset)
-    if state.slot < slot:
-        process_slots(state, slot, context)
-    proposer_index = h.get_beacon_proposer_index(state, context)
-    body = ns.BeaconBlockBody(
-        randao_reveal=make_randao_reveal(state, slot, context),
-        eth1_data=state.eth1_data.copy(),
-        attestations=list(attestations),
-        sync_aggregate=make_sync_aggregate(state, context),
-        execution_payload=make_execution_payload_deneb(
-            state, context, block_number=slot
-        ),
+    return produce_block_fork(
+        "deneb", state, slot, context, attestations,
         blob_kzg_commitments=list(blob_kzg_commitments),
     )
-    block = ns.BeaconBlock(
-        slot=slot,
-        proposer_index=proposer_index,
-        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
-        body=body,
-    )
-    scratch = state.copy()
-    process_block(scratch, block, context)
-    block.state_root = type(scratch).hash_tree_root(scratch)
-
-    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
-    root = compute_signing_root(ns.BeaconBlock, block, domain)
-    signature = secret_key(proposer_index).sign(root).to_bytes()
-    return ns.SignedBeaconBlock(message=block, signature=signature)
 
 
-# ---------------------------------------------------------------------------
-# electra
-# ---------------------------------------------------------------------------
-
-
-def make_genesis_payload_header_electra(context):
-    from ethereum_consensus_tpu.models.electra import build as electra_build
-
-    ns = electra_build(context.preset)
-    return ns.ExecutionPayloadHeader(
-        block_hash=GENESIS_PAYLOAD_BLOCK_HASH,
-        timestamp=ETH1_TIMESTAMP + context.genesis_delay,
-        prev_randao=ETH1_BLOCK_HASH,
-    )
-
-
-@functools.lru_cache(maxsize=4)
-def cached_genesis_electra(validator_count: int, preset_name: str):
-    from ethereum_consensus_tpu.models.electra import genesis as electra_genesis
-
-    context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    deposits = make_deposits(validator_count, context)
-    state = electra_genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH,
-        ETH1_TIMESTAMP,
-        deposits,
-        context,
-        execution_payload_header=make_genesis_payload_header_electra(context),
-    )
-    return state, context
-
-
-def fresh_genesis_electra(validator_count: int = 64, preset_name: str = "minimal"):
-    state, context = cached_genesis_electra(validator_count, preset_name)
-    return state.copy(), context
-
-
-def make_execution_payload_electra(state, context, block_number=1,
-                                   deposit_receipts=(), withdrawal_requests=()):
-    from ethereum_consensus_tpu.models.electra import build as electra_build
-    from ethereum_consensus_tpu.models.electra import helpers as eh
-    from ethereum_consensus_tpu.models.electra.block_processing import (
-        get_expected_withdrawals,
-    )
-
-    ns = electra_build(context.preset)
-    epoch = state.slot // context.SLOTS_PER_EPOCH
-    withdrawals, _ = get_expected_withdrawals(state, context)
-    return ns.ExecutionPayload(
-        parent_hash=state.latest_execution_payload_header.block_hash,
-        prev_randao=h.get_randao_mix(state, epoch),
-        block_number=block_number,
-        timestamp=eh.compute_timestamp_at_slot(state, state.slot, context),
-        block_hash=bls.hash(b"exec-block-electra-%d" % int(state.slot)),
-        withdrawals=withdrawals,
-        deposit_receipts=list(deposit_receipts),
-        withdrawal_requests=list(withdrawal_requests),
+def produce_block_electra(state, slot: int, context, attestations=(),
+                          deposit_receipts=(), withdrawal_requests=(),
+                          consolidations=()):
+    return produce_block_fork(
+        "electra", state, slot, context, attestations,
+        payload_fields=dict(
+            deposit_receipts=list(deposit_receipts),
+            withdrawal_requests=list(withdrawal_requests),
+        ),
+        consolidations=list(consolidations),
     )
 
 
@@ -669,43 +488,3 @@ def make_attestation_electra(state, slot: int, context, participation=1.0):
         committee_bits=committee_bits,
         signature=signature.to_bytes(),
     )
-
-
-def produce_block_electra(state, slot: int, context, attestations=(),
-                          deposit_receipts=(), withdrawal_requests=(),
-                          consolidations=()):
-    from ethereum_consensus_tpu.models.electra import build as electra_build
-    from ethereum_consensus_tpu.models.electra.block_processing import process_block
-    from ethereum_consensus_tpu.models.electra.slot_processing import process_slots
-    from ethereum_consensus_tpu.models.phase0.containers import BeaconBlockHeader
-
-    ns = electra_build(context.preset)
-    if state.slot < slot:
-        process_slots(state, slot, context)
-    proposer_index = h.get_beacon_proposer_index(state, context)
-    body = ns.BeaconBlockBody(
-        randao_reveal=make_randao_reveal(state, slot, context),
-        eth1_data=state.eth1_data.copy(),
-        attestations=list(attestations),
-        sync_aggregate=make_sync_aggregate(state, context),
-        execution_payload=make_execution_payload_electra(
-            state, context, block_number=slot,
-            deposit_receipts=deposit_receipts,
-            withdrawal_requests=withdrawal_requests,
-        ),
-        consolidations=list(consolidations),
-    )
-    block = ns.BeaconBlock(
-        slot=slot,
-        proposer_index=proposer_index,
-        parent_root=BeaconBlockHeader.hash_tree_root(state.latest_block_header),
-        body=body,
-    )
-    scratch = state.copy()
-    process_block(scratch, block, context)
-    block.state_root = type(scratch).hash_tree_root(scratch)
-
-    domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
-    root = compute_signing_root(ns.BeaconBlock, block, domain)
-    signature = secret_key(proposer_index).sign(root).to_bytes()
-    return ns.SignedBeaconBlock(message=block, signature=signature)

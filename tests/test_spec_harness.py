@@ -1,0 +1,142 @@
+"""Conformance-harness tests: the snappy codec, discovery, and runner
+dispatch — exercised against locally synthesized vector fixtures (the
+official tarballs aren't available offline; SPEC_TEST_ROOT enables the real
+ones through the same code path)."""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from chain_utils import fresh_genesis  # noqa: E402
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.models import phase0  # noqa: E402
+from ethereum_consensus_tpu.utils import snappy  # noqa: E402
+from spec_tests import collect_tests, run_all  # noqa: E402
+
+
+def test_snappy_roundtrip_and_copies():
+    # literal-only roundtrip through our own compressor
+    for payload in (b"", b"a", b"hello world" * 500, os.urandom(70000)):
+        assert snappy.decompress(snappy.compress(payload)) == payload
+
+    # hand-built stream with a copy element (offset 5, len 10 → overlapping
+    # run-length copy), the case a literal-only roundtrip can't reach
+    stream = bytearray()
+    stream += bytes([15])  # uncompressed length 15
+    stream += bytes([(5 - 1) << 2]) + b"abcde"  # literal "abcde"
+    stream += bytes([((10 - 4) << 2) | 0b01, 5])  # 1-byte-offset copy len 10
+    assert snappy.decompress(bytes(stream)) == b"abcde" + b"abcde" * 2
+
+    with pytest.raises(ValueError):
+        snappy.decompress(bytes([200, 200]))  # truncated varint/poison
+
+
+def _write_vector(root: Path, parts, files):
+    case_dir = root.joinpath("tests", *parts)
+    case_dir.mkdir(parents=True)
+    for name, content in files.items():
+        path = case_dir / name
+        if name.endswith(".ssz_snappy"):
+            path.write_bytes(snappy.compress(content))
+        else:
+            path.write_text(content)
+    return case_dir
+
+
+@pytest.fixture
+def vector_root(tmp_path):
+    state, ctx = fresh_genesis(16, "minimal")
+    ns = phase0.build(ctx.preset)
+    pre = state.copy()
+    post = pre.copy()
+    from ethereum_consensus_tpu.models.phase0.slot_processing import process_slots
+
+    process_slots(post, 3, ctx)
+
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "sanity", "slots", "pyspec_tests", "slots_3"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+            "post.ssz_snappy": ns.BeaconState.serialize(post),
+            "slots.yaml": "3\n",
+        },
+    )
+    # a shuffling vector derived from our own implementation
+    from ethereum_consensus_tpu.models.phase0 import helpers as h
+
+    seed = b"\x17" * 32
+    mapping = [h.compute_shuffled_index(i, 7, seed, ctx) for i in range(7)]
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "shuffling", "core", "shuffle", "shuffle_7"),
+        {
+            "mapping.yaml": (
+                f"seed: '0x{seed.hex()}'\ncount: 7\n"
+                f"mapping: {mapping}\n"
+            )
+        },
+    )
+    # an ssz_static vector
+    checkpoint = ns.Checkpoint(epoch=9, root=b"\x0c" * 32)
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "ssz_static", "Checkpoint", "ssz_random", "case_0"),
+        {
+            "serialized.ssz_snappy": ns.Checkpoint.serialize(checkpoint),
+            "roots.yaml": f"root: '0x{ns.Checkpoint.hash_tree_root(checkpoint).hex()}'\n",
+        },
+    )
+    # an ignored runner and a skipped runner
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "fork_choice", "on_block", "pyspec_tests", "x"),
+        {"meta.yaml": "{}\n"},
+    )
+    return tmp_path
+
+
+def test_collect_and_run_synthesized_vectors(vector_root):
+    tests = collect_tests(str(vector_root))
+    names = {t.name for t in tests}
+    assert "minimal::phase0::sanity::slots::pyspec_tests::slots_3" in names
+    assert len(tests) == 4
+
+    results = run_all(str(vector_root))
+    assert results["fail"] == 0, results["failures"]
+    assert results["pass"] == 3
+    assert results["ignored"] == 1  # fork_choice collected-but-ignored
+
+
+def test_negative_vector_must_error(tmp_path):
+    """A slots vector with a corrupt post state must be reported as FAIL."""
+    state, ctx = fresh_genesis(16, "minimal")
+    ns = phase0.build(ctx.preset)
+    pre = state.copy()
+    bad_post = pre.copy()  # not advanced → roots cannot match
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "sanity", "slots", "pyspec_tests", "bad"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+            "post.ssz_snappy": ns.BeaconState.serialize(bad_post),
+            "slots.yaml": "2\n",
+        },
+    )
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 1
+
+
+@pytest.mark.skipif(
+    "SPEC_TEST_ROOT" not in os.environ
+    or not os.path.isdir(os.path.join(os.environ["SPEC_TEST_ROOT"], "tests")),
+    reason="official consensus-spec-tests vectors not present",
+)
+def test_official_vectors():
+    results = run_all(os.environ["SPEC_TEST_ROOT"])
+    assert results["fail"] == 0, results["failures"][:20]
