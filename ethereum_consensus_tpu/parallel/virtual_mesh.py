@@ -1,0 +1,77 @@
+"""Virtual multi-device CPU platform provisioning.
+
+One real TPU chip is the common case under the axon tunnel; multi-device
+sharding is still testable by re-running in a subprocess whose JAX sees a
+virtual ``n``-device CPU platform. The platform plugin registers at
+interpreter startup, so this MUST happen via environment of a fresh
+process — never in-process. This module holds the one canonical recipe
+(used by tests/conftest.py and __graft_entry__.dryrun_multichip).
+
+Deliberately imports neither jax nor the rest of the package.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+__all__ = ["cpu_mesh_env", "run_in_cpu_mesh", "REEXEC_SENTINEL"]
+
+# Set (to the provisioned device count) in a child spawned for a specific
+# request; a child provisioned for n devices that still can't see them must
+# fail loudly instead of re-execing forever.
+REEXEC_SENTINEL = "EC_VIRTUAL_MESH_CHILD"
+
+
+def _default_repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def cpu_mesh_env(n_devices: int = 8, repo_root: str | None = None) -> dict:
+    """Environment for a subprocess with an n-device virtual CPU platform.
+
+    Preserves any pre-existing XLA_FLAGS (appends the device-count flag);
+    pins PYTHONPATH to the repo root to drop sitecustomize plugin injection.
+    """
+    if repo_root is None:
+        repo_root = _default_repo_root()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    env[REEXEC_SENTINEL] = str(n_devices)
+    return env
+
+
+def run_in_cpu_mesh(
+    code: str,
+    n_devices: int = 8,
+    timeout: int = 600,
+    repo_root: str | None = None,
+) -> str:
+    """Run ``code`` in a subprocess on the virtual CPU mesh; returns stdout.
+
+    Raises RuntimeError (with both streams) on nonzero exit.
+    """
+    if repo_root is None:
+        repo_root = _default_repo_root()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=cpu_mesh_env(n_devices, repo_root=repo_root),
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=repo_root,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cpu-mesh subprocess failed (rc={proc.returncode}):\n"
+            f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+        )
+    return proc.stdout

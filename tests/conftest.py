@@ -8,7 +8,6 @@ registers at interpreter startup and cannot be undone in-process.
 """
 
 import os
-import subprocess
 import sys
 
 import pytest
@@ -19,28 +18,18 @@ sys.path.insert(0, REPO_ROOT)
 
 def cpu_mesh_env(n_devices: int = 8) -> dict:
     """Environment for a subprocess with an n-device virtual CPU platform."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT  # drop the axon sitecustomize injection
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
-    return env
+    from ethereum_consensus_tpu.parallel.virtual_mesh import cpu_mesh_env as _env
+
+    return _env(n_devices, repo_root=REPO_ROOT)
 
 
 def run_in_cpu_mesh(code: str, n_devices: int = 8, timeout: int = 600) -> str:
     """Run ``code`` in a subprocess on the virtual CPU mesh; returns stdout."""
-    proc = subprocess.run(
-        [sys.executable, "-c", code],
-        env=cpu_mesh_env(n_devices),
-        capture_output=True,
-        text=True,
-        timeout=timeout,
-        cwd=REPO_ROOT,
+    from ethereum_consensus_tpu.parallel.virtual_mesh import (
+        run_in_cpu_mesh as _run,
     )
-    if proc.returncode != 0:
-        raise AssertionError(
-            f"cpu-mesh subprocess failed:\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
-        )
-    return proc.stdout
+
+    return _run(code, n_devices=n_devices, timeout=timeout, repo_root=REPO_ROOT)
 
 
 @pytest.fixture
