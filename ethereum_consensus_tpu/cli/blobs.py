@@ -132,12 +132,12 @@ def decode(blobs: list[bytes], framing: str = "sized") -> bytes:
 
 def bundle(blobs: list[bytes], kzg_settings=None):
     """(bundler.rs) — per blob: commitment + proof → BlobsBundle-shaped
-    dict. Uses the insecure dev setup unless a ceremony ``kzg_settings``
+    dict. Uses the embedded mainnet ceremony setup unless ``kzg_settings``
     is supplied."""
     from ..crypto import kzg
 
     if kzg_settings is None:
-        kzg_settings = kzg.KzgSettings.insecure_dev_setup(n=FIELD_ELEMENTS_PER_BLOB)
+        kzg_settings = kzg.KzgSettings.ceremony()
     commitments = []
     proofs = []
     for blob in blobs:

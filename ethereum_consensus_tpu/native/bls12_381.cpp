@@ -1,0 +1,1805 @@
+// From-scratch BLS12-381 host backend (the role blst plays for the
+// reference, ethereum-consensus/src/crypto/bls.rs): Montgomery Fp,
+// Fp2/Fp6/Fp12 tower, G1/G2, optimal ate pairing with a shared final
+// exponentiation, RFC 9380 hash-to-G2, Pippenger MSM, and the eth BLS
+// verification APIs. Semantics mirror the pure-Python oracle in
+// crypto/{fields,curves,pairing,hash_to_curve}.py bit-for-bit at the API
+// boundary; tests cross-check the two.
+//
+// Built by native/bls.py with g++ -O3 -shared; exposed via ctypes.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#include "bls12_381_constants.h"
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+typedef uint8_t u8;
+typedef uint32_t u32;
+
+// ---------------------------------------------------------------------------
+// Fp: 6x64-bit Montgomery arithmetic
+// ---------------------------------------------------------------------------
+
+struct Fp { u64 l[6]; };
+
+static const int NL = 6;
+
+static u64 FP_INV;      // -p^{-1} mod 2^64
+static Fp FP_R2;        // 2^768 mod p (standard-form limbs)
+static Fp FP_ONE;       // 2^384 mod p == Montgomery form of 1
+static Fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+// big exponents, computed at init from p
+static u64 EXP_P_MINUS_2[6];
+static u64 EXP_P_PLUS_1_DIV_4[6];
+static u64 EXP_P_MINUS_3_DIV_4[6];
+static u64 EXP_P_MINUS_1_DIV_2[6];
+static u64 EXP_P_MINUS_1_DIV_6[6];
+static u64 P_MINUS_1_DIV_2_STD[6];  // for lexicographic-largest compares
+
+static inline u64 adc(u64 a, u64 b, u64& carry) {
+  u128 t = (u128)a + b + carry;
+  carry = (u64)(t >> 64);
+  return (u64)t;
+}
+
+static inline u64 sbb(u64 a, u64 b, u64& borrow) {
+  u128 t = (u128)a - b - borrow;
+  borrow = (u64)((t >> 64) & 1);
+  return (u64)t;
+}
+
+static inline int fp_cmp_raw(const u64* a, const u64* b) {
+  for (int i = NL - 1; i >= 0; i--) {
+    if (a[i] < b[i]) return -1;
+    if (a[i] > b[i]) return 1;
+  }
+  return 0;
+}
+
+static inline bool fp_is_zero(const Fp& a) {
+  u64 acc = 0;
+  for (int i = 0; i < NL; i++) acc |= a.l[i];
+  return acc == 0;
+}
+
+static inline bool fp_eq(const Fp& a, const Fp& b) {
+  u64 acc = 0;
+  for (int i = 0; i < NL; i++) acc |= a.l[i] ^ b.l[i];
+  return acc == 0;
+}
+
+static inline void fp_add(Fp& out, const Fp& a, const Fp& b) {
+  u64 carry = 0;
+  for (int i = 0; i < NL; i++) out.l[i] = adc(a.l[i], b.l[i], carry);
+  if (carry || fp_cmp_raw(out.l, P_RAW.l) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < NL; i++) out.l[i] = sbb(out.l[i], P_RAW.l[i], borrow);
+  }
+}
+
+static inline void fp_sub(Fp& out, const Fp& a, const Fp& b) {
+  u64 borrow = 0;
+  for (int i = 0; i < NL; i++) out.l[i] = sbb(a.l[i], b.l[i], borrow);
+  if (borrow) {
+    u64 carry = 0;
+    for (int i = 0; i < NL; i++) out.l[i] = adc(out.l[i], P_RAW.l[i], carry);
+  }
+}
+
+static inline void fp_neg(Fp& out, const Fp& a) {
+  if (fp_is_zero(a)) { out = a; return; }
+  u64 borrow = 0;
+  for (int i = 0; i < NL; i++) out.l[i] = sbb(P_RAW.l[i], a.l[i], borrow);
+}
+
+static inline void fp_dbl(Fp& out, const Fp& a) { fp_add(out, a, a); }
+
+// Montgomery CIOS multiplication: out = a*b*2^-384 mod p
+static void fp_mul(Fp& out, const Fp& a, const Fp& b) {
+  u64 t[NL + 2];
+  for (int i = 0; i < NL + 2; i++) t[i] = 0;
+  for (int i = 0; i < NL; i++) {
+    u64 c = 0;
+    for (int j = 0; j < NL; j++) {
+      u128 cur = (u128)t[j] + (u128)a.l[j] * b.l[i] + c;
+      t[j] = (u64)cur;
+      c = (u64)(cur >> 64);
+    }
+    u128 cur = (u128)t[NL] + c;
+    t[NL] = (u64)cur;
+    t[NL + 1] = (u64)(cur >> 64);
+
+    u64 m = t[0] * FP_INV;
+    cur = (u128)t[0] + (u128)m * P_RAW.l[0];
+    c = (u64)(cur >> 64);
+    for (int j = 1; j < NL; j++) {
+      cur = (u128)t[j] + (u128)m * P_RAW.l[j] + c;
+      t[j - 1] = (u64)cur;
+      c = (u64)(cur >> 64);
+    }
+    cur = (u128)t[NL] + c;
+    t[NL - 1] = (u64)cur;
+    t[NL] = t[NL + 1] + (u64)(cur >> 64);
+  }
+  for (int i = 0; i < NL; i++) out.l[i] = t[i];
+  if (t[NL] || fp_cmp_raw(out.l, P_RAW.l) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < NL; i++) out.l[i] = sbb(out.l[i], P_RAW.l[i], borrow);
+  }
+}
+
+static inline void fp_sqr(Fp& out, const Fp& a) { fp_mul(out, a, a); }
+
+static void fp_to_mont(Fp& out, const Fp& std_form) { fp_mul(out, std_form, FP_R2); }
+static void fp_from_mont(Fp& out, const Fp& mont) {
+  Fp one_std = {{1, 0, 0, 0, 0, 0}};
+  fp_mul(out, mont, one_std);
+}
+
+// exponent is a little-endian limb array; square-and-multiply MSB-first
+static void fp_pow(Fp& out, const Fp& base, const u64* exp, int exp_limbs) {
+  Fp result = FP_ONE;
+  bool started = false;
+  for (int i = exp_limbs - 1; i >= 0; i--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) fp_sqr(result, result);
+      if ((exp[i] >> b) & 1) {
+        if (started) fp_mul(result, result, base);
+        else { result = base; started = true; }
+      }
+    }
+  }
+  out = started ? result : FP_ONE;
+}
+
+static void fp_inv(Fp& out, const Fp& a) { fp_pow(out, a, EXP_P_MINUS_2, 6); }
+
+// returns false if not a square
+static bool fp_sqrt(Fp& out, const Fp& a) {
+  Fp cand, check;
+  fp_pow(cand, a, EXP_P_PLUS_1_DIV_4, 6);
+  fp_sqr(check, cand);
+  if (!fp_eq(check, a)) return false;
+  out = cand;
+  return true;
+}
+
+static int fp_sgn0(const Fp& mont) {
+  Fp std_form;
+  fp_from_mont(std_form, mont);
+  return (int)(std_form.l[0] & 1);
+}
+
+static bool fp_is_lex_largest(const Fp& mont) {
+  Fp std_form;
+  fp_from_mont(std_form, mont);
+  return fp_cmp_raw(std_form.l, P_MINUS_1_DIV_2_STD) > 0;
+}
+
+// big-endian 48-byte IO (standard form)
+static void fp_to_bytes(u8 out[48], const Fp& mont) {
+  Fp s;
+  fp_from_mont(s, mont);
+  for (int i = 0; i < NL; i++) {
+    u64 w = s.l[NL - 1 - i];
+    for (int j = 0; j < 8; j++) out[i * 8 + j] = (u8)(w >> (56 - 8 * j));
+  }
+}
+
+// returns false if value >= p
+static bool fp_from_bytes(Fp& out, const u8 in[48]) {
+  Fp s;
+  for (int i = 0; i < NL; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[i * 8 + j];
+    s.l[NL - 1 - i] = w;
+  }
+  if (fp_cmp_raw(s.l, P_RAW.l) >= 0) return false;
+  fp_to_mont(out, s);
+  return true;
+}
+
+static void fp_from_u64(Fp& out, u64 v) {
+  Fp s = {{v, 0, 0, 0, 0, 0}};
+  fp_to_mont(out, s);
+}
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u^2+1)
+// ---------------------------------------------------------------------------
+
+struct Fp2 { Fp c0, c1; };
+
+static Fp2 FP2_ZERO, FP2_ONE;
+
+static inline bool fp2_is_zero(const Fp2& a) { return fp_is_zero(a.c0) && fp_is_zero(a.c1); }
+static inline bool fp2_eq(const Fp2& a, const Fp2& b) { return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1); }
+
+static inline void fp2_add(Fp2& o, const Fp2& a, const Fp2& b) {
+  fp_add(o.c0, a.c0, b.c0); fp_add(o.c1, a.c1, b.c1);
+}
+static inline void fp2_sub(Fp2& o, const Fp2& a, const Fp2& b) {
+  fp_sub(o.c0, a.c0, b.c0); fp_sub(o.c1, a.c1, b.c1);
+}
+static inline void fp2_neg(Fp2& o, const Fp2& a) { fp_neg(o.c0, a.c0); fp_neg(o.c1, a.c1); }
+static inline void fp2_dbl(Fp2& o, const Fp2& a) { fp2_add(o, a, a); }
+
+static void fp2_mul(Fp2& o, const Fp2& a, const Fp2& b) {
+  Fp t0, t1, t2, s0, s1;
+  fp_mul(t0, a.c0, b.c0);
+  fp_mul(t1, a.c1, b.c1);
+  fp_add(s0, a.c0, a.c1);
+  fp_add(s1, b.c0, b.c1);
+  fp_mul(t2, s0, s1);
+  fp_sub(o.c0, t0, t1);
+  fp_sub(t2, t2, t0);
+  fp_sub(o.c1, t2, t1);
+}
+
+static void fp2_sqr(Fp2& o, const Fp2& a) {
+  Fp s, d, t;
+  fp_add(s, a.c0, a.c1);
+  fp_sub(d, a.c0, a.c1);
+  fp_mul(t, a.c0, a.c1);
+  fp_mul(o.c0, s, d);
+  fp_add(o.c1, t, t);
+}
+
+static void fp2_scalar_mul(Fp2& o, const Fp2& a, const Fp& k) {
+  fp_mul(o.c0, a.c0, k); fp_mul(o.c1, a.c1, k);
+}
+
+// xi = u + 1: (a + bu)(1 + u) = (a - b) + (a + b)u
+static void fp2_mul_by_xi(Fp2& o, const Fp2& a) {
+  Fp t0, t1;
+  fp_sub(t0, a.c0, a.c1);
+  fp_add(t1, a.c0, a.c1);
+  o.c0 = t0; o.c1 = t1;
+}
+
+static inline void fp2_conj(Fp2& o, const Fp2& a) { o.c0 = a.c0; fp_neg(o.c1, a.c1); }
+
+static void fp2_inv(Fp2& o, const Fp2& a) {
+  Fp n0, n1, norm, inv;
+  fp_sqr(n0, a.c0);
+  fp_sqr(n1, a.c1);
+  fp_add(norm, n0, n1);
+  fp_inv(inv, norm);
+  fp_mul(o.c0, a.c0, inv);
+  Fp t;
+  fp_mul(t, a.c1, inv);
+  fp_neg(o.c1, t);
+}
+
+static void fp2_pow(Fp2& out, const Fp2& base, const u64* exp, int exp_limbs) {
+  Fp2 result = FP2_ONE;
+  bool started = false;
+  for (int i = exp_limbs - 1; i >= 0; i--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) fp2_sqr(result, result);
+      if ((exp[i] >> b) & 1) {
+        if (started) fp2_mul(result, result, base);
+        else { result = base; started = true; }
+      }
+    }
+  }
+  out = started ? result : FP2_ONE;
+}
+
+static int fp2_sgn0(const Fp2& a) {
+  Fp s0;
+  fp_from_mont(s0, a.c0);
+  int sign0 = (int)(s0.l[0] & 1);
+  bool zero0 = fp_is_zero(a.c0);
+  Fp s1;
+  fp_from_mont(s1, a.c1);
+  int sign1 = (int)(s1.l[0] & 1);
+  return sign0 | ((zero0 ? 1 : 0) & sign1);
+}
+
+static bool fp2_is_lex_largest(const Fp2& a) {
+  if (!fp_is_zero(a.c1)) return fp_is_lex_largest(a.c1);
+  return fp_is_lex_largest(a.c0);
+}
+
+// p == 3 mod 4 two-adicity-1 algorithm, mirrors fields.py Fq2.sqrt
+static bool fp2_sqrt(Fp2& out, const Fp2& a) {
+  if (fp2_is_zero(a)) { out = a; return true; }
+  Fp2 a1, alpha, x0, t;
+  fp2_pow(a1, a, EXP_P_MINUS_3_DIV_4, 6);
+  fp2_sqr(t, a1);
+  fp2_mul(alpha, t, a);
+  fp2_mul(x0, a1, a);
+  Fp2 neg_one;
+  fp2_neg(neg_one, FP2_ONE);
+  if (fp2_eq(alpha, neg_one)) {
+    // i * x0 = (-x0.c1, x0.c0)
+    Fp2 r;
+    fp_neg(r.c0, x0.c1);
+    r.c1 = x0.c0;
+    out = r;
+    return true;
+  }
+  Fp2 b, cand, check;
+  fp2_add(t, alpha, FP2_ONE);
+  fp2_pow(b, t, EXP_P_MINUS_1_DIV_2, 6);
+  fp2_mul(cand, b, x0);
+  fp2_sqr(check, cand);
+  if (!fp2_eq(check, a)) return false;
+  out = cand;
+  return true;
+}
+
+static void fp2_from_raw(Fp2& out, const Fp2Raw& r) {
+  Fp c0s, c1s;
+  for (int i = 0; i < NL; i++) { c0s.l[i] = r.c0.l[i]; c1s.l[i] = r.c1.l[i]; }
+  fp_to_mont(out.c0, c0s);
+  fp_to_mont(out.c1, c1s);
+}
+
+// ---------------------------------------------------------------------------
+// Fp6 = Fp2[v]/(v^3 - xi), Fp12 = Fp6[w]/(w^2 - v)
+// ---------------------------------------------------------------------------
+
+struct Fp6 { Fp2 a0, a1, a2; };
+struct Fp12 { Fp6 c0, c1; };
+
+static Fp6 FP6_ZERO, FP6_ONE;
+static Fp12 FP12_ONE;
+static Fp2 FROB_GAMMA1[6];  // xi^(i*(p-1)/6), i = 0..5
+
+static inline bool fp6_is_zero(const Fp6& a) {
+  return fp2_is_zero(a.a0) && fp2_is_zero(a.a1) && fp2_is_zero(a.a2);
+}
+static inline void fp6_add(Fp6& o, const Fp6& a, const Fp6& b) {
+  fp2_add(o.a0, a.a0, b.a0); fp2_add(o.a1, a.a1, b.a1); fp2_add(o.a2, a.a2, b.a2);
+}
+static inline void fp6_sub(Fp6& o, const Fp6& a, const Fp6& b) {
+  fp2_sub(o.a0, a.a0, b.a0); fp2_sub(o.a1, a.a1, b.a1); fp2_sub(o.a2, a.a2, b.a2);
+}
+static inline void fp6_neg(Fp6& o, const Fp6& a) {
+  fp2_neg(o.a0, a.a0); fp2_neg(o.a1, a.a1); fp2_neg(o.a2, a.a2);
+}
+
+static void fp6_mul(Fp6& o, const Fp6& a, const Fp6& b) {
+  Fp2 t0, t1, t2, s, u, x, y;
+  fp2_mul(t0, a.a0, b.a0);
+  fp2_mul(t1, a.a1, b.a1);
+  fp2_mul(t2, a.a2, b.a2);
+  // c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+  fp2_add(s, a.a1, a.a2);
+  fp2_add(u, b.a1, b.a2);
+  fp2_mul(x, s, u);
+  fp2_sub(x, x, t1);
+  fp2_sub(x, x, t2);
+  fp2_mul_by_xi(y, x);
+  Fp2 c0, c1, c2;
+  fp2_add(c0, t0, y);
+  // c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+  fp2_add(s, a.a0, a.a1);
+  fp2_add(u, b.a0, b.a1);
+  fp2_mul(x, s, u);
+  fp2_sub(x, x, t0);
+  fp2_sub(x, x, t1);
+  fp2_mul_by_xi(y, t2);
+  fp2_add(c1, x, y);
+  // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+  fp2_add(s, a.a0, a.a2);
+  fp2_add(u, b.a0, b.a2);
+  fp2_mul(x, s, u);
+  fp2_sub(x, x, t0);
+  fp2_sub(x, x, t2);
+  fp2_add(c2, x, t1);
+  o.a0 = c0; o.a1 = c1; o.a2 = c2;
+}
+
+static inline void fp6_sqr(Fp6& o, const Fp6& a) { fp6_mul(o, a, a); }
+
+// multiply by v: (a0, a1, a2) -> (xi*a2, a0, a1)
+static void fp6_mul_by_v(Fp6& o, const Fp6& a) {
+  Fp2 t;
+  fp2_mul_by_xi(t, a.a2);
+  Fp2 old_a0 = a.a0, old_a1 = a.a1;
+  o.a0 = t; o.a1 = old_a0; o.a2 = old_a1;
+}
+
+static void fp6_scalar_mul_fp2(Fp6& o, const Fp6& a, const Fp2& k) {
+  fp2_mul(o.a0, a.a0, k); fp2_mul(o.a1, a.a1, k); fp2_mul(o.a2, a.a2, k);
+}
+
+static void fp6_inv(Fp6& o, const Fp6& a) {
+  // c0 = a0^2 - xi*a1*a2 ; c1 = xi*a2^2 - a0*a1 ; c2 = a1^2 - a0*a2
+  Fp2 c0, c1, c2, t, u;
+  fp2_sqr(c0, a.a0);
+  fp2_mul(t, a.a1, a.a2);
+  fp2_mul_by_xi(u, t);
+  fp2_sub(c0, c0, u);
+  fp2_sqr(t, a.a2);
+  fp2_mul_by_xi(u, t);
+  fp2_mul(t, a.a0, a.a1);
+  fp2_sub(c1, u, t);
+  fp2_sqr(t, a.a1);
+  fp2_mul(u, a.a0, a.a2);
+  fp2_sub(c2, t, u);
+  // t = a0*c0 + xi*(a2*c1 + a1*c2)
+  Fp2 acc, x;
+  fp2_mul(acc, a.a2, c1);
+  fp2_mul(x, a.a1, c2);
+  fp2_add(acc, acc, x);
+  fp2_mul_by_xi(acc, acc);
+  fp2_mul(x, a.a0, c0);
+  fp2_add(acc, acc, x);
+  Fp2 inv;
+  fp2_inv(inv, acc);
+  fp2_mul(o.a0, c0, inv);
+  fp2_mul(o.a1, c1, inv);
+  fp2_mul(o.a2, c2, inv);
+}
+
+static inline bool fp12_eq(const Fp12& a, const Fp12& b) {
+  return fp2_eq(a.c0.a0, b.c0.a0) && fp2_eq(a.c0.a1, b.c0.a1) && fp2_eq(a.c0.a2, b.c0.a2) &&
+         fp2_eq(a.c1.a0, b.c1.a0) && fp2_eq(a.c1.a1, b.c1.a1) && fp2_eq(a.c1.a2, b.c1.a2);
+}
+
+static void fp12_mul(Fp12& o, const Fp12& a, const Fp12& b) {
+  Fp6 t0, t1, s0, s1, t2, vt;
+  fp6_mul(t0, a.c0, b.c0);
+  fp6_mul(t1, a.c1, b.c1);
+  fp6_add(s0, a.c0, a.c1);
+  fp6_add(s1, b.c0, b.c1);
+  fp6_mul(t2, s0, s1);
+  fp6_sub(t2, t2, t0);
+  fp6_sub(t2, t2, t1);
+  fp6_mul_by_v(vt, t1);
+  fp6_add(o.c0, t0, vt);
+  o.c1 = t2;
+}
+
+static void fp12_sqr(Fp12& o, const Fp12& a) {
+  // c0 = A0^2 + v*A1^2 ; c1 = 2*A0*A1, karatsuba form
+  Fp6 u, s, t, vt;
+  fp6_mul(u, a.c0, a.c1);
+  fp6_add(s, a.c0, a.c1);
+  fp6_mul_by_v(vt, a.c1);
+  fp6_add(t, a.c0, vt);
+  fp6_mul(t, s, t);       // (A0+A1)(A0+v*A1) = A0^2 + v*A1^2 + (1+v)*A0*A1
+  fp6_sub(t, t, u);
+  fp6_mul_by_v(vt, u);
+  fp6_sub(o.c0, t, vt);
+  fp6_add(o.c1, u, u);
+}
+
+static inline void fp12_conj(Fp12& o, const Fp12& a) {
+  o.c0 = a.c0;
+  fp6_neg(o.c1, a.c1);
+}
+
+static void fp12_inv(Fp12& o, const Fp12& a) {
+  Fp6 t0, t1, vt, inv;
+  fp6_sqr(t0, a.c0);
+  fp6_sqr(t1, a.c1);
+  fp6_mul_by_v(vt, t1);
+  fp6_sub(t0, t0, vt);
+  fp6_inv(inv, t0);
+  fp6_mul(o.c0, a.c0, inv);
+  Fp6 t;
+  fp6_mul(t, a.c1, inv);
+  fp6_neg(o.c1, t);
+}
+
+// Frobenius x -> x^p. Basis powers of w: w^0..w^5 live at
+// (c0.a0, c1.a0, c0.a1, c1.a1, c0.a2, c1.a2); b_i -> conj(b_i)*gamma1^i.
+static void fp12_frob(Fp12& o, const Fp12& a) {
+  Fp2 b[6] = {a.c0.a0, a.c1.a0, a.c0.a1, a.c1.a1, a.c0.a2, a.c1.a2};
+  Fp2 r[6];
+  for (int i = 0; i < 6; i++) {
+    Fp2 c;
+    fp2_conj(c, b[i]);
+    fp2_mul(r[i], c, FROB_GAMMA1[i]);
+  }
+  o.c0.a0 = r[0]; o.c1.a0 = r[1]; o.c0.a1 = r[2];
+  o.c1.a1 = r[3]; o.c0.a2 = r[4]; o.c1.a2 = r[5];
+}
+
+static void fp12_frob_n(Fp12& o, const Fp12& a, int n) {
+  Fp12 t = a;
+  for (int i = 0; i < n; i++) fp12_frob(t, t);
+  o = t;
+}
+
+static bool fp12_is_one(const Fp12& a) { return fp12_eq(a, FP12_ONE); }
+
+// ---------------------------------------------------------------------------
+// Curve groups: Jacobian coordinates, templated over the field
+// ---------------------------------------------------------------------------
+
+struct FpOps {
+  typedef Fp F;
+  static void add(F& o, const F& a, const F& b) { fp_add(o, a, b); }
+  static void sub(F& o, const F& a, const F& b) { fp_sub(o, a, b); }
+  static void mul(F& o, const F& a, const F& b) { fp_mul(o, a, b); }
+  static void sqr(F& o, const F& a) { fp_sqr(o, a); }
+  static void neg(F& o, const F& a) { fp_neg(o, a); }
+  static void inv(F& o, const F& a) { fp_inv(o, a); }
+  static bool is_zero(const F& a) { return fp_is_zero(a); }
+  static bool eq(const F& a, const F& b) { return fp_eq(a, b); }
+  static F zero() { return FP_ZERO; }
+  static F one() { return FP_ONE; }
+};
+
+struct Fp2Ops {
+  typedef Fp2 F;
+  static void add(F& o, const F& a, const F& b) { fp2_add(o, a, b); }
+  static void sub(F& o, const F& a, const F& b) { fp2_sub(o, a, b); }
+  static void mul(F& o, const F& a, const F& b) { fp2_mul(o, a, b); }
+  static void sqr(F& o, const F& a) { fp2_sqr(o, a); }
+  static void neg(F& o, const F& a) { fp2_neg(o, a); }
+  static void inv(F& o, const F& a) { fp2_inv(o, a); }
+  static bool is_zero(const F& a) { return fp2_is_zero(a); }
+  static bool eq(const F& a, const F& b) { return fp2_eq(a, b); }
+  static F zero() { return FP2_ZERO; }
+  static F one() { return FP2_ONE; }
+};
+
+template <class Ops>
+struct Point {
+  typename Ops::F x, y, z;
+  bool is_inf() const { return Ops::is_zero(z); }
+};
+
+typedef Point<FpOps> G1;
+typedef Point<Fp2Ops> G2;
+
+static Fp G1_B;    // 4
+static Fp2 G2_B;   // 4(u+1)
+static G1 G1_GEN;
+static G2 G2_GEN;
+
+template <class Ops>
+static Point<Ops> pt_infinity() {
+  Point<Ops> p;
+  p.x = Ops::one(); p.y = Ops::one(); p.z = Ops::zero();
+  return p;
+}
+
+// dbl-2009-l, mirrors curves.py _JacobianPoint.double
+template <class Ops>
+static void pt_double(Point<Ops>& o, const Point<Ops>& p) {
+  typedef typename Ops::F F;
+  if (p.is_inf()) { o = p; return; }
+  F a, b, c, d, e, f, t, x3, y3, z3;
+  Ops::sqr(a, p.x);
+  Ops::sqr(b, p.y);
+  Ops::sqr(c, b);
+  Ops::add(t, p.x, b);
+  Ops::sqr(t, t);
+  Ops::sub(t, t, a);
+  Ops::sub(d, t, c);
+  Ops::add(d, d, d);
+  Ops::add(e, a, a);
+  Ops::add(e, e, a);
+  Ops::sqr(f, e);
+  Ops::sub(x3, f, d);
+  Ops::sub(x3, x3, d);
+  F c8;
+  Ops::add(c8, c, c);
+  Ops::add(c8, c8, c8);
+  Ops::add(c8, c8, c8);
+  Ops::sub(t, d, x3);
+  Ops::mul(y3, e, t);
+  Ops::sub(y3, y3, c8);
+  Ops::mul(z3, p.y, p.z);
+  Ops::add(z3, z3, z3);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+// add-2007-bl, mirrors curves.py _JacobianPoint.__add__
+template <class Ops>
+static void pt_add(Point<Ops>& o, const Point<Ops>& p, const Point<Ops>& q) {
+  typedef typename Ops::F F;
+  if (p.is_inf()) { o = q; return; }
+  if (q.is_inf()) { o = p; return; }
+  F z1z1, z2z2, u1, u2, s1, s2, t;
+  Ops::sqr(z1z1, p.z);
+  Ops::sqr(z2z2, q.z);
+  Ops::mul(u1, p.x, z2z2);
+  Ops::mul(u2, q.x, z1z1);
+  Ops::mul(t, p.y, q.z);
+  Ops::mul(s1, t, z2z2);
+  Ops::mul(t, q.y, p.z);
+  Ops::mul(s2, t, z1z1);
+  if (Ops::eq(u1, u2)) {
+    if (Ops::eq(s1, s2)) { pt_double(o, p); return; }
+    o = pt_infinity<Ops>();
+    return;
+  }
+  F h, i, j, r, v, x3, y3, z3;
+  Ops::sub(h, u2, u1);
+  Ops::add(i, h, h);
+  Ops::sqr(i, i);
+  Ops::mul(j, h, i);
+  Ops::sub(r, s2, s1);
+  Ops::add(r, r, r);
+  Ops::mul(v, u1, i);
+  Ops::sqr(x3, r);
+  Ops::sub(x3, x3, j);
+  Ops::sub(x3, x3, v);
+  Ops::sub(x3, x3, v);
+  Ops::sub(t, v, x3);
+  Ops::mul(y3, r, t);
+  F sj;
+  Ops::mul(sj, s1, j);
+  Ops::sub(y3, y3, sj);
+  Ops::sub(y3, y3, sj);
+  Ops::mul(t, p.z, q.z);
+  Ops::add(t, t, t);
+  Ops::mul(z3, t, h);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+template <class Ops>
+static void pt_neg(Point<Ops>& o, const Point<Ops>& p) {
+  o.x = p.x;
+  Ops::neg(o.y, p.y);
+  o.z = p.z;
+}
+
+// scalar given as little-endian u64 limbs; MSB-first double-and-add
+template <class Ops>
+static void pt_mul(Point<Ops>& o, const Point<Ops>& p, const u64* scalar, int limbs) {
+  Point<Ops> result = pt_infinity<Ops>();
+  bool started = false;
+  for (int i = limbs - 1; i >= 0; i--) {
+    for (int b = 63; b >= 0; b--) {
+      if (started) pt_double(result, result);
+      if ((scalar[i] >> b) & 1) {
+        if (started) pt_add(result, result, p);
+        else { result = p; started = true; }
+      }
+    }
+  }
+  o = started ? result : pt_infinity<Ops>();
+}
+
+template <class Ops>
+static bool pt_in_subgroup(const Point<Ops>& p) {
+  if (p.is_inf()) return true;
+  Point<Ops> t;
+  pt_mul(t, p, R_RAW, 4);
+  return t.is_inf();
+}
+
+// affine (x, y); returns false for infinity
+template <class Ops>
+static bool pt_to_affine(typename Ops::F& ax, typename Ops::F& ay, const Point<Ops>& p) {
+  typedef typename Ops::F F;
+  if (p.is_inf()) return false;
+  F zinv, z2, z3;
+  Ops::inv(zinv, p.z);
+  Ops::sqr(z2, zinv);
+  Ops::mul(z3, z2, zinv);
+  Ops::mul(ax, p.x, z2);
+  Ops::mul(ay, p.y, z3);
+  return true;
+}
+
+template <class Ops>
+static Point<Ops> pt_from_affine(const typename Ops::F& ax, const typename Ops::F& ay) {
+  Point<Ops> p;
+  p.x = ax; p.y = ay; p.z = Ops::one();
+  return p;
+}
+
+template <class Ops>
+static bool pt_on_curve_affine(const typename Ops::F& ax, const typename Ops::F& ay,
+                               const typename Ops::F& b) {
+  typedef typename Ops::F F;
+  F y2, x3, t;
+  Ops::sqr(y2, ay);
+  Ops::sqr(t, ax);
+  Ops::mul(x3, t, ax);
+  Ops::add(x3, x3, b);
+  return Ops::eq(y2, x3);
+}
+
+// ---------------------------------------------------------------------------
+// ZCash-format compressed serialization (mirrors curves.py)
+// ---------------------------------------------------------------------------
+
+enum DecodeErr {
+  DEC_OK = 0,
+  DEC_NOT_COMPRESSED = 2,
+  DEC_BAD_INFINITY = 3,
+  DEC_NOT_IN_FIELD = 4,
+  DEC_NOT_ON_CURVE = 5,
+  DEC_NOT_IN_SUBGROUP = 6,
+};
+
+static const u8 FLAG_COMPRESSED = 0x80;
+static const u8 FLAG_INFINITY = 0x40;
+static const u8 FLAG_SIGN = 0x20;
+
+// decompress + full validation (curve + subgroup), infinity allowed
+static int g1_decompress(G1& out, const u8 in[48], bool check_subgroup = true) {
+  u8 flags = in[0];
+  if (!(flags & FLAG_COMPRESSED)) return DEC_NOT_COMPRESSED;
+  if (flags & FLAG_INFINITY) {
+    if (flags & ~(FLAG_COMPRESSED | FLAG_INFINITY)) return DEC_BAD_INFINITY;
+    for (int i = 1; i < 48; i++) if (in[i]) return DEC_BAD_INFINITY;
+    out = pt_infinity<FpOps>();
+    return DEC_OK;
+  }
+  u8 buf[48];
+  memcpy(buf, in, 48);
+  buf[0] = flags & 0x1F;
+  Fp x;
+  if (!fp_from_bytes(x, buf)) return DEC_NOT_IN_FIELD;
+  Fp y2, t, y;
+  fp_sqr(t, x);
+  fp_mul(y2, t, x);
+  fp_add(y2, y2, G1_B);
+  if (!fp_sqrt(y, y2)) return DEC_NOT_ON_CURVE;
+  if (fp_is_lex_largest(y) != !!(flags & FLAG_SIGN)) fp_neg(y, y);
+  out = pt_from_affine<FpOps>(x, y);
+  if (check_subgroup && !pt_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
+  return DEC_OK;
+}
+
+static int g2_decompress(G2& out, const u8 in[96], bool check_subgroup = true) {
+  u8 flags = in[0];
+  if (!(flags & FLAG_COMPRESSED)) return DEC_NOT_COMPRESSED;
+  if (flags & FLAG_INFINITY) {
+    if (flags & ~(FLAG_COMPRESSED | FLAG_INFINITY)) return DEC_BAD_INFINITY;
+    for (int i = 1; i < 96; i++) if (in[i]) return DEC_BAD_INFINITY;
+    out = pt_infinity<Fp2Ops>();
+    return DEC_OK;
+  }
+  // layout: c1 (48, flags in MSB) || c0 (48)
+  u8 buf[48];
+  memcpy(buf, in, 48);
+  buf[0] = flags & 0x1F;
+  Fp2 x;
+  if (!fp_from_bytes(x.c1, buf)) return DEC_NOT_IN_FIELD;
+  if (!fp_from_bytes(x.c0, in + 48)) return DEC_NOT_IN_FIELD;
+  Fp2 y2, t, y;
+  fp2_sqr(t, x);
+  fp2_mul(y2, t, x);
+  fp2_add(y2, y2, G2_B);
+  if (!fp2_sqrt(y, y2)) return DEC_NOT_ON_CURVE;
+  if (fp2_is_lex_largest(y) != !!(flags & FLAG_SIGN)) fp2_neg(y, y);
+  out = pt_from_affine<Fp2Ops>(x, y);
+  if (check_subgroup && !pt_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
+  return DEC_OK;
+}
+
+static void g1_compress(u8 out[48], const G1& p) {
+  if (p.is_inf()) {
+    memset(out, 0, 48);
+    out[0] = FLAG_COMPRESSED | FLAG_INFINITY;
+    return;
+  }
+  Fp ax, ay;
+  pt_to_affine<FpOps>(ax, ay, p);
+  fp_to_bytes(out, ax);
+  out[0] |= FLAG_COMPRESSED;
+  if (fp_is_lex_largest(ay)) out[0] |= FLAG_SIGN;
+}
+
+static void g2_compress(u8 out[96], const G2& p) {
+  if (p.is_inf()) {
+    memset(out, 0, 96);
+    out[0] = FLAG_COMPRESSED | FLAG_INFINITY;
+    return;
+  }
+  Fp2 ax, ay;
+  pt_to_affine<Fp2Ops>(ax, ay, p);
+  fp_to_bytes(out, ax.c1);
+  fp_to_bytes(out + 48, ax.c0);
+  out[0] |= FLAG_COMPRESSED;
+  if (fp2_is_lex_largest(ay)) out[0] |= FLAG_SIGN;
+}
+
+// ---------------------------------------------------------------------------
+// Optimal ate pairing
+//
+// Miller loop over the M-twist with Jacobian accumulators and
+// denominator-free line functions. Untwist: x = x'*xi^-1*v^2,
+// y = y'*xi^-1*v*w (same map as crypto/pairing.py). Lines are scaled by
+// Fq2 constants, which the final exponentiation kills (they lie in a
+// proper subfield). Line slots in Fp12 (basis powers of w):
+//   doubling, scale 2YZ^3:  c0.a0 = -xi*(2YZ^3 * yP)
+//                           c1.a1 = 2Y^2 - 3X^3
+//                           c1.a2 = (3X^2 Z^2) * xP
+//   addition (T + Q, Q affine), scale lam_d = (X - xq Z^2) Z:
+//     lam_n = Y - yq Z^3
+//                           c0.a0 = -xi*(lam_d * yP)
+//                           c1.a1 = yq*lam_d - lam_n*xq
+//                           c1.a2 = lam_n * xP
+// ---------------------------------------------------------------------------
+
+struct MillerPair {
+  Fp xp, yp;   // G1 affine
+  Fp2 xq, yq;  // G2 affine (twist coords)
+  G2 t;        // accumulator
+};
+
+static void line_to_fp12(Fp12& l, const Fp2& c00, const Fp2& c11, const Fp2& c12) {
+  l.c0.a0 = c00;
+  l.c0.a1 = FP2_ZERO;
+  l.c0.a2 = FP2_ZERO;
+  l.c1.a0 = FP2_ZERO;
+  l.c1.a1 = c11;
+  l.c1.a2 = c12;
+}
+
+// tangent line at pr.t evaluated at (xp, yp); multiplies into f
+static void miller_double_step(Fp12& f, MillerPair& pr) {
+  const Fp2 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
+  Fp2 y2, z2, z3, yz3_2, x2, x3, c00, c11, c12, t;
+  fp2_sqr(y2, Y);
+  fp2_sqr(z2, Z);
+  fp2_mul(z3, z2, Z);
+  fp2_mul(yz3_2, Y, z3);
+  fp2_dbl(yz3_2, yz3_2);             // 2YZ^3
+  fp2_sqr(x2, X);
+  fp2_mul(x3, x2, X);
+  // c00 = -xi * (2YZ^3 * yp)
+  fp2_scalar_mul(t, yz3_2, pr.yp);
+  fp2_mul_by_xi(t, t);
+  fp2_neg(c00, t);
+  // c11 = 2Y^2 - 3X^3
+  Fp2 x3_3;
+  fp2_dbl(c11, y2);
+  fp2_add(x3_3, x3, x3);
+  fp2_add(x3_3, x3_3, x3);
+  fp2_sub(c11, c11, x3_3);
+  // c12 = 3 X^2 Z^2 * xp
+  Fp2 x2_3;
+  fp2_add(x2_3, x2, x2);
+  fp2_add(x2_3, x2_3, x2);
+  fp2_mul(t, x2_3, z2);
+  fp2_scalar_mul(c12, t, pr.xp);
+  Fp12 l;
+  line_to_fp12(l, c00, c11, c12);
+  fp12_mul(f, f, l);
+  pt_double(pr.t, pr.t);
+}
+
+// line through pr.t and (xq, yq) evaluated at (xp, yp); multiplies into f
+static void miller_add_step(Fp12& f, MillerPair& pr) {
+  const Fp2 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
+  Fp2 z2, z3, lam_n, lam_d, t, c00, c11, c12;
+  fp2_sqr(z2, Z);
+  fp2_mul(z3, z2, Z);
+  fp2_mul(t, pr.yq, z3);
+  fp2_sub(lam_n, Y, t);              // Y - yq Z^3
+  fp2_mul(t, pr.xq, z2);
+  fp2_sub(lam_d, X, t);
+  fp2_mul(lam_d, lam_d, Z);          // (X - xq Z^2) Z
+  // c00 = -xi * (lam_d * yp)
+  fp2_scalar_mul(t, lam_d, pr.yp);
+  fp2_mul_by_xi(t, t);
+  fp2_neg(c00, t);
+  // c11 = yq*lam_d - lam_n*xq
+  Fp2 u;
+  fp2_mul(t, pr.yq, lam_d);
+  fp2_mul(u, lam_n, pr.xq);
+  fp2_sub(c11, t, u);
+  // c12 = lam_n * xp
+  fp2_scalar_mul(c12, lam_n, pr.xp);
+  Fp12 l;
+  line_to_fp12(l, c00, c11, c12);
+  fp12_mul(f, f, l);
+  G2 q = pt_from_affine<Fp2Ops>(pr.xq, pr.yq);
+  pt_add(pr.t, pr.t, q);
+}
+
+// product of Miller loops, one shared squaring chain; pairs must be finite
+static void multi_miller_loop(Fp12& f, MillerPair* pairs, size_t n) {
+  f = FP12_ONE;
+  if (n == 0) return;
+  for (size_t k = 0; k < n; k++)
+    pairs[k].t = pt_from_affine<Fp2Ops>(pairs[k].xq, pairs[k].yq);
+  // bits of |x| MSB-first, top bit consumed by initialization
+  int msb = 63;
+  while (!((BLS_X_ABS >> msb) & 1)) msb--;
+  for (int b = msb - 1; b >= 0; b--) {
+    fp12_sqr(f, f);
+    for (size_t k = 0; k < n; k++) miller_double_step(f, pairs[k]);
+    if ((BLS_X_ABS >> b) & 1)
+      for (size_t k = 0; k < n; k++) miller_add_step(f, pairs[k]);
+  }
+  // x negative: conjugate
+  fp12_conj(f, f);
+}
+
+// f^|x| then conjugate (x negative); input must be in cyclotomic subgroup
+static void fp12_pow_neg_x(Fp12& o, const Fp12& a) {
+  Fp12 result;
+  bool started = false;
+  for (int b = 63; b >= 0; b--) {
+    if (started) fp12_sqr(result, result);
+    if ((BLS_X_ABS >> b) & 1) {
+      if (started) fp12_mul(result, result, a);
+      else { result = a; started = true; }
+    }
+  }
+  fp12_conj(o, result);
+}
+
+// full final exponentiation up to a cube: f^(3*(p^12-1)/r).
+// Hard part via (x-1)^2 (x+p) (x^2+p^2-1) + 3 == 3*(p^4-p^2+1)/r
+// (verified numerically); the cube preserves the ==1 verdict since
+// gcd(3, r) = 1. Only predicates are exposed, never raw pairing values.
+static void final_exp_for_verdict(Fp12& o, const Fp12& f) {
+  // easy: f^(p^6-1) = conj(f) * f^-1, then ^(p^2+1)
+  Fp12 inv, f1, f2, t;
+  fp12_inv(inv, f);
+  fp12_conj(t, f);
+  fp12_mul(f1, t, inv);
+  fp12_frob_n(t, f1, 2);
+  fp12_mul(f2, t, f1);
+  // hard (cyclotomic subgroup: inverse == conjugate)
+  Fp12 a, b, c, d, e;
+  fp12_pow_neg_x(t, f2);
+  fp12_conj(a, f2);
+  fp12_mul(a, a, t);              // f2^(x-1)
+  fp12_pow_neg_x(t, a);
+  fp12_conj(b, a);
+  fp12_mul(b, b, t);              // a^(x-1)
+  fp12_pow_neg_x(t, b);
+  fp12_frob(c, b);
+  fp12_mul(c, c, t);              // b^(x+p)
+  fp12_pow_neg_x(t, c);
+  fp12_pow_neg_x(t, t);           // c^(x^2)
+  fp12_frob_n(d, c, 2);
+  fp12_mul(d, d, t);
+  fp12_conj(e, c);
+  fp12_mul(d, d, e);              // c^(x^2+p^2-1)
+  // result = d * f2^3
+  fp12_sqr(t, f2);
+  fp12_mul(t, t, f2);
+  fp12_mul(o, d, t);
+}
+
+// Π e(Pi, Qi) == 1, skipping infinite points (mirrors pairing.py)
+static bool pairing_product_is_one(const G1* ps, const G2* qs, size_t n) {
+  MillerPair pairs[129];
+  MillerPair* heap_pairs = nullptr;
+  MillerPair* use = pairs;
+  if (n > 129) { heap_pairs = new MillerPair[n]; use = heap_pairs; }
+  size_t m = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (ps[i].is_inf() || qs[i].is_inf()) continue;
+    pt_to_affine<FpOps>(use[m].xp, use[m].yp, ps[i]);
+    pt_to_affine<Fp2Ops>(use[m].xq, use[m].yq, qs[i]);
+    m++;
+  }
+  Fp12 f, fe;
+  multi_miller_loop(f, use, m);
+  final_exp_for_verdict(fe, f);
+  bool ok = fp12_is_one(fe);
+  delete[] heap_pairs;
+  return ok;
+}
+
+// ---------------------------------------------------------------------------
+// init: derive every constant from p at load time
+// ---------------------------------------------------------------------------
+
+static Fp2 SSWU_A, SSWU_B, SSWU_Z, SSWU_NEG_B_OVER_A, SSWU_B_OVER_ZA;
+static Fp2 ISO_XN[4], ISO_XD[3], ISO_YN[4], ISO_YD[4];
+
+static void limbs_sub_small(u64* out, const u64* a, u64 small) {
+  u64 borrow = 0;
+  out[0] = sbb(a[0], small, borrow);
+  for (int i = 1; i < 6; i++) out[i] = sbb(a[i], 0, borrow);
+}
+
+static void limbs_add_small(u64* out, const u64* a, u64 small) {
+  u64 carry = 0;
+  out[0] = adc(a[0], small, carry);
+  for (int i = 1; i < 6; i++) out[i] = adc(a[i], 0, carry);
+}
+
+static void limbs_shr(u64* out, const u64* a, int k) {
+  for (int i = 0; i < 6; i++) {
+    u64 lo = a[i] >> k;
+    u64 hi = (i + 1 < 6) ? (a[i + 1] << (64 - k)) : 0;
+    out[i] = lo | hi;
+  }
+}
+
+static void limbs_div3(u64* out, const u64* a) {
+  u128 rem = 0;
+  for (int i = 5; i >= 0; i--) {
+    u128 cur = (rem << 64) | a[i];
+    out[i] = (u64)(cur / 3);
+    rem = cur % 3;
+  }
+}
+
+static bool INITIALIZED = false;
+
+static void ensure_init() {
+  if (INITIALIZED) return;
+  // -p^{-1} mod 2^64 by Newton iteration
+  u64 inv = 1;
+  for (int i = 0; i < 6; i++) inv *= 2 - P_RAW.l[0] * inv;
+  FP_INV = (u64)0 - inv;
+  // 2^768 mod p by doubling (fp_add reduces and needs no Montgomery state)
+  Fp acc = {{1, 0, 0, 0, 0, 0}};
+  for (int i = 0; i < 768; i++) fp_add(acc, acc, acc);
+  FP_R2 = acc;
+  Fp one_std = {{1, 0, 0, 0, 0, 0}};
+  fp_mul(FP_ONE, one_std, FP_R2);
+  // exponents
+  limbs_sub_small(EXP_P_MINUS_2, P_RAW.l, 2);
+  u64 tmp[6];
+  limbs_add_small(tmp, P_RAW.l, 1);
+  limbs_shr(EXP_P_PLUS_1_DIV_4, tmp, 2);
+  limbs_sub_small(tmp, P_RAW.l, 3);
+  limbs_shr(EXP_P_MINUS_3_DIV_4, tmp, 2);
+  limbs_sub_small(tmp, P_RAW.l, 1);
+  limbs_shr(EXP_P_MINUS_1_DIV_2, tmp, 1);
+  for (int i = 0; i < 6; i++) P_MINUS_1_DIV_2_STD[i] = EXP_P_MINUS_1_DIV_2[i];
+  limbs_div3(EXP_P_MINUS_1_DIV_6, EXP_P_MINUS_1_DIV_2);
+  // field constants
+  FP2_ZERO.c0 = FP_ZERO; FP2_ZERO.c1 = FP_ZERO;
+  FP2_ONE.c0 = FP_ONE; FP2_ONE.c1 = FP_ZERO;
+  FP6_ZERO.a0 = FP2_ZERO; FP6_ZERO.a1 = FP2_ZERO; FP6_ZERO.a2 = FP2_ZERO;
+  FP6_ONE.a0 = FP2_ONE; FP6_ONE.a1 = FP2_ZERO; FP6_ONE.a2 = FP2_ZERO;
+  FP12_ONE.c0 = FP6_ONE; FP12_ONE.c1 = FP6_ZERO;
+  // Frobenius gamma1^i = xi^(i*(p-1)/6)
+  Fp2 xi;
+  xi.c0 = FP_ONE; xi.c1 = FP_ONE;
+  Fp2 g;
+  fp2_pow(g, xi, EXP_P_MINUS_1_DIV_6, 6);
+  FROB_GAMMA1[0] = FP2_ONE;
+  for (int i = 1; i < 6; i++) fp2_mul(FROB_GAMMA1[i], FROB_GAMMA1[i - 1], g);
+  // curve constants + generators
+  fp_from_u64(G1_B, 4);
+  fp_from_u64(G2_B.c0, 4);
+  fp_from_u64(G2_B.c1, 4);
+  Fp gx, gy;
+  Fp g1x_std, g1y_std;
+  for (int i = 0; i < 6; i++) { g1x_std.l[i] = G1_GEN_X.l[i]; g1y_std.l[i] = G1_GEN_Y.l[i]; }
+  fp_to_mont(gx, g1x_std);
+  fp_to_mont(gy, g1y_std);
+  G1_GEN = pt_from_affine<FpOps>(gx, gy);
+  Fp2 g2x, g2y;
+  fp2_from_raw(g2x, G2_GEN_X);
+  fp2_from_raw(g2y, G2_GEN_Y);
+  G2_GEN = pt_from_affine<Fp2Ops>(g2x, g2y);
+  // SSWU constants (RFC 9380 §8.8.2): A' = 240u, B' = 1012(1+u), Z = -(2+u)
+  Fp f240, f1012, f2, f1;
+  fp_from_u64(f240, 240);
+  fp_from_u64(f1012, 1012);
+  fp_from_u64(f2, 2);
+  fp_from_u64(f1, 1);
+  SSWU_A.c0 = FP_ZERO; SSWU_A.c1 = f240;
+  SSWU_B.c0 = f1012; SSWU_B.c1 = f1012;
+  fp_neg(SSWU_Z.c0, f2);
+  fp_neg(SSWU_Z.c1, f1);
+  Fp2 a_inv, t;
+  fp2_inv(a_inv, SSWU_A);
+  fp2_mul(t, SSWU_B, a_inv);
+  fp2_neg(SSWU_NEG_B_OVER_A, t);
+  Fp2 za, za_inv;
+  fp2_mul(za, SSWU_Z, SSWU_A);
+  fp2_inv(za_inv, za);
+  fp2_mul(SSWU_B_OVER_ZA, SSWU_B, za_inv);
+  // isogeny tables
+  for (int i = 0; i < 4; i++) fp2_from_raw(ISO_XN[i], ISO_X_NUM[i]);
+  for (int i = 0; i < 3; i++) fp2_from_raw(ISO_XD[i], ISO_X_DEN[i]);
+  for (int i = 0; i < 4; i++) fp2_from_raw(ISO_YN[i], ISO_Y_NUM[i]);
+  for (int i = 0; i < 4; i++) fp2_from_raw(ISO_YD[i], ISO_Y_DEN[i]);
+  INITIALIZED = true;
+}
+
+// ---------------------------------------------------------------------------
+// SHA-256 (for expand_message_xmd); standard FIPS 180-4 constants
+// ---------------------------------------------------------------------------
+
+static const u32 SHA_K[64] = {
+  0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+  0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+  0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+  0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+  0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+  0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+  0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+  0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+  0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+  0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+  0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+struct Sha256 {
+  u32 h[8];
+  u8 buf[64];
+  u64 total;
+  size_t fill;
+};
+
+static inline u32 rotr(u32 x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha_init(Sha256& s) {
+  static const u32 H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  memcpy(s.h, H0, sizeof(H0));
+  s.total = 0;
+  s.fill = 0;
+}
+
+static void sha_block(Sha256& s, const u8* p) {
+  u32 w[64];
+  for (int i = 0; i < 16; i++)
+    w[i] = ((u32)p[4 * i] << 24) | ((u32)p[4 * i + 1] << 16) |
+           ((u32)p[4 * i + 2] << 8) | p[4 * i + 3];
+  for (int i = 16; i < 64; i++) {
+    u32 s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    u32 s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  u32 a = s.h[0], b = s.h[1], c = s.h[2], d = s.h[3];
+  u32 e = s.h[4], f = s.h[5], g = s.h[6], hh = s.h[7];
+  for (int i = 0; i < 64; i++) {
+    u32 S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    u32 ch = (e & f) ^ (~e & g);
+    u32 t1 = hh + S1 + ch + SHA_K[i] + w[i];
+    u32 S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    u32 maj = (a & b) ^ (a & c) ^ (b & c);
+    u32 t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  s.h[0] += a; s.h[1] += b; s.h[2] += c; s.h[3] += d;
+  s.h[4] += e; s.h[5] += f; s.h[6] += g; s.h[7] += hh;
+}
+
+static void sha_update(Sha256& s, const u8* data, size_t len) {
+  s.total += len;
+  while (len) {
+    if (s.fill == 0 && len >= 64) {
+      sha_block(s, data);
+      data += 64;
+      len -= 64;
+      continue;
+    }
+    size_t take = 64 - s.fill;
+    if (take > len) take = len;
+    memcpy(s.buf + s.fill, data, take);
+    s.fill += take;
+    data += take;
+    len -= take;
+    if (s.fill == 64) { sha_block(s, s.buf); s.fill = 0; }
+  }
+}
+
+static void sha_final(Sha256& s, u8 out[32]) {
+  u64 bits = s.total * 8;
+  u8 pad = 0x80;
+  sha_update(s, &pad, 1);
+  u8 z = 0;
+  while (s.fill != 56) sha_update(s, &z, 1);
+  u8 lenb[8];
+  for (int i = 0; i < 8; i++) lenb[i] = (u8)(bits >> (56 - 8 * i));
+  sha_update(s, lenb, 8);
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (u8)(s.h[i] >> 24);
+    out[4 * i + 1] = (u8)(s.h[i] >> 16);
+    out[4 * i + 2] = (u8)(s.h[i] >> 8);
+    out[4 * i + 3] = (u8)s.h[i];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// hash_to_g2 (RFC 9380, BLS12381G2_XMD:SHA-256_SSWU_RO_), mirrors
+// crypto/hash_to_curve.py
+// ---------------------------------------------------------------------------
+
+// len_in_bytes <= 256 covers count=2, m=2, L=64
+static bool expand_message_xmd(u8* out, size_t len_in_bytes, const u8* msg,
+                               size_t msg_len, const u8* dst, size_t dst_len) {
+  const size_t B = 32, RB = 64;
+  size_t ell = (len_in_bytes + B - 1) / B;
+  if (ell > 255 || len_in_bytes > 65535 || dst_len > 255) return false;
+  u8 dst_prime[256];
+  memcpy(dst_prime, dst, dst_len);
+  dst_prime[dst_len] = (u8)dst_len;
+  size_t dp_len = dst_len + 1;
+  u8 zpad[RB];
+  memset(zpad, 0, RB);
+  u8 lib[2] = {(u8)(len_in_bytes >> 8), (u8)len_in_bytes};
+  u8 b0[32], bi[32];
+  Sha256 s;
+  sha_init(s);
+  sha_update(s, zpad, RB);
+  sha_update(s, msg, msg_len);
+  sha_update(s, lib, 2);
+  u8 zero = 0;
+  sha_update(s, &zero, 1);
+  sha_update(s, dst_prime, dp_len);
+  sha_final(s, b0);
+  sha_init(s);
+  sha_update(s, b0, 32);
+  u8 one = 1;
+  sha_update(s, &one, 1);
+  sha_update(s, dst_prime, dp_len);
+  sha_final(s, bi);
+  size_t off = 0;
+  for (size_t i = 1;; i++) {
+    size_t take = len_in_bytes - off < 32 ? len_in_bytes - off : 32;
+    memcpy(out + off, bi, take);
+    off += take;
+    if (off >= len_in_bytes) break;
+    u8 x[32];
+    for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+    sha_init(s);
+    sha_update(s, x, 32);
+    u8 idx = (u8)(i + 1);
+    sha_update(s, &idx, 1);
+    sha_update(s, dst_prime, dp_len);
+    sha_final(s, bi);
+  }
+  return true;
+}
+
+// 64-byte big-endian -> Fp via Horner in the field
+static void fp_from_64_bytes(Fp& out, const u8 in[64]) {
+  Fp b;  // 2^64 as a field element
+  fp_from_u64(b, 0);  // placeholder; set below via doubling
+  // 2^64 = (2^32)^2; build from u64 1<<32 squared to stay in range
+  Fp t32;
+  fp_from_u64(t32, (u64)1 << 32);
+  fp_mul(b, t32, t32);
+  Fp acc;
+  fp_from_u64(acc, 0);
+  for (int i = 0; i < 8; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[i * 8 + j];
+    Fp lw;
+    fp_from_u64(lw, w);
+    fp_mul(acc, acc, b);
+    fp_add(acc, acc, lw);
+  }
+  out = acc;
+}
+
+static void map_to_curve_sswu(Fp2& xo, Fp2& yo, const Fp2& u) {
+  Fp2 u2, zu2, tv, x1, gx1, y1, t;
+  fp2_sqr(u2, u);
+  fp2_mul(zu2, SSWU_Z, u2);
+  fp2_sqr(tv, zu2);
+  fp2_add(tv, tv, zu2);
+  if (fp2_is_zero(tv)) {
+    x1 = SSWU_B_OVER_ZA;
+  } else {
+    Fp2 tv_inv;
+    fp2_inv(tv_inv, tv);
+    fp2_add(t, FP2_ONE, tv_inv);
+    fp2_mul(x1, SSWU_NEG_B_OVER_A, t);
+  }
+  // g(x) = x^3 + A x + B
+  Fp2 x3, ax;
+  fp2_sqr(t, x1);
+  fp2_mul(x3, t, x1);
+  fp2_mul(ax, SSWU_A, x1);
+  fp2_add(gx1, x3, ax);
+  fp2_add(gx1, gx1, SSWU_B);
+  Fp2 x, y;
+  if (fp2_sqrt(y1, gx1)) {
+    x = x1; y = y1;
+  } else {
+    Fp2 x2, gx2, y2;
+    fp2_mul(x2, zu2, x1);
+    fp2_sqr(t, x2);
+    fp2_mul(x3, t, x2);
+    fp2_mul(ax, SSWU_A, x2);
+    fp2_add(gx2, x3, ax);
+    fp2_add(gx2, gx2, SSWU_B);
+    fp2_sqrt(y2, gx2);  // must be square when gx1 is not
+    x = x2; y = y2;
+  }
+  if (fp2_sgn0(y) != fp2_sgn0(u)) fp2_neg(y, y);
+  xo = x; yo = y;
+}
+
+static void horner_fp2(Fp2& out, const Fp2* coeffs, int n, const Fp2& v) {
+  Fp2 acc = FP2_ZERO;
+  for (int i = n - 1; i >= 0; i--) {
+    Fp2 t;
+    fp2_mul(t, acc, v);
+    fp2_add(acc, t, coeffs[i]);
+  }
+  out = acc;
+}
+
+static void iso_map_to_g2(G2& out, const Fp2& x, const Fp2& y) {
+  Fp2 xn, xd, yn, yd;
+  horner_fp2(xn, ISO_XN, 4, x);
+  horner_fp2(xd, ISO_XD, 3, x);
+  horner_fp2(yn, ISO_YN, 4, x);
+  horner_fp2(yd, ISO_YD, 4, x);
+  if (fp2_is_zero(xd) || fp2_is_zero(yd)) {
+    out = pt_infinity<Fp2Ops>();
+    return;
+  }
+  Fp2 xd_inv, yd_inv, xo, yo, t;
+  fp2_inv(xd_inv, xd);
+  fp2_mul(xo, xn, xd_inv);
+  fp2_inv(yd_inv, yd);
+  fp2_mul(t, yn, yd_inv);
+  fp2_mul(yo, y, t);
+  out = pt_from_affine<Fp2Ops>(xo, yo);
+}
+
+static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
+                             const u8* dst, size_t dst_len) {
+  u8 uniform[256];
+  if (!expand_message_xmd(uniform, 256, msg, msg_len, dst, dst_len)) {
+    out = pt_infinity<Fp2Ops>();
+    return false;
+  }
+  Fp2 u0, u1;
+  fp_from_64_bytes(u0.c0, uniform);
+  fp_from_64_bytes(u0.c1, uniform + 64);
+  fp_from_64_bytes(u1.c0, uniform + 128);
+  fp_from_64_bytes(u1.c1, uniform + 192);
+  Fp2 x0, y0, x1, y1;
+  map_to_curve_sswu(x0, y0, u0);
+  map_to_curve_sswu(x1, y1, u1);
+  G2 q0, q1, sum;
+  iso_map_to_g2(q0, x0, y0);
+  iso_map_to_g2(q1, x1, y1);
+  pt_add(sum, q0, q1);
+  pt_mul(out, sum, H_EFF_G2_RAW, 10);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Pippenger multi-scalar multiplication
+// ---------------------------------------------------------------------------
+
+static void scalar_from_be32(u64 out[4], const u8 in[32]) {
+  for (int i = 0; i < 4; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[i * 8 + j];
+    out[3 - i] = w;
+  }
+}
+
+static inline int scalar_window(const u64* limbs, int nlimbs, int bit, int c) {
+  // c-bit digit starting at `bit` (LSB order), c <= 16
+  int limb = bit >> 6, off = bit & 63;
+  if (limb >= nlimbs) return 0;
+  u64 v = limbs[limb] >> off;
+  if (off + c > 64 && limb + 1 < nlimbs) v |= limbs[limb + 1] << (64 - off);
+  return (int)(v & (((u64)1 << c) - 1));
+}
+
+template <class Ops>
+static void pt_msm(Point<Ops>& out, const Point<Ops>* pts, const u64* scalars,
+                   size_t n, int scalar_bits) {
+  if (n == 0) { out = pt_infinity<Ops>(); return; }
+  int c = n < 4 ? 2 : n < 32 ? 4 : n < 256 ? 6 : n < 4096 ? 8 : 10;
+  int nbuckets = (1 << c) - 1;
+  Point<Ops>* buckets = new Point<Ops>[nbuckets];
+  Point<Ops> result = pt_infinity<Ops>();
+  int windows = (scalar_bits + c - 1) / c;
+  for (int win = windows - 1; win >= 0; win--) {
+    for (int i = 0; i < c; i++) pt_double(result, result);
+    for (int b = 0; b < nbuckets; b++) buckets[b] = pt_infinity<Ops>();
+    for (size_t k = 0; k < n; k++) {
+      int d = scalar_window(scalars + 4 * k, 4, win * c, c);
+      if (d) pt_add(buckets[d - 1], buckets[d - 1], pts[k]);
+    }
+    Point<Ops> running = pt_infinity<Ops>(), acc = pt_infinity<Ops>();
+    for (int b = nbuckets - 1; b >= 0; b--) {
+      pt_add(running, running, buckets[b]);
+      pt_add(acc, acc, running);
+    }
+    pt_add(result, result, acc);
+  }
+  delete[] buckets;
+  out = result;
+}
+
+// ---------------------------------------------------------------------------
+// raw affine IO (standard-form big-endian coordinates)
+// g1 raw: x || y (96 bytes); g2 raw: x.c0 || x.c1 || y.c0 || y.c1 (192)
+// ---------------------------------------------------------------------------
+
+static void g1_to_raw(u8 out[96], const G1& p) {
+  if (p.is_inf()) { memset(out, 0, 96); return; }
+  Fp ax, ay;
+  pt_to_affine<FpOps>(ax, ay, p);
+  fp_to_bytes(out, ax);
+  fp_to_bytes(out + 48, ay);
+}
+
+static bool g1_from_raw(G1& out, const u8 in[96], int is_inf) {
+  if (is_inf) { out = pt_infinity<FpOps>(); return true; }
+  Fp x, y;
+  if (!fp_from_bytes(x, in) || !fp_from_bytes(y, in + 48)) return false;
+  if (!pt_on_curve_affine<FpOps>(x, y, G1_B)) return false;
+  out = pt_from_affine<FpOps>(x, y);
+  return true;
+}
+
+static void g2_to_raw(u8 out[192], const G2& p) {
+  if (p.is_inf()) { memset(out, 0, 192); return; }
+  Fp2 ax, ay;
+  pt_to_affine<Fp2Ops>(ax, ay, p);
+  fp_to_bytes(out, ax.c0);
+  fp_to_bytes(out + 48, ax.c1);
+  fp_to_bytes(out + 96, ay.c0);
+  fp_to_bytes(out + 144, ay.c1);
+}
+
+static bool g2_from_raw(G2& out, const u8 in[192], int is_inf) {
+  if (is_inf) { out = pt_infinity<Fp2Ops>(); return true; }
+  Fp2 x, y;
+  if (!fp_from_bytes(x.c0, in) || !fp_from_bytes(x.c1, in + 48) ||
+      !fp_from_bytes(y.c0, in + 96) || !fp_from_bytes(y.c1, in + 144))
+    return false;
+  if (!pt_on_curve_affine<Fp2Ops>(x, y, G2_B)) return false;
+  out = pt_from_affine<Fp2Ops>(x, y);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// public C API
+// error codes: 0 ok / verify-false, 1 verify-true; negative = parse errors
+// (-2 not compressed, -3 bad infinity, -4 not in field, -5 not on curve,
+//  -6 not in subgroup, -1 other)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+u64 ec_bls_version() { return 3; }
+
+int ec_g1_decompress(const u8* in, u8* out_raw, int* is_inf, int check_subgroup) {
+  ensure_init();
+  G1 p;
+  int rc = g1_decompress(p, in, check_subgroup != 0);
+  if (rc != DEC_OK) return -rc;
+  *is_inf = p.is_inf() ? 1 : 0;
+  g1_to_raw(out_raw, p);
+  return 0;
+}
+
+int ec_g2_decompress(const u8* in, u8* out_raw, int* is_inf, int check_subgroup) {
+  ensure_init();
+  G2 p;
+  int rc = g2_decompress(p, in, check_subgroup != 0);
+  if (rc != DEC_OK) return -rc;
+  *is_inf = p.is_inf() ? 1 : 0;
+  g2_to_raw(out_raw, p);
+  return 0;
+}
+
+int ec_g1_compress_raw(const u8* raw, int is_inf, u8* out) {
+  ensure_init();
+  G1 p;
+  if (!g1_from_raw(p, raw, is_inf)) return -5;
+  g1_compress(out, p);
+  return 0;
+}
+
+int ec_g2_compress_raw(const u8* raw, int is_inf, u8* out) {
+  ensure_init();
+  G2 p;
+  if (!g2_from_raw(p, raw, is_inf)) return -5;
+  g2_compress(out, p);
+  return 0;
+}
+
+int ec_g1_generator_raw(u8* out) { ensure_init(); g1_to_raw(out, G1_GEN); return 0; }
+int ec_g2_generator_raw(u8* out) { ensure_init(); g2_to_raw(out, G2_GEN); return 0; }
+
+// scalar must be 32-byte BE, 0 < scalar < r enforced by caller
+int ec_bls_sk_to_pk(const u8* sk, u8* out) {
+  ensure_init();
+  u64 s[4];
+  scalar_from_be32(s, sk);
+  G1 p;
+  pt_mul(p, G1_GEN, s, 4);
+  g1_compress(out, p);
+  return 0;
+}
+
+int ec_bls_hash_to_g2(const u8* msg, size_t msg_len, const u8* dst,
+                      size_t dst_len, u8* out96) {
+  ensure_init();
+  G2 h;
+  if (!hash_to_g2_point(h, msg, msg_len, dst, dst_len)) return -1;
+  g2_compress(out96, h);
+  return 0;
+}
+
+int ec_bls_sign(const u8* sk, const u8* msg, size_t msg_len, const u8* dst,
+                size_t dst_len, u8* out96) {
+  ensure_init();
+  u64 s[4];
+  scalar_from_be32(s, sk);
+  G2 h, sig;
+  if (!hash_to_g2_point(h, msg, msg_len, dst, dst_len)) return -1;
+  pt_mul(sig, h, s, 4);
+  g2_compress(out96, sig);
+  return 0;
+}
+
+int ec_bls_verify(const u8* pk48, const u8* msg, size_t msg_len, const u8* dst,
+                  size_t dst_len, const u8* sig96, int assume_valid) {
+  ensure_init();
+  G1 pk;
+  int rc = g1_decompress(pk, pk48, assume_valid == 0);
+  if (rc != DEC_OK) return -rc;
+  G2 sig;
+  rc = g2_decompress(sig, sig96, assume_valid == 0);
+  if (rc != DEC_OK) return -rc;
+  if (pk.is_inf() || sig.is_inf()) return 0;
+  G2 h;
+  if (!hash_to_g2_point(h, msg, msg_len, dst, dst_len)) return -1;
+  G1 neg_gen;
+  pt_neg(neg_gen, G1_GEN);
+  G1 ps[2] = {pk, neg_gen};
+  G2 qs[2] = {h, sig};
+  return pairing_product_is_one(ps, qs, 2) ? 1 : 0;
+}
+
+int ec_bls_fast_aggregate_verify(const u8* pks, size_t n, const u8* msg,
+                                 size_t msg_len, const u8* dst, size_t dst_len,
+                                 const u8* sig96, int assume_valid) {
+  ensure_init();
+  if (n == 0) return 0;
+  G1 acc = pt_infinity<FpOps>();
+  for (size_t i = 0; i < n; i++) {
+    G1 pk;
+    int rc = g1_decompress(pk, pks + 48 * i, assume_valid == 0);
+    if (rc != DEC_OK) return -rc;
+    if (pk.is_inf()) return 0;  // PublicKey semantics: identity is invalid
+    pt_add(acc, acc, pk);
+  }
+  G2 sig;
+  int rc = g2_decompress(sig, sig96, assume_valid == 0);
+  if (rc != DEC_OK) return -rc;
+  if (acc.is_inf() || sig.is_inf()) return 0;
+  G2 h;
+  if (!hash_to_g2_point(h, msg, msg_len, dst, dst_len)) return -1;
+  G1 neg_gen;
+  pt_neg(neg_gen, G1_GEN);
+  G1 ps[2] = {acc, neg_gen};
+  G2 qs[2] = {h, sig};
+  return pairing_product_is_one(ps, qs, 2) ? 1 : 0;
+}
+
+int ec_bls_aggregate_verify(const u8* pks, size_t n, const u8* msgs,
+                            const u32* msg_lens, const u8* dst, size_t dst_len,
+                            const u8* sig96, int assume_valid) {
+  ensure_init();
+  if (n == 0) return 0;
+  G2 sig;
+  int rc = g2_decompress(sig, sig96, assume_valid == 0);
+  if (rc != DEC_OK) return -rc;
+  if (sig.is_inf()) return 0;
+  G1* ps = new G1[n + 1];
+  G2* qs = new G2[n + 1];
+  size_t off = 0;
+  for (size_t i = 0; i < n; i++) {
+    G1 pk;
+    rc = g1_decompress(pk, pks + 48 * i, assume_valid == 0);
+    if (rc != DEC_OK) { delete[] ps; delete[] qs; return -rc; }
+    if (pk.is_inf()) { delete[] ps; delete[] qs; return 0; }
+    ps[i] = pk;
+    if (!hash_to_g2_point(qs[i], msgs + off, msg_lens[i], dst, dst_len)) {
+      delete[] ps; delete[] qs;
+      return -1;
+    }
+    off += msg_lens[i];
+  }
+  pt_neg(ps[n], G1_GEN);
+  qs[n] = sig;
+  bool ok = pairing_product_is_one(ps, qs, n + 1);
+  delete[] ps;
+  delete[] qs;
+  return ok ? 1 : 0;
+}
+
+int ec_bls_aggregate_sigs(const u8* sigs, size_t n, u8* out96) {
+  ensure_init();
+  if (n == 0) return -1;
+  G2 acc = pt_infinity<Fp2Ops>();
+  for (size_t i = 0; i < n; i++) {
+    G2 s;
+    int rc = g2_decompress(s, sigs + 96 * i, true);
+    if (rc != DEC_OK) return -rc;
+    pt_add(acc, acc, s);
+  }
+  g2_compress(out96, acc);
+  return 0;
+}
+
+int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
+  ensure_init();
+  if (n == 0) return -1;
+  G1 acc = pt_infinity<FpOps>();
+  for (size_t i = 0; i < n; i++) {
+    G1 p;
+    int rc = g1_decompress(p, pks + 48 * i, true);
+    if (rc != DEC_OK) return -rc;
+    if (p.is_inf()) return -3;  // eth_aggregate_public_keys validates each key
+    pt_add(acc, acc, p);
+  }
+  g1_compress(out48, acc);
+  return 0;
+}
+
+// Random-linear-combination batch verification: every set must satisfy
+// fast_aggregate_verify. scalars16: per-set 16-byte BE nonzero blinders
+// (caller supplies; set 0 may be 1). Returns 1 all-valid, 0 otherwise.
+int ec_bls_batch_verify(size_t n_sets, const u32* pk_counts, const u8* pks,
+                        const u8* msgs, const u32* msg_lens, const u8* sigs,
+                        const u8* dst, size_t dst_len, const u8* scalars16) {
+  ensure_init();
+  if (n_sets == 0) return 1;
+  G1* ps = new G1[n_sets + 1];
+  G2* qs = new G2[n_sets + 1];
+  G2 sig_acc = pt_infinity<Fp2Ops>();
+  size_t pk_off = 0, msg_off = 0;
+  bool ok = true;
+  for (size_t i = 0; i < n_sets && ok; i++) {
+    u32 cnt = pk_counts[i];
+    if (cnt == 0) { ok = false; break; }
+    G1 agg = pt_infinity<FpOps>();
+    for (u32 j = 0; j < cnt; j++) {
+      G1 pk;
+      if (g1_decompress(pk, pks + 48 * (pk_off + j), true) != DEC_OK ||
+          pk.is_inf()) {
+        ok = false;
+        break;
+      }
+      pt_add(agg, agg, pk);
+    }
+    pk_off += cnt;
+    if (!ok) break;
+    G2 sig;
+    if (g2_decompress(sig, sigs + 96 * i, true) != DEC_OK || sig.is_inf() ||
+        agg.is_inf()) {
+      ok = false;
+      break;
+    }
+    u64 r[4] = {0, 0, 0, 0};
+    for (int b = 0; b < 8; b++) r[1] = (r[1] << 8) | scalars16[16 * i + b];
+    for (int b = 8; b < 16; b++) r[0] = (r[0] << 8) | scalars16[16 * i + b];
+    if ((r[0] | r[1]) == 0) { ok = false; break; }
+    G1 rp;
+    pt_mul(rp, agg, r, 2);
+    G2 rs;
+    pt_mul(rs, sig, r, 2);
+    pt_add(sig_acc, sig_acc, rs);
+    ps[i] = rp;
+    if (!hash_to_g2_point(qs[i], msgs + msg_off, msg_lens[i], dst, dst_len)) {
+      ok = false;
+      break;
+    }
+    msg_off += msg_lens[i];
+  }
+  if (ok) {
+    pt_neg(ps[n_sets], G1_GEN);
+    qs[n_sets] = sig_acc;
+    ok = pairing_product_is_one(ps, qs, n_sets + 1);
+  }
+  delete[] ps;
+  delete[] qs;
+  return ok ? 1 : 0;
+}
+
+int ec_g1_msm(const u8* points_raw, const u8* scalars32, size_t n, u8* out_raw,
+              int* out_inf) {
+  ensure_init();
+  G1* pts = new G1[n];
+  u64* sc = new u64[4 * n];
+  for (size_t i = 0; i < n; i++) {
+    if (!g1_from_raw(pts[i], points_raw + 96 * i, 0)) {
+      delete[] pts; delete[] sc;
+      return -5;
+    }
+    scalar_from_be32(sc + 4 * i, scalars32 + 32 * i);
+  }
+  G1 r;
+  pt_msm(r, pts, sc, n, 256);
+  *out_inf = r.is_inf() ? 1 : 0;
+  g1_to_raw(out_raw, r);
+  delete[] pts;
+  delete[] sc;
+  return 0;
+}
+
+int ec_g2_msm(const u8* points_raw, const u8* scalars32, size_t n, u8* out_raw,
+              int* out_inf) {
+  ensure_init();
+  G2* pts = new G2[n];
+  u64* sc = new u64[4 * n];
+  for (size_t i = 0; i < n; i++) {
+    if (!g2_from_raw(pts[i], points_raw + 192 * i, 0)) {
+      delete[] pts; delete[] sc;
+      return -5;
+    }
+    scalar_from_be32(sc + 4 * i, scalars32 + 32 * i);
+  }
+  G2 r;
+  pt_msm(r, pts, sc, n, 256);
+  *out_inf = r.is_inf() ? 1 : 0;
+  g2_to_raw(out_raw, r);
+  delete[] pts;
+  delete[] sc;
+  return 0;
+}
+
+int ec_g1_mul_raw(const u8* point_raw, int is_inf, const u8* scalar32,
+                  u8* out_raw, int* out_inf) {
+  ensure_init();
+  G1 p;
+  if (!g1_from_raw(p, point_raw, is_inf)) return -5;
+  u64 s[4];
+  scalar_from_be32(s, scalar32);
+  G1 r;
+  pt_mul(r, p, s, 4);
+  *out_inf = r.is_inf() ? 1 : 0;
+  g1_to_raw(out_raw, r);
+  return 0;
+}
+
+int ec_g1_add_raw(const u8* a_raw, int a_inf, const u8* b_raw, int b_inf,
+                  u8* out_raw, int* out_inf) {
+  ensure_init();
+  G1 a, b;
+  if (!g1_from_raw(a, a_raw, a_inf) || !g1_from_raw(b, b_raw, b_inf)) return -5;
+  G1 r;
+  pt_add(r, a, b);
+  *out_inf = r.is_inf() ? 1 : 0;
+  g1_to_raw(out_raw, r);
+  return 0;
+}
+
+int ec_g1_subgroup_check_raw(const u8* raw) {
+  ensure_init();
+  G1 p;
+  if (!g1_from_raw(p, raw, 0)) return -5;
+  return pt_in_subgroup(p) ? 1 : 0;
+}
+
+int ec_g2_subgroup_check_raw(const u8* raw) {
+  ensure_init();
+  G2 p;
+  if (!g2_from_raw(p, raw, 0)) return -5;
+  return pt_in_subgroup(p) ? 1 : 0;
+}
+
+int ec_pairing_product_is_one_raw(const u8* g1_raw, const u8* g1_inf,
+                                  const u8* g2_raw, const u8* g2_inf,
+                                  size_t n) {
+  ensure_init();
+  G1* ps = new G1[n];
+  G2* qs = new G2[n];
+  for (size_t i = 0; i < n; i++) {
+    if (!g1_from_raw(ps[i], g1_raw + 96 * i, g1_inf[i]) ||
+        !g2_from_raw(qs[i], g2_raw + 192 * i, g2_inf[i])) {
+      delete[] ps; delete[] qs;
+      return -5;
+    }
+  }
+  bool ok = pairing_product_is_one(ps, qs, n);
+  delete[] ps;
+  delete[] qs;
+  return ok ? 1 : 0;
+}
+
+}  // extern "C"
