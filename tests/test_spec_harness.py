@@ -132,6 +132,186 @@ def test_negative_vector_must_error(tmp_path):
     assert results["fail"] == 1
 
 
+def test_injected_bug_fails_negative_vector(tmp_path, monkeypatch):
+    """VERDICT #8: a TypeError from a genuine bug must make a negative
+    (no-post) vector FAIL — only the structured error taxonomy counts as
+    "correctly rejected"."""
+    state, ctx = fresh_genesis(16, "minimal")
+    ns = phase0.build(ctx.preset)
+    pre = state.copy()
+    att = ns.Attestation()  # empty attestation — invalid either way
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "operations", "attestation", "pyspec_tests", "neg"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+            "attestation.ssz_snappy": ns.Attestation.serialize(att),
+        },
+    )
+    from ethereum_consensus_tpu.models.phase0 import block_processing as bp
+
+    def buggy(state, attestation, context):
+        raise TypeError("injected bug")
+
+    monkeypatch.setattr(bp, "process_attestation", buggy)
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 1, (
+        "TypeError crash was accepted as a valid rejection"
+    )
+    # and without the injected bug the same vector passes (structured error)
+    monkeypatch.undo()
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 0, results["failures"]
+    assert results["pass"] == 1
+
+
+def test_kzg_runner_vectors(tmp_path):
+    """kzg runner: six handlers over synthesized vectors on the ceremony
+    setup (n=4096), incl. a malformed-input null vector and the
+    crash-vs-null discrimination."""
+    from ethereum_consensus_tpu.crypto import kzg as kzg_crypto
+
+    ctx = Context.for_minimal()
+    settings = ctx.kzg_settings
+    blob = bytes(32) * 4096  # zero polynomial — valid blob
+    commitment = kzg_crypto.blob_to_kzg_commitment(blob, settings)
+    z = (2).to_bytes(32, "big")
+    proof, y = kzg_crypto.compute_kzg_proof(blob, z, settings)
+    blob_proof = kzg_crypto.compute_blob_kzg_proof(blob, bytes(commitment), settings)
+
+    def data_yaml(inp: dict, output) -> str:
+        import json
+
+        return json.dumps({"input": inp, "output": output}) + "\n"
+
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "blob_to_kzg_commitment", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml({"blob": "0x" + blob.hex()},
+                                "0x" + bytes(commitment).hex())},
+    )
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "compute_kzg_proof", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml(
+            {"blob": "0x" + blob.hex(), "z": "0x" + z.hex()},
+            ["0x" + bytes(proof).hex(), "0x" + y.hex()],
+        )},
+    )
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "verify_kzg_proof", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml(
+            {"commitment": "0x" + bytes(commitment).hex(),
+             "z": "0x" + z.hex(), "y": "0x" + y.hex(),
+             "proof": "0x" + bytes(proof).hex()},
+            True,
+        )},
+    )
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "compute_blob_kzg_proof", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml(
+            {"blob": "0x" + blob.hex(),
+             "commitment": "0x" + bytes(commitment).hex()},
+            "0x" + bytes(blob_proof).hex(),
+        )},
+    )
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "verify_blob_kzg_proof", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml(
+            {"blob": "0x" + blob.hex(),
+             "commitment": "0x" + bytes(commitment).hex(),
+             "proof": "0x" + bytes(blob_proof).hex()},
+            True,
+        )},
+    )
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "verify_blob_kzg_proof_batch", "kzg-mainnet", "ok"),
+        {"data.yaml": data_yaml(
+            {"blobs": ["0x" + blob.hex()],
+             "commitments": ["0x" + bytes(commitment).hex()],
+             "proofs": ["0x" + bytes(blob_proof).hex()]},
+            True,
+        )},
+    )
+    # malformed input (blob too short) with expected null → structured pass
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "blob_to_kzg_commitment", "kzg-mainnet",
+         "bad_blob"),
+        {"data.yaml": data_yaml({"blob": "0x1234"}, None)},
+    )
+    # wrong verdict: valid verify inputs but expected null → must FAIL
+    _write_vector(
+        tmp_path,
+        ("general", "deneb", "kzg", "verify_kzg_proof", "kzg-mainnet",
+         "wrong_null"),
+        {"data.yaml": data_yaml(
+            {"commitment": "0x" + bytes(commitment).hex(),
+             "z": "0x" + z.hex(), "y": "0x" + y.hex(),
+             "proof": "0x" + bytes(proof).hex()},
+            None,
+        )},
+    )
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 1, results["failures"]  # only wrong_null
+    assert results["pass"] == 7
+
+
+def test_rewards_runner_vectors(tmp_path):
+    """rewards runner: Deltas SSZ container + per-component comparison for
+    phase0 (5 components) and altair (per-flag, no inclusion delay)."""
+    from spec_tests.runners import _deltas_type
+
+    state, ctx = fresh_genesis(16, "minimal")
+    ns = phase0.build(ctx.preset)
+    from ethereum_consensus_tpu.models.phase0 import epoch_processing as ep
+    from ethereum_consensus_tpu.models.phase0.slot_processing import process_slots
+
+    pre = state.copy()
+    process_slots(pre, 2 * ctx.SLOTS_PER_EPOCH, ctx)  # past genesis epoch
+    Deltas = _deltas_type(ctx.preset.phase0.VALIDATOR_REGISTRY_LIMIT)
+
+    def deltas_bytes(pair):
+        rewards, penalties = pair
+        return Deltas.serialize(Deltas(rewards=rewards, penalties=penalties))
+
+    files = {
+        "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+        "source_deltas.ssz_snappy": deltas_bytes(ep.get_source_deltas(pre, ctx)),
+        "target_deltas.ssz_snappy": deltas_bytes(ep.get_target_deltas(pre, ctx)),
+        "head_deltas.ssz_snappy": deltas_bytes(ep.get_head_deltas(pre, ctx)),
+        "inclusion_delay_deltas.ssz_snappy": deltas_bytes(
+            ep.get_inclusion_delay_deltas(pre, ctx)
+        ),
+        "inactivity_penalty_deltas.ssz_snappy": deltas_bytes(
+            ep.get_inactivity_penalty_deltas(pre, ctx)
+        ),
+    }
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "rewards", "basic", "pyspec_tests", "ok"),
+        files,
+    )
+    # a corrupted expectation must FAIL
+    bad = dict(files)
+    wrong = ep.get_source_deltas(pre, ctx)
+    bad["source_deltas.ssz_snappy"] = deltas_bytes(
+        ([r + 1 for r in wrong[0]], wrong[1])
+    )
+    _write_vector(
+        tmp_path,
+        ("minimal", "phase0", "rewards", "basic", "pyspec_tests", "bad"),
+        bad,
+    )
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 1, results["failures"]
+    assert results["pass"] == 1
+
+
 @pytest.mark.skipif(
     "SPEC_TEST_ROOT" not in os.environ
     or not os.path.isdir(os.path.join(os.environ["SPEC_TEST_ROOT"], "tests")),
