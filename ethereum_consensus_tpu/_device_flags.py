@@ -1,0 +1,36 @@
+"""Runtime switches for device acceleration of spec-path functions.
+
+Deliberately free of any jax import: the host layers (models/, ssz/)
+consult these flags on every call and only lazily import the ops package
+when a flag is on, so a host-only process never pays for jax. The flags
+are set by ``ops.install()`` (and unset by ``ops.uninstall()``).
+
+Thresholds are minimum element counts: device sweeps/shuffles win only
+above a size where kernel launch + host<->device packing amortizes; below
+the threshold the spec functions keep their host path.
+"""
+
+from __future__ import annotations
+
+SWEEPS_MIN_N: int | None = None
+SHUFFLE_MIN_N: int | None = None
+BLS_AGG_MIN_N: int | None = None
+
+
+def sweeps_enabled(n: int) -> bool:
+    """Route registry sweeps (flag deltas, inactivity, hysteresis) to
+    device for an ``n``-validator registry?"""
+    return SWEEPS_MIN_N is not None and n >= SWEEPS_MIN_N
+
+
+def shuffle_enabled(n: int) -> bool:
+    """Route committee shuffling to the device whole-list kernel for an
+    ``n``-element index list?"""
+    return SHUFFLE_MIN_N is not None and n >= SHUFFLE_MIN_N
+
+
+def bls_agg_enabled(n: int) -> bool:
+    """Route G1 pubkey aggregation to the device limb kernels for an
+    ``n``-point batch? (Below the threshold the native C++ adds win —
+    the device fold is latency-bound, not work-bound.)"""
+    return BLS_AGG_MIN_N is not None and n >= BLS_AGG_MIN_N

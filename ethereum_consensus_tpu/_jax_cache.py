@@ -1,0 +1,32 @@
+"""Persistent XLA compilation cache.
+
+Device-shape compiles dominate wall-clock on the tunneled TPU (tens of
+seconds per distinct shape); caching them on disk makes every re-run —
+tests, bench, driver entry — hit the compiled binary instead. Called from
+the jax chokepoints (ops/, parallel/) so host-only imports never pull jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+_ENABLED = False
+
+
+def enable(cache_dir: str | None = None) -> None:
+    """Idempotently point jax at the on-disk compile cache."""
+    global _ENABLED
+    if _ENABLED:
+        return
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        cache_dir or os.environ.get("EC_JAX_CACHE_DIR", _DEFAULT_DIR),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _ENABLED = True

@@ -16,6 +16,7 @@ import json
 from typing import Iterator
 
 from ..serde import from_hex
+from ..utils import trace
 from .errors import ApiError
 from .types import (
     AttestationDuty,
@@ -70,7 +71,10 @@ class Client:
             raise error
 
     def http_get(self, path: str, params=None, headers=None):
-        response = self.session.get(self._url(path), params=params, headers=headers)
+        with trace.span("api.get", path=path):
+            response = self.session.get(
+                self._url(path), params=params, headers=headers
+            )
         self._raise_for_api_error(response)
         return response
 
@@ -87,7 +91,10 @@ class Client:
         )
 
     def http_post(self, path: str, payload=None, headers=None):
-        response = self.session.post(self._url(path), json=payload, headers=headers)
+        with trace.span("api.post", path=path):
+            response = self.session.post(
+                self._url(path), json=payload, headers=headers
+            )
         self._raise_for_api_error(response)
         return response
 

@@ -27,6 +27,7 @@ import hashlib
 import os
 import secrets
 
+from .. import _device_flags
 from ..error import (
     InvalidPublicKeyError,
     InvalidSecretKeyError,
@@ -152,20 +153,37 @@ class PublicKey:
     be the identity (it then never verifies).
 
     Holds either a decoded G1Point, validated compressed bytes, or both;
-    the point decodes lazily so the native fast path never pays for it."""
+    the point decodes lazily so the native fast path never pays for it.
+    The decompressed affine form (``raw_uncompressed``) is cached after
+    first use — decompression costs a field sqrt + subgroup check, and the
+    chain workload re-verifies the same validator keys every block."""
 
-    __slots__ = ("_point", "_bytes")
+    __slots__ = ("_point", "_bytes", "_raw")
 
     def __init__(self, point: G1Point):
         self._point = point
         self._bytes = None
+        self._raw = None
 
     @classmethod
     def _from_valid_bytes(cls, data: bytes) -> "PublicKey":
         self = cls.__new__(cls)
         self._point = None
         self._bytes = bytes(data)
+        self._raw = None
         return self
+
+    def raw_uncompressed(self) -> bytes:
+        """Affine x||y (96 bytes, big-endian), decompressed once and
+        cached. Native backend only (callers gate on it)."""
+        if self._raw is None:
+            rc, raw, is_inf = native_bls.g1_decompress(
+                self.to_bytes(), check_subgroup=False
+            )
+            if rc != 0:
+                raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
+            self._raw = b"\x00" * 96 if is_inf else raw
+        return self._raw
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
@@ -361,10 +379,23 @@ def fast_aggregate_verify(
     dst: bytes = ETH_DST,
 ) -> bool:
     """All keys sign the same message: aggregate the pubkeys, verify once
-    (bls.rs fast_aggregate_verify:114)."""
+    (bls.rs fast_aggregate_verify:114).
+
+    Large batches route the aggregation through the device G1 kernel
+    (ops/g1.py log-depth limb fold) when installed — the O(N) piece; the
+    single pairing stays native."""
     if not public_keys:
         return False
     if _native():
+        if _device_flags.bls_agg_enabled(len(public_keys)):
+            try:
+                agg = _aggregate_on_device(public_keys)
+            except Exception:  # noqa: BLE001 — device trouble must not change verdicts
+                pass  # fall through to the native path
+            else:
+                if agg is None:
+                    return False  # identity aggregate never verifies
+                return verify_signature(agg, message, signature, dst)
         rc = native_bls.fast_aggregate_verify(
             [pk.to_bytes() for pk in public_keys], message,
             signature.to_bytes(), dst,
@@ -375,6 +406,20 @@ def fast_aggregate_verify(
     for pk in public_keys:
         acc = acc + pk.point
     return verify_signature(PublicKey(acc), message, signature, dst)
+
+
+def _aggregate_on_device(public_keys: list[PublicKey]) -> "PublicKey | None":
+    """Device pubkey aggregation; None when the sum is the identity (which
+    can never verify) or the device path is unusable."""
+    from ..ops import g1 as device_g1
+
+    raws = [pk.raw_uncompressed() for pk in public_keys]
+    raw_sum, is_inf = device_g1.aggregate_pubkeys_device(raws)
+    if is_inf:
+        return None
+    agg = PublicKey._from_valid_bytes(native_bls.g1_compress_raw(raw_sum))
+    agg._raw = raw_sum
+    return agg
 
 
 def eth_aggregate_public_keys(public_keys: list[PublicKey]) -> PublicKey:
@@ -438,7 +483,35 @@ class SignatureSet:
 
 
 def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
-    """One RLC multi-pairing over every set (native backend only)."""
+    """One RLC multi-pairing over every set (native backend only).
+
+    When the device G1 kernels are installed and the batch carries enough
+    keys, every set's pubkey aggregation runs as ONE segmented device fold
+    (ops/g1.py) and the native multi-pairing sees single-key sets — the
+    device owns the O(total keys) work, the host the O(sets) pairings."""
+    total_keys = sum(len(s.public_keys) for s in sets)
+    if _device_flags.bls_agg_enabled(total_keys):
+        try:
+            from ..ops import g1 as device_g1
+
+            agg = device_g1.aggregate_pubkey_sets_device(
+                [[pk.raw_uncompressed() for pk in s.public_keys] for s in sets]
+            )
+        except Exception:  # noqa: BLE001 — device trouble must not change verdicts
+            agg = None
+        if agg is not None:
+            if any(is_inf for _, is_inf in agg):
+                return False  # an identity aggregate never verifies
+            sets = [
+                SignatureSet(
+                    [PublicKey._from_valid_bytes(
+                        native_bls.g1_compress_raw(raw)
+                    )],
+                    s.message,
+                    s.signature,
+                )
+                for (raw, _), s in zip(agg, sets)
+            ]
     scalars = [(1).to_bytes(16, "big")]
     for _ in range(len(sets) - 1):
         while True:

@@ -11,6 +11,7 @@ executor.rs:155-172), electra is supported.
 
 from __future__ import annotations
 
+from .utils import trace
 from .error import IncompatibleForksError
 from .fork import Fork
 from .models.transition import Validation
@@ -38,7 +39,10 @@ class Executor:
 
     def apply_block(self, signed_block) -> None:
         """(executor.rs:113)"""
-        self.apply_block_with_validation(signed_block, Validation.ENABLED)
+        with trace.span(
+            "executor.apply_block", slot=int(signed_block.message.slot)
+        ):
+            self.apply_block_with_validation(signed_block, Validation.ENABLED)
 
     def apply_block_with_validation(self, signed_block, validation) -> None:
         """(executor.rs:135)"""

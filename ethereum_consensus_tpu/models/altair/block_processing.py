@@ -21,6 +21,7 @@ from ...error import (
 )
 from ...signing import compute_signing_root
 from ...ssz import is_valid_merkle_branch
+from ..signature_batch import verify_or_defer
 from ..phase0.block_processing import (  # noqa: F401 — fork-diff re-exports
     get_validator_from_deposit,
     process_block_header,
@@ -84,7 +85,13 @@ def process_attestation(state, attestation, context) -> None:
 
     indexed = h.get_indexed_attestation(state, attestation, context)
     try:
-        h.is_valid_indexed_attestation(state, indexed, context)
+        h.is_valid_indexed_attestation(
+            state, indexed, context,
+            error=InvalidAttestation(
+                f"attestation at slot {data.slot} committee {data.index}: "
+                "aggregate signature does not verify"
+            ),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttestation(str(exc)) from exc
 
@@ -129,8 +136,14 @@ def process_attester_slashing(state, attester_slashing, context, slash_fn=None) 
     if not h.is_slashable_attestation_data(attestation_1.data, attestation_2.data):
         raise InvalidAttesterSlashing("attestation data not slashable")
     try:
-        h.is_valid_indexed_attestation(state, attestation_1, context)
-        h.is_valid_indexed_attestation(state, attestation_2, context)
+        h.is_valid_indexed_attestation(
+            state, attestation_1, context,
+            error=InvalidAttesterSlashing("attestation 1 signature invalid"),
+        )
+        h.is_valid_indexed_attestation(
+            state, attestation_2, context,
+            error=InvalidAttesterSlashing("attestation 2 signature invalid"),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttesterSlashing(str(exc)) from exc
 
@@ -228,17 +241,19 @@ def process_sync_aggregate(state, sync_aggregate, context) -> None:
     from ...primitives import Root
 
     signing_root = compute_signing_root(Root, root_at_slot, domain)
+    error = InvalidSyncAggregate("invalid sync committee aggregate signature")
     try:
         sig = bls.Signature.from_bytes(sync_aggregate.sync_committee_signature)
-        ok = bls.eth_fast_aggregate_verify(
-            [bls.PublicKey.from_bytes(bytes(pk)) for pk in participant_keys],
-            signing_root,
-            sig,
-        )
-    except Exception:
-        ok = False
-    if not ok:
-        raise InvalidSyncAggregate("invalid sync committee aggregate signature")
+        keys = [bls.PublicKey.from_bytes(bytes(pk)) for pk in participant_keys]
+    except Exception as exc:
+        raise InvalidSyncAggregate(str(exc)) from exc
+    if not keys:
+        # the "no participants" infinity rule (bls.rs eth_fast_aggregate_
+        # verify:150) — a data-dependent special case, checked inline
+        if not bls.eth_fast_aggregate_verify([], signing_root, sig):
+            raise error
+    else:
+        verify_or_defer(keys, signing_root, sig, error)
 
     # participant + proposer rewards
     total_active_increments = (

@@ -9,6 +9,7 @@ altair process_epoch:305.
 
 from __future__ import annotations
 
+from ... import _device_flags
 from ...primitives import GENESIS_EPOCH
 from ..phase0.epoch_processing import (  # noqa: F401 — fork-diff re-exports
     process_effective_balance_updates,
@@ -53,9 +54,24 @@ def process_justification_and_finalization(state, context) -> None:
 
 
 def process_inactivity_updates(state, context) -> None:
-    """(epoch_processing.rs:104)"""
+    """(epoch_processing.rs:104) — whole-registry sweep; device twin above
+    threshold (ops/sweeps.py inactivity_updates_device)."""
     current_epoch = h.get_current_epoch(state, context)
     if current_epoch == GENESIS_EPOCH:
+        return
+    if _device_flags.sweeps_enabled(len(state.validators)):
+        from ...ops import sweeps as _sweeps
+
+        prev_epoch = h.get_previous_epoch(state, context)
+        packed = _sweeps.pack_registry(
+            state, prev_epoch,
+            use_current_participation=(prev_epoch == current_epoch),
+        )
+        scores = _sweeps.inactivity_updates_device(
+            packed, context, h.is_in_inactivity_leak(state, context)
+        )
+        for i, score in enumerate(scores):
+            state.inactivity_scores[i] = int(score)
         return
     eligible = h.get_eligible_validator_indices(state, context)
     unslashed_participating = h.get_unslashed_participating_indices(
@@ -74,19 +90,55 @@ def process_inactivity_updates(state, context) -> None:
             )
 
 
-def process_rewards_and_penalties(state, context) -> None:
-    """(epoch_processing.rs:160) — flag deltas + inactivity penalties."""
-    if h.get_current_epoch(state, context) == GENESIS_EPOCH:
+def process_rewards_and_penalties(
+    state,
+    context,
+    helpers=None,
+    inactivity_quotient_name="INACTIVITY_PENALTY_QUOTIENT_ALTAIR",
+) -> None:
+    """(epoch_processing.rs:160) — flag deltas + inactivity penalties.
+
+    Device path packs the registry ONCE and reuses it for all four delta
+    sweeps (the registry fields the sweeps read don't change until the
+    deltas are applied below). ``helpers`` / ``inactivity_quotient_name``
+    let later forks reuse this body with their helpers module and quotient
+    (bellatrix+)."""
+    hm = helpers or h
+    current_epoch = hm.get_current_epoch(state, context)
+    if current_epoch == GENESIS_EPOCH:
         return
-    deltas = [
-        h.get_flag_index_deltas(state, flag_index, context)
-        for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
-    ]
-    deltas.append(h.get_inactivity_penalty_deltas(state, context))
+    n = len(state.validators)
+    if _device_flags.sweeps_enabled(n):
+        from ...ops import sweeps as _sweeps
+
+        prev_epoch = hm.get_previous_epoch(state, context)
+        packed = _sweeps.pack_registry(
+            state, prev_epoch,
+            use_current_participation=(prev_epoch == current_epoch),
+        )
+        total_active = hm.get_total_active_balance(state, context)
+        is_leaking = hm.is_in_inactivity_leak(state, context)
+        deltas = [
+            _sweeps.flag_deltas_device(
+                packed, flag_index, total_active, context, is_leaking
+            )
+            for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
+        ]
+        deltas.append(
+            ([0] * n, _sweeps.inactivity_penalties_device(
+                packed, context, getattr(context, inactivity_quotient_name)
+            ))
+        )
+    else:
+        deltas = [
+            hm.get_flag_index_deltas(state, flag_index, context)
+            for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
+        ]
+        deltas.append(hm.get_inactivity_penalty_deltas(state, context))
     for rewards, penalties in deltas:
-        for index in range(len(state.validators)):
-            h.increase_balance(state, index, rewards[index])
-            h.decrease_balance(state, index, penalties[index])
+        for index in range(n):
+            hm.increase_balance(state, index, int(rewards[index]))
+            hm.decrease_balance(state, index, int(penalties[index]))
 
 
 def process_participation_flag_updates(state, context) -> None:

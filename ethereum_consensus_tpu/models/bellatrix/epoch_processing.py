@@ -10,7 +10,6 @@ from __future__ import annotations
 from ...primitives import GENESIS_EPOCH
 from .. import _diff
 from ..altair import epoch_processing as _altair_ep
-from ..altair.constants import PARTICIPATION_FLAG_WEIGHTS
 from ..altair.epoch_processing import (
     process_effective_balance_updates,
     process_eth1_data_reset,
@@ -29,18 +28,14 @@ __all__ = ["process_rewards_and_penalties", "process_slashings", "process_epoch"
 
 
 def process_rewards_and_penalties(state, context) -> None:
-    """altair shape with the bellatrix inactivity-penalty quotient."""
-    if h.get_current_epoch(state, context) == GENESIS_EPOCH:
-        return
-    deltas = [
-        h.get_flag_index_deltas(state, flag_index, context)
-        for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
-    ]
-    deltas.append(h.get_inactivity_penalty_deltas(state, context))
-    for rewards, penalties in deltas:
-        for index in range(len(state.validators)):
-            h.increase_balance(state, index, rewards[index])
-            h.decrease_balance(state, index, penalties[index])
+    """altair shape with the bellatrix inactivity-penalty quotient and
+    bellatrix helpers (same pack-once device path)."""
+    _altair_ep.process_rewards_and_penalties(
+        state,
+        context,
+        helpers=h,
+        inactivity_quotient_name="INACTIVITY_PENALTY_QUOTIENT_BELLATRIX",
+    )
 
 
 def process_slashings(state, context) -> None:

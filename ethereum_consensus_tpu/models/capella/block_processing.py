@@ -12,14 +12,15 @@ from __future__ import annotations
 from ...crypto import bls
 from ...domains import DomainType
 from ...error import (
+    CryptoError,
     InvalidBlsToExecutionChange,
     InvalidExecutionPayload,
-    InvalidSignatureError,
     InvalidWithdrawals,
 )
 from ...execution_engine import verify_and_notify_new_payload
 from ...primitives import BLS_WITHDRAWAL_PREFIX, ETH1_ADDRESS_WITHDRAWAL_PREFIX
-from ...signing import verify_signed_data
+from ...signing import compute_signing_root
+from ..signature_batch import verify_or_defer
 from .. import _diff
 from ..altair import block_processing as _altair_bp
 from ..bellatrix import block_processing as _bellatrix_bp
@@ -65,16 +66,16 @@ def process_bls_to_execution_change(state, signed_address_change, context) -> No
         bytes(state.genesis_validators_root),
         context,
     )
+    signing_root = compute_signing_root(BlsToExecutionChange, address_change, domain)
     try:
-        verify_signed_data(
-            BlsToExecutionChange,
-            address_change,
-            bytes(signed_address_change.signature),
-            public_key,
-            domain,
-        )
-    except InvalidSignatureError as exc:
+        pk = bls.PublicKey.from_bytes(public_key)
+        sig = bls.Signature.from_bytes(bytes(signed_address_change.signature))
+    except CryptoError as exc:
         raise InvalidBlsToExecutionChange(str(exc)) from exc
+    verify_or_defer(
+        [pk], signing_root, sig,
+        InvalidBlsToExecutionChange("invalid address-change signature"),
+    )
 
     validator.withdrawal_credentials = (
         ETH1_ADDRESS_WITHDRAWAL_PREFIX

@@ -11,16 +11,18 @@ from __future__ import annotations
 
 from ...domains import DomainType
 from ...error import (
+    CryptoError,
     InvalidAttestation,
     InvalidBlobData,
     InvalidExecutionPayload,
     InvalidIndexedAttestation,
-    InvalidSignatureError,
     InvalidVoluntaryExit,
 )
 from ...execution_engine import verify_and_notify_new_payload
 from ...primitives import FAR_FUTURE_EPOCH
-from ...signing import verify_signed_data
+from ...crypto import bls
+from ...signing import compute_signing_root
+from ..signature_batch import verify_or_defer
 from .. import _diff
 from ..altair import block_processing as _altair_bp
 from ..altair.constants import PROPOSER_WEIGHT, PARTICIPATION_FLAG_WEIGHTS, WEIGHT_DENOMINATOR
@@ -74,7 +76,13 @@ def process_attestation(state, attestation, context) -> None:
 
     indexed = h.get_indexed_attestation(state, attestation, context)
     try:
-        h.is_valid_indexed_attestation(state, indexed, context)
+        h.is_valid_indexed_attestation(
+            state, indexed, context,
+            error=InvalidAttestation(
+                f"attestation at slot {data.slot} committee {data.index}: "
+                "aggregate signature does not verify"
+            ),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttestation(str(exc)) from exc
 
@@ -170,16 +178,15 @@ def process_voluntary_exit(state, signed_voluntary_exit, context) -> None:
         bytes(state.genesis_validators_root),
         context,
     )
+    signing_root = compute_signing_root(VoluntaryExit, voluntary_exit, domain)
     try:
-        verify_signed_data(
-            VoluntaryExit,
-            voluntary_exit,
-            bytes(signed_voluntary_exit.signature),
-            bytes(validator.public_key),
-            domain,
-        )
-    except InvalidSignatureError as exc:
+        pk = bls.PublicKey.from_bytes(bytes(validator.public_key))
+        sig = bls.Signature.from_bytes(bytes(signed_voluntary_exit.signature))
+    except CryptoError as exc:
         raise InvalidVoluntaryExit(str(exc)) from exc
+    verify_or_defer(
+        [pk], signing_root, sig, InvalidVoluntaryExit("invalid exit signature")
+    )
     h.initiate_validator_exit(state, voluntary_exit.validator_index, context)
 
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 from ...crypto import bls
 from ...domains import DomainType
 from ...error import (
+    CryptoError,
     InvalidAttestation,
     InvalidBlobData,
     InvalidConsolidation,
@@ -32,6 +33,7 @@ from ...primitives import FAR_FUTURE_EPOCH, UNSET_DEPOSIT_RECEIPTS_START_INDEX
 from ...signing import compute_signing_root, verify_signed_data
 from ...ssz import is_valid_merkle_branch
 from .. import _diff
+from ..signature_batch import verify_or_defer
 from ..altair.constants import (
     PARTICIPATION_FLAG_WEIGHTS,
     PROPOSER_WEIGHT,
@@ -263,7 +265,13 @@ def process_attestation(state, attestation, context) -> None:
 
     indexed = h.get_indexed_attestation(state, attestation, context)
     try:
-        h.is_valid_indexed_attestation(state, indexed, context)
+        h.is_valid_indexed_attestation(
+            state, indexed, context,
+            error=InvalidAttestation(
+                f"attestation at slot {data.slot}: aggregate signature does "
+                "not verify"
+            ),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttestation(str(exc)) from exc
 
@@ -430,16 +438,15 @@ def process_voluntary_exit(state, signed_voluntary_exit, context) -> None:
         bytes(state.genesis_validators_root),
         context,
     )
+    signing_root = compute_signing_root(VoluntaryExit, voluntary_exit, domain)
     try:
-        verify_signed_data(
-            VoluntaryExit,
-            voluntary_exit,
-            bytes(signed_voluntary_exit.signature),
-            bytes(validator.public_key),
-            domain,
-        )
-    except InvalidSignatureError as exc:
+        pk = bls.PublicKey.from_bytes(bytes(validator.public_key))
+        sig = bls.Signature.from_bytes(bytes(signed_voluntary_exit.signature))
+    except CryptoError as exc:
         raise InvalidVoluntaryExit(str(exc)) from exc
+    verify_or_defer(
+        [pk], signing_root, sig, InvalidVoluntaryExit("invalid exit signature")
+    )
     h.initiate_validator_exit(state, voluntary_exit.validator_index, context)
 
 
@@ -594,11 +601,12 @@ def process_consolidation(state, signed_consolidation, context) -> None:
             bls.PublicKey.from_bytes(bytes(target_validator.public_key)),
         ]
         sig = bls.Signature.from_bytes(bytes(signed_consolidation.signature))
-        ok = bls.fast_aggregate_verify(pks, signing_root, sig)
-    except Exception:
-        ok = False
-    if not ok:
-        raise InvalidConsolidation("invalid consolidation signature")
+    except CryptoError as exc:
+        raise InvalidConsolidation(str(exc)) from exc
+    verify_or_defer(
+        pks, signing_root, sig,
+        InvalidConsolidation("invalid consolidation signature"),
+    )
 
     source_validator.exit_epoch = h.compute_consolidation_epoch_and_update_churn(
         state, source_validator.effective_balance, context

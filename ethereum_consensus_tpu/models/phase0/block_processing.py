@@ -27,6 +27,7 @@ from ...error import (
 from ...primitives import FAR_FUTURE_EPOCH
 from ...signing import compute_signing_root
 from ...ssz import is_valid_merkle_branch
+from ..signature_batch import verify_or_defer
 from . import helpers as h
 from .containers import (
     BeaconBlockHeader,
@@ -95,8 +96,7 @@ def process_randao(state, body, context) -> None:
         sig = bls.Signature.from_bytes(body.randao_reveal)
     except Exception as exc:
         raise InvalidRandao(str(exc)) from exc
-    if not bls.verify_signature(pk, signing_root, sig):
-        raise InvalidRandao("invalid randao reveal")
+    verify_or_defer([pk], signing_root, sig, InvalidRandao("invalid randao reveal"))
     mix = h.xor(
         h.get_randao_mix(state, epoch), bls.hash(bytes(body.randao_reveal))
     )
@@ -147,8 +147,10 @@ def process_proposer_slashing(state, proposer_slashing, context, slash_fn=None) 
         )
         pk = bls.PublicKey.from_bytes(proposer.public_key)
         sig = bls.Signature.from_bytes(signed_header.signature)
-        if not bls.verify_signature(pk, signing_root, sig):
-            raise InvalidProposerSlashing("invalid header signature")
+        verify_or_defer(
+            [pk], signing_root, sig,
+            InvalidProposerSlashing("invalid header signature"),
+        )
     slash_fn(state, index, None, context)
 
 
@@ -159,8 +161,14 @@ def process_attester_slashing(state, attester_slashing, context) -> None:
     if not h.is_slashable_attestation_data(attestation_1.data, attestation_2.data):
         raise InvalidAttesterSlashing("attestation data not slashable")
     try:
-        h.is_valid_indexed_attestation(state, attestation_1, context)
-        h.is_valid_indexed_attestation(state, attestation_2, context)
+        h.is_valid_indexed_attestation(
+            state, attestation_1, context,
+            error=InvalidAttesterSlashing("attestation 1 signature invalid"),
+        )
+        h.is_valid_indexed_attestation(
+            state, attestation_2, context,
+            error=InvalidAttesterSlashing("attestation 2 signature invalid"),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttesterSlashing(str(exc)) from exc
 
@@ -219,7 +227,13 @@ def process_attestation(state, attestation, context) -> None:
 
     indexed = h.get_indexed_attestation(state, attestation, context)
     try:
-        h.is_valid_indexed_attestation(state, indexed, context)
+        h.is_valid_indexed_attestation(
+            state, indexed, context,
+            error=InvalidAttestation(
+                f"attestation at slot {data.slot} committee {data.index}: "
+                "aggregate signature does not verify"
+            ),
+        )
     except InvalidIndexedAttestation as exc:
         raise InvalidAttestation(str(exc)) from exc
 
@@ -307,8 +321,9 @@ def process_voluntary_exit(state, signed_voluntary_exit, context) -> None:
     )
     pk = bls.PublicKey.from_bytes(validator.public_key)
     sig = bls.Signature.from_bytes(signed_voluntary_exit.signature)
-    if not bls.verify_signature(pk, signing_root, sig):
-        raise InvalidVoluntaryExit("invalid exit signature")
+    verify_or_defer(
+        [pk], signing_root, sig, InvalidVoluntaryExit("invalid exit signature")
+    )
     h.initiate_validator_exit(state, voluntary_exit.validator_index, context)
 
 

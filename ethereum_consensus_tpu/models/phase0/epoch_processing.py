@@ -12,6 +12,7 @@ implementation.
 
 from __future__ import annotations
 
+from ... import _device_flags
 from ...error import StateTransitionError, saturating_sub
 from ...primitives import GENESIS_EPOCH
 from . import helpers as h
@@ -394,6 +395,16 @@ def process_eth1_data_reset(state, context) -> None:
 
 
 def process_effective_balance_updates(state, context) -> None:
+    """Hysteresis sweep over the whole registry; device twin above
+    threshold (ops/sweeps.py effective_balance_updates_device)."""
+    if _device_flags.sweeps_enabled(len(state.validators)):
+        from ...ops import sweeps as _sweeps
+
+        packed = _sweeps.pack_registry(state, h.get_current_epoch(state, context))
+        updated = _sweeps.effective_balance_updates_device(packed, context)
+        for index, validator in enumerate(state.validators):
+            validator.effective_balance = int(updated[index])
+        return
     hysteresis_increment = (
         context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
     )

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 
+from ... import _device_flags
 from ...crypto import bls
 from ...domains import DomainType
 from ...error import (
@@ -28,6 +29,7 @@ from ...error import (
 )
 from ...primitives import FAR_FUTURE_EPOCH, GENESIS_EPOCH
 from ...signing import compute_signing_root
+from ..signature_batch import verify_or_defer
 from .containers import Fork, ForkData
 
 __all__ = [
@@ -191,13 +193,42 @@ def compute_shuffled_indices(indices: list[int], seed: bytes, context) -> list[i
     return shuffled
 
 
+# full shuffle-result LRU — committee lookups hit the same seed for every
+# committee of an epoch, so one device shuffle serves them all. Keyed by
+# (seed, round count, digest of the index list) so differing presets or
+# active sets can never alias.
+_SHUFFLE_CACHE: dict = {}
+_SHUFFLE_CACHE_MAX = 4
+
+
+def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
+    digest = hashlib.sha256(
+        b"".join(i.to_bytes(8, "little") for i in indices)
+    ).digest()
+    key = (seed, context.SHUFFLE_ROUND_COUNT, digest)
+    hit = _SHUFFLE_CACHE.get(key)
+    if hit is None:
+        from ...ops.shuffle import compute_shuffled_indices_device
+
+        hit = compute_shuffled_indices_device(indices, seed, context)
+        if len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
+            _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
+        _SHUFFLE_CACHE[key] = hit
+    return hit
+
+
 def compute_committee(
     indices: list[int], seed: bytes, index: int, count: int, context
 ) -> list[int]:
     """Slice ``index``/``count`` of the shuffled active set (spec
-    compute_committee)."""
+    compute_committee). Above the installed threshold the whole active set
+    is shuffled once on device (ops/shuffle.py, bit-identical to the
+    per-index map) and cached per seed, so every committee of the epoch
+    reuses one kernel run."""
     start = len(indices) * index // count
     end = len(indices) * (index + 1) // count
+    if _device_flags.shuffle_enabled(len(indices)):
+        return _shuffled_active_set(indices, seed, context)[start:end]
     return [
         indices[compute_shuffled_index(i, len(indices), seed, context)]
         for i in range(start, end)
@@ -422,9 +453,13 @@ def get_indexed_attestation(state, attestation, context):
     )
 
 
-def is_valid_indexed_attestation(state, indexed_attestation, context) -> None:
+def is_valid_indexed_attestation(state, indexed_attestation, context, error=None) -> None:
     """Raises on failure (helpers.rs:71). The BLS fast_aggregate_verify here
-    is the #1 signature hot path (SURVEY.md §3.1)."""
+    is the #1 signature hot path (SURVEY.md §3.1): inside a
+    ``collect_signatures`` scope the verification is deferred into the
+    block's batch. ``error`` overrides the structured error used for a
+    signature failure so callers keep their attribution (e.g.
+    InvalidAttestation for process_attestation)."""
     indices = list(indexed_attestation.attesting_indices)
     if not indices:
         raise InvalidIndexedAttestation("no attesting indices")
@@ -445,12 +480,13 @@ def is_valid_indexed_attestation(state, indexed_attestation, context) -> None:
         type(indexed_attestation.data), indexed_attestation.data, domain
     )
     signature = bls.Signature.from_bytes(indexed_attestation.signature)
-    if not bls.fast_aggregate_verify(public_keys, signing_root, signature):
-        raise InvalidIndexedAttestation("aggregate signature does not verify")
+    if error is None:
+        error = InvalidIndexedAttestation("aggregate signature does not verify")
+    verify_or_defer(public_keys, signing_root, signature, error)
 
 
 def verify_block_signature(state, signed_block, context) -> None:
-    """(helpers.rs:144)"""
+    """(helpers.rs:144) — deferred into the block batch when collecting."""
     from ...error import InvalidBlock
 
     block = signed_block.message
@@ -461,8 +497,7 @@ def verify_block_signature(state, signed_block, context) -> None:
     signing_root = compute_signing_root(type(block), block, domain)
     pk = bls.PublicKey.from_bytes(proposer.public_key)
     sig = bls.Signature.from_bytes(signed_block.signature)
-    if not bls.verify_signature(pk, signing_root, sig):
-        raise InvalidBlock("invalid block signature")
+    verify_or_defer([pk], signing_root, sig, InvalidBlock("invalid block signature"))
 
 
 # ---------------------------------------------------------------------------

@@ -9,6 +9,7 @@ from __future__ import annotations
 from enum import Enum
 
 from ...error import InvalidStateRoot
+from ..signature_batch import collect_signatures
 from .block_processing import process_block
 from .helpers import verify_block_signature
 from .slot_processing import process_slots
@@ -23,11 +24,15 @@ class Validation(Enum):
 
 def state_transition_block_in_slot(state, signed_block, validation, context) -> None:
     """Apply a block to a state already advanced to the block's slot
-    (state_transition.rs:15)."""
-    if validation is Validation.ENABLED:
-        verify_block_signature(state, signed_block, context)
+    (state_transition.rs:15). All of the block's signature sets are
+    collected and verified as one batch before the state-root check (see
+    models/signature_batch.py)."""
     block = signed_block.message
-    process_block(state, block, context)
+    with collect_signatures() as batch:
+        if validation is Validation.ENABLED:
+            verify_block_signature(state, signed_block, context)
+        process_block(state, block, context)
+        batch.flush()
     if validation is Validation.ENABLED:
         state_root = type(state).hash_tree_root(state)
         if block.state_root != state_root:

@@ -1,0 +1,120 @@
+"""Block-level signature-set collection.
+
+The reference verifies each signature at its call site as block processing
+walks the operations (phase0/helpers.rs:71 `is_valid_indexed_attestation`,
+:144 `verify_block_signature`; altair/block_processing.rs:192
+`process_sync_aggregate`). On TPU the right boundary is the opposite: the
+state transition *collects* every (pubkeys, message, signature) claim a
+block makes — proposer signature, randao reveal, slashing headers, up to
+MAX_ATTESTATIONS aggregates, voluntary exits, the sync aggregate — and
+verifies them as ONE batch (random-linear-combination multi-pairing via
+``crypto.bls.verify_signature_sets``: N+1 Miller loops, one shared final
+exponentiation, device-batchable MSMs).
+
+Semantics are preserved exactly:
+
+* Deferral is ambient (a context variable set by ``collect_signatures``),
+  so spec functions keep their reference signatures, and a spec function
+  called *outside* a collection scope — e.g. a single-operation
+  conformance vector — verifies inline, exactly as before.
+* Each deferred set carries the structured error its call site would have
+  raised; ``flush`` raises the error of the FIRST failing set in
+  insertion (i.e. spec) order, so error attribution still names the
+  specific invalid operation.
+* A failed flush aborts the whole transition — identical observable
+  behavior to the sequential path, because an invalid block discards the
+  state either way (the reference's Executor does the same;
+  executor.rs:113).
+* Deposit signatures are NOT deferrable: an invalid deposit signature is
+  *skipped*, not an error (phase0/block_processing.rs:351), and whether
+  the validator joins the registry affects the rest of the block.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+
+from ..crypto import bls
+
+__all__ = [
+    "SignatureBatch",
+    "collect_signatures",
+    "current_batch",
+    "verify_or_defer",
+]
+
+_CURRENT: contextvars.ContextVar["SignatureBatch | None"] = contextvars.ContextVar(
+    "signature_batch", default=None
+)
+
+
+class SignatureBatch:
+    """Accumulates SignatureSets plus the error each would raise."""
+
+    __slots__ = ("_sets", "_errors")
+
+    def __init__(self):
+        self._sets: list[bls.SignatureSet] = []
+        self._errors: list[Exception] = []
+
+    def __len__(self) -> int:
+        return len(self._sets)
+
+    def defer(
+        self,
+        public_keys: list[bls.PublicKey],
+        message: bytes,
+        signature: bls.Signature,
+        error: Exception,
+    ) -> None:
+        self._sets.append(bls.SignatureSet(public_keys, message, signature))
+        self._errors.append(error)
+
+    def flush(self) -> None:
+        """One batched verification; raises the first failing set's error."""
+        if not self._sets:
+            return
+        sets, errors = self._sets, self._errors
+        self._sets, self._errors = [], []
+        for ok, error in zip(bls.verify_signature_sets(sets), errors):
+            if not ok:
+                raise error
+
+
+def current_batch() -> SignatureBatch | None:
+    return _CURRENT.get()
+
+
+@contextmanager
+def collect_signatures():
+    """Scope within which ``verify_or_defer`` defers instead of verifying.
+
+    Scopes nest: an inner scope gets its own batch (flushed on its own
+    exit), so a nested full transition cannot leak sets into the caller.
+    The batch is NOT auto-flushed on exit — the transition flushes
+    explicitly before the state-root check so errors surface at a
+    deterministic point."""
+    batch = SignatureBatch()
+    token = _CURRENT.set(batch)
+    try:
+        yield batch
+    finally:
+        _CURRENT.reset(token)
+
+
+def verify_or_defer(
+    public_keys: list[bls.PublicKey],
+    message: bytes,
+    signature: bls.Signature,
+    error: Exception,
+) -> None:
+    """fast_aggregate_verify semantics: inline outside a collection scope,
+    deferred inside one. ``error`` is the structured error to raise when
+    the set does not verify."""
+    batch = _CURRENT.get()
+    if batch is None:
+        if not bls.fast_aggregate_verify(public_keys, message, signature):
+            raise error
+    else:
+        batch.defer(public_keys, message, signature, error)

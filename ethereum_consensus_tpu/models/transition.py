@@ -13,6 +13,7 @@ from enum import Enum
 from ..error import InvalidStateRoot, StateTransitionError, checked_add
 from .phase0.containers import BeaconBlockHeader
 from .phase0.helpers import verify_block_signature
+from .signature_batch import collect_signatures
 
 __all__ = [
     "Validation",
@@ -57,11 +58,20 @@ def process_slots_generic(state, slot: int, context, process_epoch) -> None:
 def state_transition_block_in_slot_generic(
     state, signed_block, validation, context, process_block
 ) -> None:
-    """(phase0/state_transition.rs:15)"""
-    if validation is Validation.ENABLED:
-        verify_block_signature(state, signed_block, context)
+    """(phase0/state_transition.rs:15)
+
+    Every signature claim the block makes — proposer, randao, slashing
+    headers, attestation aggregates, exits, sync aggregate — is collected
+    while processing and verified as ONE batch (signature_batch module)
+    before the state-root check. An invalid signature aborts the
+    transition with the same structured error the sequential path raises,
+    attributed to the first failing operation in spec order."""
     block = signed_block.message
-    process_block(state, block, context)
+    with collect_signatures() as batch:
+        if validation is Validation.ENABLED:
+            verify_block_signature(state, signed_block, context)
+        process_block(state, block, context)
+        batch.flush()
     if validation is Validation.ENABLED:
         state_root = type(state).hash_tree_root(state)
         if block.state_root != state_root:

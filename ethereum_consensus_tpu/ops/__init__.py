@@ -6,19 +6,64 @@ never import it directly — device acceleration is installed explicitly via
 ``install()``.
 """
 
-from .merkle import merkleize_chunks_device
+from .. import _device_flags
+from .._jax_cache import enable as _enable_jax_cache
+
+_enable_jax_cache()
+
+from .merkle import merkleize_chunks_device  # noqa: E402
 from .sha256 import install_device_hasher, sha256_64b_pallas, sha256_64b_xla
 
+DEFAULT_SWEEPS_MIN_N = 1 << 16
+DEFAULT_SHUFFLE_MIN_N = 1 << 15
+DEFAULT_BLS_AGG_MIN_N = 1 << 12
 
-def install() -> None:
-    """Install all device fast paths into the host layers."""
+
+def install(
+    sweeps_min_n: int = DEFAULT_SWEEPS_MIN_N,
+    shuffle_min_n: int = DEFAULT_SHUFFLE_MIN_N,
+    bls_agg_min_n: int = DEFAULT_BLS_AGG_MIN_N,
+) -> None:
+    """Install all device fast paths into the host layers:
+
+    * SHA-256 hash levels above ssz.hash.DEVICE_MIN_NODES (merkleization);
+    * epoch-processing registry sweeps (altair+ flag deltas, inactivity
+      updates/penalties, effective-balance hysteresis) above
+      ``sweeps_min_n`` validators;
+    * whole-list committee shuffling above ``shuffle_min_n`` indices;
+    * G1 pubkey aggregation (fast_aggregate_verify / batched signature
+      sets) above ``bls_agg_min_n`` total points.
+
+    Spec semantics are unchanged — every device twin is bit-identical to
+    its host function (cross-checked in tests); the thresholds only decide
+    where the work runs. Exact u64 arithmetic needs jax x64 mode, enabled
+    here."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
     install_device_hasher()
+    _device_flags.SWEEPS_MIN_N = sweeps_min_n
+    _device_flags.SHUFFLE_MIN_N = shuffle_min_n
+    _device_flags.BLS_AGG_MIN_N = bls_agg_min_n
+
+
+def uninstall() -> None:
+    """Turn the spec-path device routing back off (keeps the hasher)."""
+    _device_flags.SWEEPS_MIN_N = None
+    _device_flags.SHUFFLE_MIN_N = None
+    _device_flags.BLS_AGG_MIN_N = None
+    from ..models.phase0 import helpers as _phase0_helpers
+
+    _phase0_helpers._SHUFFLE_CACHE.clear()
 
 
 __all__ = [
+    "DEFAULT_SHUFFLE_MIN_N",
+    "DEFAULT_SWEEPS_MIN_N",
     "install",
     "install_device_hasher",
     "merkleize_chunks_device",
     "sha256_64b_pallas",
     "sha256_64b_xla",
+    "uninstall",
 ]

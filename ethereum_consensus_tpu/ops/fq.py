@@ -1,0 +1,219 @@
+"""Device BLS12-381 base-field arithmetic in 16-bit limbs.
+
+The TPU has no wide-integer unit, so field elements are decomposed into
+**24 little-endian 16-bit limbs held in uint32 lanes** (SURVEY.md §7 hard
+parts: "381-bit field arithmetic must be limb-decomposed into 32-bit
+lanes"). All heavy products run as uint64 vector ops (x64 mode), where a
+full 24×24 schoolbook accumulation stays far below 2^64 (24·(2^16-1)^2 <
+2^37 per column), so no carry splitting is needed mid-product.
+
+Multiplication uses **Montgomery form** (R = 2^384): `mont_mul(a, b) =
+a·b·R⁻¹ mod p` with the standard word-by-word CIOS reduction, unrolled at
+trace time (24 outer steps — static Python loops become straight-line XLA
+ops, exactly what the compiler wants; no data-dependent control flow).
+
+Shapes: every function maps (..., 24) uint32 limb arrays elementwise over
+the leading batch axes — `vmap`-free batching, the whole batch is one
+vector program. Cross-checked limb-exact against the host big-int field
+(crypto/fields.py) and the native C++ backend in tests/test_ops_bls.py.
+
+Reference parity: the role blst's fp.c plays for crypto/bls.rs (C6).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "P_INT",
+    "LIMBS",
+    "LIMB_BITS",
+    "to_limbs",
+    "from_limbs",
+    "to_mont",
+    "from_mont",
+    "add_mod",
+    "sub_mod",
+    "mont_mul",
+    "mont_square",
+    "ONE_MONT",
+]
+
+P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+LIMB_BITS = 16
+LIMBS = 24  # 24 × 16 = 384 bits
+MASK = (1 << LIMB_BITS) - 1
+
+R_INT = (1 << (LIMB_BITS * LIMBS)) % P_INT  # 2^384 mod p
+R2_INT = (R_INT * R_INT) % P_INT
+# -p^{-1} mod 2^16 (Montgomery n0' for the CIOS inner step)
+N0_INT = (-pow(P_INT, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def _int_to_limbs(value: int) -> np.ndarray:
+    return np.array(
+        [(value >> (LIMB_BITS * i)) & MASK for i in range(LIMBS)], dtype=np.uint32
+    )
+
+
+P_LIMBS = _int_to_limbs(P_INT)
+R2_LIMBS = _int_to_limbs(R2_INT)
+ONE_MONT = _int_to_limbs(R_INT)  # 1 in Montgomery form
+
+
+def to_limbs(values) -> np.ndarray:
+    """int or iterable of ints → (..., 24) uint32 limb array (host side)."""
+    if isinstance(values, int):
+        return _int_to_limbs(values)
+    return np.stack([to_limbs(v) for v in values])
+
+
+def from_limbs(limbs) -> "int | list":
+    """(..., 24) limb array → int(s) (host side)."""
+    arr = np.asarray(limbs)
+    if arr.ndim == 1:
+        return sum(int(limb) << (LIMB_BITS * i) for i, limb in enumerate(arr))
+    return [from_limbs(row) for row in arr]
+
+
+def _geq(a, b):
+    """a >= b over (..., 24) limb arrays, comparing from the top limb."""
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    for i in reversed(range(LIMBS)):
+        ai, bi = a[..., i], b[..., i]
+        gt = gt | (~lt & (ai > bi))
+        lt = lt | (~gt & (ai < bi))
+    return ~lt
+
+
+def _sub_raw(a, b):
+    """a - b assuming a >= b, limbwise with borrow (uint64 lanes)."""
+    out = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(LIMBS):
+        d = (
+            a[..., i].astype(jnp.uint64)
+            + jnp.uint64(1 << LIMB_BITS)
+            - b[..., i].astype(jnp.uint64)
+            - borrow
+        )
+        out.append((d & jnp.uint64(MASK)).astype(jnp.uint32))
+        borrow = jnp.uint64(1) - (d >> jnp.uint64(LIMB_BITS))
+    return jnp.stack(out, axis=-1)
+
+
+def _cond_sub_p(x):
+    """x - p where x >= p, else x (the canonical-form step)."""
+    p = jnp.asarray(P_LIMBS)
+    p = jnp.broadcast_to(p, x.shape)
+    need = _geq(x, p)
+    return jnp.where(need[..., None], _sub_raw(x, p), x)
+
+
+@jax.jit
+def add_mod(a, b):
+    """(a + b) mod p over (..., 24) limb arrays."""
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(LIMBS):
+        s = a[..., i].astype(jnp.uint64) + b[..., i].astype(jnp.uint64) + carry
+        out.append((s & jnp.uint64(MASK)).astype(jnp.uint32))
+        carry = s >> jnp.uint64(LIMB_BITS)
+    # p < 2^381 and inputs are canonical, so the 2^384 carry is always 0
+    return _cond_sub_p(jnp.stack(out, axis=-1))
+
+
+@jax.jit
+def sub_mod(a, b):
+    """(a - b) mod p over (..., 24) limb arrays."""
+    p = jnp.broadcast_to(jnp.asarray(P_LIMBS), a.shape)
+    lt = ~_geq(a, b)
+    a_adj = jnp.where(lt[..., None], _add_raw(a, p), a)
+    return _sub_raw(a_adj, b)
+
+
+def _add_raw(a, b):
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(LIMBS):
+        s = a[..., i].astype(jnp.uint64) + b[..., i].astype(jnp.uint64) + carry
+        out.append((s & jnp.uint64(MASK)).astype(jnp.uint32))
+        carry = s >> jnp.uint64(LIMB_BITS)
+    # callers guarantee the sum fits 384 bits + borrow headroom (a < p ≤ b+p)
+    return jnp.stack(out, axis=-1)
+
+
+@jax.jit
+def mont_mul(a, b):
+    """Montgomery product a·b·R⁻¹ mod p over (..., 24) limb arrays.
+
+    Vectorized CIOS with **deferred carries**: the accumulator keeps 25
+    uint64 *columns* whose values may exceed 16 bits; each of the 24
+    `fori_loop` steps adds one a-limb × b row and one m × p row as single
+    vector ops over the limb axis, then shifts a column out. Column
+    magnitude stays < 24·2·2³² + shift-ins < 2³⁸ ≪ 2⁶⁴, and column 0's low
+    16 bits are always exact, which is all the m-computation needs. One
+    carry-normalization pass + conditional subtract canonicalizes at the
+    end (CIOS bound: result < 2p). The loop body is traced ONCE — the
+    whole product is ~20 vector ops, not 24² scalar ones."""
+    a64 = a.astype(jnp.uint64)
+    b64 = b.astype(jnp.uint64)
+    p64 = jnp.asarray(P_LIMBS.astype(np.uint64))
+    n0 = jnp.uint64(N0_INT)
+    mask = jnp.uint64(MASK)
+    shift = jnp.uint64(LIMB_BITS)
+
+    batch_shape = a.shape[:-1]
+    t0 = jnp.zeros(batch_shape + (LIMBS + 1,), dtype=jnp.uint64)
+
+    def step(i, t):
+        ai = jax.lax.dynamic_index_in_dim(a64, i, axis=-1, keepdims=True)
+        t = t.at[..., :LIMBS].add(ai * b64)
+        m = (t[..., 0] * n0) & mask
+        t = t.at[..., :LIMBS].add(m[..., None] * p64)
+        carry0 = t[..., 0] >> shift
+        shifted = jnp.concatenate(
+            [t[..., 1:], jnp.zeros(batch_shape + (1,), jnp.uint64)], axis=-1
+        )
+        return shifted.at[..., 0].add(carry0)
+
+    t = jax.lax.fori_loop(0, LIMBS, step, t0)
+
+    # carry-normalize the 25 columns into 24 canonical limbs (the 2^384
+    # column is absorbed by the CIOS < 2p bound after propagation)
+    def carry_step(carry, col):
+        v = col + carry
+        return v >> shift, v & mask
+
+    _, limbs = jax.lax.scan(
+        carry_step,
+        jnp.zeros(batch_shape, jnp.uint64),
+        jnp.moveaxis(t, -1, 0),
+    )
+    out = jnp.moveaxis(limbs, 0, -1)[..., :LIMBS].astype(jnp.uint32)
+    return _cond_sub_p(out)
+
+
+def mont_square(a):
+    return mont_mul(a, a)
+
+
+@jax.jit
+def to_mont(a):
+    """Canonical → Montgomery form: a·R mod p."""
+    r2 = jnp.broadcast_to(jnp.asarray(R2_LIMBS), a.shape)
+    return mont_mul(a, r2)
+
+
+@jax.jit
+def from_mont(a):
+    """Montgomery → canonical form: a·R⁻¹ mod p."""
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mont_mul(a, one)
+
+

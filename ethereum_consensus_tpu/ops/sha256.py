@@ -227,14 +227,25 @@ def sha256_64b_pallas(msgs: jax.Array, interpret: bool = False) -> jax.Array:
     )(msgs)
 
 
+_PALLAS_BROKEN = False
+
+
 def _supports_pallas() -> bool:
-    return jax.default_backend() == "tpu"
+    return jax.default_backend() == "tpu" and not _PALLAS_BROKEN
 
 
 def sha256_64b(msgs: jax.Array) -> jax.Array:
-    """Batched SHA-256, Pallas on TPU (when N tiles evenly), XLA otherwise."""
+    """Batched SHA-256, Pallas on TPU (when N tiles evenly), XLA otherwise.
+
+    A Pallas compile failure (e.g. a transient remote-compile-helper error
+    on tunneled TPU setups) demotes to the bit-identical XLA kernel for
+    the rest of the process instead of surfacing an internal error."""
+    global _PALLAS_BROKEN
     if _supports_pallas() and msgs.shape[1] % _TILE_N == 0:
-        return sha256_64b_pallas(msgs)
+        try:
+            return sha256_64b_pallas(msgs)
+        except jax.errors.JaxRuntimeError:
+            _PALLAS_BROKEN = True
     return sha256_64b_xla(msgs)
 
 

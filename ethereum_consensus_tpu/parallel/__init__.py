@@ -8,9 +8,13 @@ signature batches, validator-registry sweeps) are sharded over a
 ICI, per the shard_map recipe.
 """
 
-from .mesh import chip_mesh, default_device_mesh
-from .merkle import sharded_merkle_root_words, sharded_merkleize_chunks
-from .step import make_chain_step
+from .._jax_cache import enable as _enable_jax_cache
+
+_enable_jax_cache()
+
+from .mesh import chip_mesh, default_device_mesh  # noqa: E402
+from .merkle import sharded_merkle_root_words, sharded_merkleize_chunks  # noqa: E402
+from .step import make_chain_step  # noqa: E402
 
 __all__ = [
     "chip_mesh",

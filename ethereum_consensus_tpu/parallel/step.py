@@ -30,7 +30,13 @@ from ..ops.sha256 import sha256_64b
 from ..ssz.merkle import next_pow_of_two
 from .mesh import SHARD_AXIS
 
-__all__ = ["make_chain_step", "u64_to_be_words"]
+__all__ = [
+    "make_chain_step",
+    "make_epoch_sweep_step",
+    "pad_registry_for_mesh",
+    "run_chain_step",
+    "u64_to_be_words",
+]
 
 
 def _bswap32(x):
@@ -69,10 +75,14 @@ def make_chain_step(
 ):
     """Build the jitted distributed chain step over ``mesh``.
 
-    Returns ``step(balances, effective_balances, active_mask, zero_words)``
-    where the first three are (N,) arrays sharded over ``axis_name`` (N
-    divisible by mesh size; N/devices divisible by 4 — one SSZ chunk packs
-    four u64 balances) and ``zero_words`` is ops.merkle.zero_hash_words().
+    Returns ``step(balances, effective_balances, active_mask, zero_words,
+    length_words)`` where the first three are (N,) arrays sharded over
+    ``axis_name`` (N divisible by mesh size; N/devices a power-of-two
+    multiple of 4 — one SSZ chunk packs four u64 balances; use
+    ``run_chain_step`` for arbitrary sizes, which zero-pads and passes the
+    TRUE length's mix-in words), ``zero_words`` is
+    ops.merkle.zero_hash_words() and ``length_words`` is the (8,) uint32
+    word view of the SSZ length mix-in chunk.
     Returns ``(new_effective_balances, total_active_balance, balances_root)``
     with the root as (8,) uint32 words, replicated.
     """
@@ -90,7 +100,7 @@ def make_chain_step(
     upward = hysteresis_increment * np.uint64(hysteresis_upward_multiplier)
     max_eff = np.uint64(max_effective_balance)
 
-    def body(balances, eff, active, zero_words):
+    def body(balances, eff, active, zero_words, length_words):
         local_n = balances.shape[0]
         if local_n % 4:
             raise ValueError("per-device balance count must be a multiple of 4")
@@ -120,9 +130,8 @@ def make_chain_step(
         sub = reduce_levels(words, zero_words, local_depth)
         roots = jax.lax.all_gather(sub, axis_name)  # (n_dev, 8)
         merkle = reduce_levels(roots.T, zero_words, depth, start_level=local_depth)
-        # SSZ List → mix_in_length(root, N)
-        length = jnp.asarray(_length_words(local_n * n_dev))
-        msg = jnp.concatenate([merkle, length]).reshape(16, 1)
+        # SSZ List → mix_in_length(root, true length)
+        msg = jnp.concatenate([merkle, length_words]).reshape(16, 1)
         root = sha256_64b(msg)[:, 0]
         return new_eff, total, root
 
@@ -134,8 +143,184 @@ def make_chain_step(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(P(axis_name), P(axis_name), P(axis_name), P(None, None)),
+            in_specs=(
+                P(axis_name), P(axis_name), P(axis_name), P(None, None), P(None),
+            ),
             out_specs=(P(axis_name), P(), P(None)),
+            check_vma=False,
+        )
+    )
+
+
+def pad_registry_for_mesh(n: int, n_dev: int) -> int:
+    """Padded registry length for an arbitrary ``n`` on an ``n_dev`` mesh:
+    each device owns an aligned power-of-two subtree of whole SSZ chunks
+    (4 u64 per chunk). Zero-padding is exactly the merkleizer's own
+    padding, so roots are unchanged as long as the TRUE length feeds the
+    SSZ length mix-in."""
+    per_dev_chunks = next_pow_of_two(max(1, -(-n // (4 * n_dev))))
+    return n_dev * per_dev_chunks * 4
+
+
+def run_chain_step(step, mesh, balances, effective, active, zero_words,
+                   axis_name: str = SHARD_AXIS):
+    """Host wrapper around ``make_chain_step``'s jitted step for ARBITRARY
+    (non-aligned) registry sizes: zero-pads the inputs to the mesh-aligned
+    width (inactive padding cannot contribute to the psum total, and zero
+    chunks are the merkleizer's own padding), runs the step with the true
+    length in the mix-in, and slices the padded tail back off."""
+    n = len(balances)
+    n_dev = mesh.shape[axis_name]
+    padded = pad_registry_for_mesh(n, n_dev)
+    bal = np.zeros(padded, np.uint64)
+    bal[:n] = balances
+    eff = np.zeros(padded, np.uint64)
+    eff[:n] = effective
+    act = np.zeros(padded, np.bool_)
+    act[:n] = active
+    new_eff, total, root_words = step(
+        jnp.asarray(bal), jnp.asarray(eff), jnp.asarray(act),
+        zero_words, jnp.asarray(_length_words(n)),
+    )
+    return np.asarray(new_eff)[:n], int(total), np.asarray(root_words)
+
+
+def make_epoch_sweep_step(
+    mesh: Mesh,
+    context,
+    axis_name: str = SHARD_AXIS,
+    is_leaking: bool = False,
+):
+    """The distributed altair epoch sweep (the real per-epoch hot loop):
+    inactivity-score updates, the three participation-flag delta sweeps,
+    inactivity penalties, and balance application — sharded row-wise over
+    the mesh with ``psum`` totals, matching altair
+    process_inactivity_updates + process_rewards_and_penalties
+    (epoch_processing.rs:104,160) bit-for-bit including saturating
+    decreases and application order.
+
+    Returns ``step(balances, effective, participation, slashed,
+    active_previous, active_current, eligible, scores)`` over sharded (N,)
+    arrays → ``(new_balances, new_scores, total_active_balance)``.
+    ``participation`` is the uint8 flag byte for the delta epoch
+    (previous, or current in the genesis corner — the caller picks when
+    packing, see ops.sweeps.pack_registry)."""
+    from ..models.altair.constants import (
+        PARTICIPATION_FLAG_WEIGHTS,
+        TIMELY_HEAD_FLAG_INDEX,
+        TIMELY_TARGET_FLAG_INDEX,
+        WEIGHT_DENOMINATOR,
+    )
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "make_epoch_sweep_step needs exact u64 semantics: enable jax_enable_x64"
+        )
+
+    increment = np.uint64(context.EFFECTIVE_BALANCE_INCREMENT)
+    base_reward_factor = np.uint64(context.BASE_REWARD_FACTOR)
+    score_bias = np.uint64(context.inactivity_score_bias)
+    recovery_rate = np.uint64(context.inactivity_score_recovery_rate)
+    inactivity_quotient = np.uint64(context.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+
+    def _isqrt(x):
+        guess = jnp.sqrt(x.astype(jnp.float64)).astype(jnp.uint64) + jnp.uint64(1)
+
+        def newton(_, g):
+            g = jnp.maximum(g, jnp.uint64(1))
+            return (g + x // g) >> jnp.uint64(1)
+
+        g = jax.lax.fori_loop(0, 6, newton, guess)
+        g = jnp.where(g * g > x, g - jnp.uint64(1), g)
+        return jnp.where((g + 1) * (g + 1) <= x, g + jnp.uint64(1), g)
+
+    def body(balances, eff, participation, slashed, active_prev, active_cur,
+             eligible, scores):
+        # --- process_inactivity_updates (epoch_processing.rs:104) ---
+        target_participating = (
+            ((participation >> np.uint8(TIMELY_TARGET_FLAG_INDEX)) & 1).astype(bool)
+            & ~slashed
+            & active_prev
+        )
+        decreased = scores - jnp.minimum(jnp.uint64(1), scores)
+        increased = scores + score_bias
+        new_scores = jnp.where(
+            eligible,
+            jnp.where(target_participating, decreased, increased),
+            scores,
+        )
+        if not is_leaking:
+            new_scores = jnp.where(
+                eligible,
+                new_scores - jnp.minimum(recovery_rate, new_scores),
+                new_scores,
+            )
+
+        # --- totals (psum over the mesh — the ICI collectives) ---
+        total_active = jax.lax.psum(
+            jnp.sum(jnp.where(active_cur, eff, jnp.uint64(0))), axis_name
+        )
+        total_active = jnp.maximum(total_active, increment)
+        base_reward_per_increment = increment * base_reward_factor // _isqrt(
+            total_active
+        )
+        base_reward = (eff // increment) * base_reward_per_increment
+        active_increments = total_active // increment
+
+        # --- the three flag-delta sweeps (helpers.rs:265) ---
+        new_balances = balances
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            w = jnp.uint64(weight)
+            participating = (
+                ((participation >> np.uint8(flag_index)) & 1).astype(bool)
+                & ~slashed
+                & active_prev
+            )
+            unslashed_increments = (
+                jax.lax.psum(
+                    jnp.sum(jnp.where(participating, eff, jnp.uint64(0))),
+                    axis_name,
+                )
+                // increment
+            )
+            rewards = jnp.where(
+                participating & eligible & jnp.bool_(not is_leaking),
+                base_reward
+                * w
+                * unslashed_increments
+                // (active_increments * jnp.uint64(WEIGHT_DENOMINATOR)),
+                jnp.uint64(0),
+            )
+            if flag_index == TIMELY_HEAD_FLAG_INDEX:
+                penalties = jnp.zeros_like(rewards)
+            else:
+                penalties = jnp.where(
+                    eligible & ~participating,
+                    base_reward * w // jnp.uint64(WEIGHT_DENOMINATOR),
+                    jnp.uint64(0),
+                )
+            # spec application order: increase then saturating decrease
+            new_balances = new_balances + rewards
+            new_balances = new_balances - jnp.minimum(penalties, new_balances)
+
+        # --- inactivity penalties (uses the UPDATED scores) ---
+        not_target = eligible & ~target_participating
+        inactivity_penalties = jnp.where(
+            not_target,
+            eff * new_scores // (score_bias * inactivity_quotient),
+            jnp.uint64(0),
+        )
+        new_balances = new_balances - jnp.minimum(inactivity_penalties, new_balances)
+
+        return new_balances, new_scores, total_active
+
+    spec = P(axis_name)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=(spec, spec, P()),
             check_vma=False,
         )
     )

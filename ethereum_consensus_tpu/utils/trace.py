@@ -1,0 +1,71 @@
+"""Tracing facade.
+
+Reference parity: the reference pulls in the `tracing` crate as a facade in
+its API client (beacon-api-client/Cargo.toml:21, examples/sse.rs:4-20); the
+core library emits nothing. Here the same role is played on top of stdlib
+``logging``: cheap structured spans and events that are silent unless the
+application installs a handler (``basic_setup`` for the examples/CLIs).
+
+Usage::
+
+    from ethereum_consensus_tpu.utils.trace import span, event
+    with span("apply_block", slot=block.slot):
+        ...
+    event("api.request", method="GET", path=path)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+
+__all__ = ["logger", "span", "event", "basic_setup"]
+
+logger = logging.getLogger("ethereum_consensus_tpu")
+logger.addHandler(logging.NullHandler())
+
+
+def _fmt_fields(fields: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in fields.items())
+
+
+@contextmanager
+def span(name: str, **fields):
+    """A timed span: DEBUG on enter, INFO with elapsed ms on exit, ERROR
+    (with the exception) if the body raises."""
+    if logger.isEnabledFor(logging.DEBUG):
+        logger.debug("enter %s %s", name, _fmt_fields(fields))
+    start = time.perf_counter()
+    try:
+        yield
+    except Exception as exc:
+        logger.error(
+            "abort %s %s error=%r elapsed_ms=%.2f",
+            name, _fmt_fields(fields), exc,
+            (time.perf_counter() - start) * 1e3,
+        )
+        raise
+    else:
+        if logger.isEnabledFor(logging.INFO):
+            logger.info(
+                "exit %s %s elapsed_ms=%.2f",
+                name, _fmt_fields(fields), (time.perf_counter() - start) * 1e3,
+            )
+
+
+def event(name: str, **fields) -> None:
+    """A point-in-time structured event at INFO."""
+    if logger.isEnabledFor(logging.INFO):
+        logger.info("%s %s", name, _fmt_fields(fields))
+
+
+def basic_setup(level: int = logging.INFO) -> None:
+    """Install a stderr handler (the examples' tracing_subscriber
+    equivalent, reference examples/sse.rs:20)."""
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    logger.addHandler(handler)
+    logger.setLevel(level)

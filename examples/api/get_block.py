@@ -1,0 +1,35 @@
+"""Fetch a beacon block by root (reference examples/get_block.rs).
+
+Usage: python examples/api/get_block.py [endpoint] [block-id]
+Defaults: http://localhost:5052 head
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from ethereum_consensus_tpu.api import Client
+from ethereum_consensus_tpu.utils.trace import basic_setup
+
+
+def main() -> int:
+    basic_setup()
+    endpoint = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:5052"
+    block_id = sys.argv[2] if len(sys.argv) > 2 else "head"
+    client = Client(endpoint)
+    try:
+        block = client.get_beacon_block(block_id)
+    except Exception as exc:  # noqa: BLE001 — example: report and exit
+        print(f"request failed ({exc}); is a beacon node at {endpoint}?")
+        return 1
+    print(f"version: {block.version}")
+    message = block.data.get("message", {})
+    print(f"slot: {message.get('slot')}")
+    print(f"proposer_index: {message.get('proposer_index')}")
+    print(f"state_root: {message.get('state_root')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

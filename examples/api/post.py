@@ -1,0 +1,40 @@
+"""POST a (default) signed validator registration to a builder endpoint
+(reference examples/post.rs).
+
+Usage: python examples/api/post.py [endpoint]
+Default: http://localhost:8080
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from ethereum_consensus_tpu.api import Client
+from ethereum_consensus_tpu.builder import (
+    SignedValidatorRegistration,
+    ValidatorRegistration,
+)
+from ethereum_consensus_tpu.utils.trace import basic_setup
+
+
+def main() -> int:
+    basic_setup()
+    endpoint = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:8080"
+    client = Client(endpoint)
+    registration = SignedValidatorRegistration(
+        message=ValidatorRegistration(), signature=b"\x00" * 96
+    )
+    payload = [SignedValidatorRegistration.to_json(registration)]
+    try:
+        response = client.http_post("/eth/v1/builder/validators", payload)
+    except Exception as exc:  # noqa: BLE001 — example: report and exit
+        print(f"request failed ({exc}); is a builder at {endpoint}?")
+        return 1
+    print(f"status: {response.status_code}")
+    print(f"body: {response.text[:500]}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,34 @@
+"""Stream beacon events over SSE (reference examples/sse.rs).
+
+Usage: python examples/api/sse.py [endpoint] [topic ...]
+Defaults: http://localhost:5052 head payload_attributes
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from ethereum_consensus_tpu.api import Client
+from ethereum_consensus_tpu.utils.trace import basic_setup, logger
+
+
+def main() -> int:
+    basic_setup()
+    endpoint = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:5052"
+    topics = sys.argv[2:] or ["head", "payload_attributes"]
+    client = Client(endpoint)
+    try:
+        for topic, data in client.get_events(topics):
+            print(f"[{topic}] {data}")
+    except KeyboardInterrupt:
+        return 0
+    except Exception as exc:  # noqa: BLE001 — example: report and exit
+        logger.warning("event stream failed: %s", exc)
+        print(f"stream failed ({exc}); is a beacon node at {endpoint}?")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,11 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
+# Persistent XLA compile cache: device-shape tests are compile-bound over
+# the TPU tunnel (60s+ per distinct shape); caching makes re-runs cheap.
+# The cache is enabled at the jax chokepoints (ops/, parallel/) —
+# _jax_cache.enable() — so no jax import is needed here.
+
 
 def cpu_mesh_env(n_devices: int = 8) -> dict:
     """Environment for a subprocess with an n-device virtual CPU platform."""

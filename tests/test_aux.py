@@ -97,3 +97,24 @@ def test_serde_helpers():
         from_str("-1")
     assert seq_of_str([1, 2]) == ["1", "2"]
     assert seq_from_str(["1", "2"]) == [1, 2]
+
+
+def test_trace_facade(caplog):
+    """The tracing facade (utils/trace.py — the reference's `tracing`
+    facade role): spans log enter/exit with timing, errors are recorded
+    and re-raised, silent by default via NullHandler."""
+    import logging
+
+    from ethereum_consensus_tpu.utils import trace
+
+    with caplog.at_level(logging.DEBUG, logger="ethereum_consensus_tpu"):
+        with trace.span("unit_test_span", slot=7):
+            trace.event("unit_test_event", detail="x")
+        with pytest.raises(ValueError):
+            with trace.span("failing_span"):
+                raise ValueError("boom")
+    text = caplog.text
+    assert "enter unit_test_span slot=7" in text
+    assert "exit unit_test_span" in text
+    assert "unit_test_event detail=x" in text
+    assert "abort failing_span" in text and "boom" in text

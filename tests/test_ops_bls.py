@@ -1,0 +1,185 @@
+"""Device BLS kernels (ops/fq.py limb field, ops/g1.py point ops) —
+limb-exact cross-checks against the host big-int field and the native C++
+backend."""
+
+import secrets
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from ethereum_consensus_tpu.native import bls as native_bls  # noqa: E402
+from ethereum_consensus_tpu.ops import fq, g1  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not native_bls.available(), reason="native BLS backend unavailable"
+)
+
+
+def rand_fq(n):
+    return [secrets.randbelow(fq.P_INT) for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    values = rand_fq(5) + [0, 1, fq.P_INT - 1]
+    limbs = fq.to_limbs(values)
+    assert fq.from_limbs(limbs) == values
+
+
+def test_field_ops_match_bigint():
+    import jax.numpy as jnp
+
+    a_int = rand_fq(64)
+    b_int = rand_fq(64)
+    a = jnp.asarray(fq.to_limbs(a_int))
+    b = jnp.asarray(fq.to_limbs(b_int))
+
+    got_add = fq.from_limbs(np.asarray(fq.add_mod(a, b)))
+    assert got_add == [(x + y) % fq.P_INT for x, y in zip(a_int, b_int)]
+
+    got_sub = fq.from_limbs(np.asarray(fq.sub_mod(a, b)))
+    assert got_sub == [(x - y) % fq.P_INT for x, y in zip(a_int, b_int)]
+
+    am = fq.to_mont(a)
+    bm = fq.to_mont(b)
+    got_mul = fq.from_limbs(np.asarray(fq.from_mont(fq.mont_mul(am, bm))))
+    assert got_mul == [(x * y) % fq.P_INT for x, y in zip(a_int, b_int)]
+
+    # mont roundtrip is the identity
+    assert fq.from_limbs(np.asarray(fq.from_mont(am))) == a_int
+
+
+def _random_g1_raws(n):
+    """n distinct non-infinity G1 points via native scalar mults of the
+    generator."""
+    gen = native_bls.g1_generator_raw()
+    out = []
+    for _ in range(n):
+        scalar = (1 + secrets.randbelow(2**128)).to_bytes(32, "big")
+        raw, is_inf = native_bls.g1_mul_raw(gen, False, scalar)
+        assert not is_inf
+        out.append(raw)
+    return out
+
+
+def test_point_roundtrip():
+    raws = _random_g1_raws(3)
+    batch = g1.points_from_raw(raws)
+    for i, raw in enumerate(raws):
+        got, is_inf = g1.point_to_raw(batch[i])
+        assert not is_inf and got == raw
+
+
+def test_point_add_matches_native():
+    a_raw, b_raw = _random_g1_raws(2)
+    batch = g1.points_from_raw([a_raw, b_raw])
+    got, is_inf = g1.point_to_raw(g1.point_add(batch[0], batch[1]))
+    want, want_inf = native_bls.g1_add_raw(a_raw, False, b_raw, False)
+    assert (got, is_inf) == (want, want_inf)
+
+
+def test_point_add_corners():
+    (a_raw,) = _random_g1_raws(1)
+    batch = g1.points_from_raw([a_raw])
+    p = batch[0]
+    inf = g1.points_from_raw([b"\x00" * 96])[0]
+
+    # P + inf == P, inf + P == P
+    got, is_inf = g1.point_to_raw(g1.point_add(p, inf))
+    assert not is_inf and got == a_raw
+    got, is_inf = g1.point_to_raw(g1.point_add(inf, p))
+    assert not is_inf and got == a_raw
+    # inf + inf == inf
+    _, is_inf = g1.point_to_raw(g1.point_add(inf, inf))
+    assert is_inf
+
+    # P + P == native double
+    got, is_inf = g1.point_to_raw(g1.point_add(p, p))
+    want, want_inf = native_bls.g1_add_raw(a_raw, False, a_raw, False)
+    assert (got, is_inf) == (want, want_inf)
+
+    # P + (-P) == inf
+    x, y = a_raw[:48], int.from_bytes(a_raw[48:], "big")
+    neg_raw = x + ((fq.P_INT - y) % fq.P_INT).to_bytes(48, "big")
+    neg = g1.points_from_raw([neg_raw])[0]
+    _, is_inf = g1.point_to_raw(g1.point_add(p, neg))
+    assert is_inf
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 64])
+def test_sum_points_matches_native(n):
+    raws = _random_g1_raws(n)
+    got, got_inf = g1.aggregate_pubkeys_device(raws)
+    acc, acc_inf = raws[0], False
+    for raw in raws[1:]:
+        acc, acc_inf = native_bls.g1_add_raw(acc, acc_inf, raw, False)
+    assert (got, got_inf) == (acc, acc_inf)
+
+
+def test_aggregate_matches_bls_eth_aggregate():
+    """Device aggregation equals the crypto-layer eth_aggregate_public_keys
+    on real pubkeys."""
+    from ethereum_consensus_tpu.crypto import bls
+
+    sks = [bls.SecretKey(i + 31337) for i in range(16)]
+    pks = [sk.public_key() for sk in sks]
+    want = bls.eth_aggregate_public_keys(pks).to_bytes()
+
+    raws = []
+    for pk in pks:
+        rc, raw, is_inf = native_bls.g1_decompress(pk.to_bytes())
+        assert rc == 0 and not is_inf
+        raws.append(raw)
+    raw_sum, is_inf = g1.aggregate_pubkeys_device(raws)
+    got = native_bls.g1_compress_raw(raw_sum, is_inf)
+    assert got == want
+
+
+def test_fast_aggregate_verify_device_route():
+    """With the BLS aggregation threshold lowered, fast_aggregate_verify
+    routes through the device fold and returns identical verdicts."""
+    from ethereum_consensus_tpu import ops
+    from ethereum_consensus_tpu.crypto import bls
+
+    msg = b"\x42" * 32
+    sks = [bls.SecretKey(i + 555) for i in range(8)]
+    pks = [sk.public_key() for sk in sks]
+    sig = bls.aggregate([sk.sign(msg) for sk in sks])
+    wrong = bls.SecretKey(31337).sign(msg)
+
+    host_ok = bls.fast_aggregate_verify(pks, msg, sig)
+    host_bad = bls.fast_aggregate_verify(pks, msg, wrong)
+    ops.install(bls_agg_min_n=1)
+    try:
+        assert bls.fast_aggregate_verify(pks, msg, sig) == host_ok is True
+        assert bls.fast_aggregate_verify(pks, msg, wrong) == host_bad is False
+    finally:
+        ops.uninstall()
+
+
+def test_verify_signature_sets_device_route():
+    from ethereum_consensus_tpu import ops
+    from ethereum_consensus_tpu.crypto import bls
+
+    sks = [bls.SecretKey(i + 777) for i in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    sets = []
+    for i in range(6):
+        msg = bytes([i]) * 32
+        sets.append(
+            bls.SignatureSet(pks, msg, bls.aggregate([sk.sign(msg) for sk in sks]))
+        )
+    bad = bls.SignatureSet(pks, b"\x09" * 32, bls.SecretKey(99).sign(b"\x09" * 32))
+
+    ops.install(bls_agg_min_n=1)
+    try:
+        assert bls.verify_signature_sets(sets) == [True] * 6
+        assert bls.verify_signature_sets(sets + [bad]) == [True] * 6 + [False]
+    finally:
+        ops.uninstall()

@@ -81,6 +81,7 @@ import jax.numpy as jnp
 import numpy as np
 from ethereum_consensus_tpu.ops.merkle import zero_hash_words
 from ethereum_consensus_tpu.parallel import chip_mesh, make_chain_step
+from ethereum_consensus_tpu.parallel.step import _length_words
 
 mesh = chip_mesh(2)
 step = make_chain_step(mesh)
@@ -90,7 +91,7 @@ eff = jnp.asarray(np.full(n, 32 * 10**9, dtype=np.uint64))
 active = jnp.asarray(np.ones(n, dtype=bool))
 zw = jnp.asarray(zero_hash_words())
 try:
-    step(balances, eff, active, zw)
+    step(balances, eff, active, zw, jnp.asarray(_length_words(n)))
 except ValueError as e:
     assert "power of two" in str(e), e
     print("step-reject-ok")
@@ -100,3 +101,121 @@ else:
         n_devices=2,
     )
     assert "step-reject-ok" in out
+
+
+def test_run_chain_step_arbitrary_sizes():
+    """run_chain_step pads any registry size (incl. primes and counts
+    smaller than the mesh) and still matches the host merkleizer + totals."""
+    out = run_in_cpu_mesh(
+        """
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from ethereum_consensus_tpu.ops.merkle import zero_hash_words
+from ethereum_consensus_tpu.parallel import chip_mesh, make_chain_step
+from ethereum_consensus_tpu.parallel.step import run_chain_step
+from ethereum_consensus_tpu.ssz import List, uint64
+
+mesh = chip_mesh(8)
+step = make_chain_step(mesh)
+zw = jnp.asarray(zero_hash_words())
+rng = np.random.default_rng(11)
+typ = List[uint64, 2**40]
+for n in (5, 8, 37, 64, 127, 1234):
+    balances = rng.integers(1, 40 * 10**9, size=n, dtype=np.uint64)
+    eff = np.full(n, 32 * 10**9, dtype=np.uint64)
+    active = rng.integers(0, 2, size=n).astype(bool)
+    new_eff, total, root = run_chain_step(step, mesh, balances, eff, active, zw)
+    want_root = typ.hash_tree_root([int(b) for b in balances])
+    got_root = np.asarray(root).astype(">u4").tobytes()
+    assert got_root == want_root, (n, got_root.hex(), want_root.hex())
+    want_total = sum(int(e) for e, a in zip(new_eff, active) if a)
+    assert int(total) == want_total, (n, int(total), want_total)
+print("arbitrary-sizes-ok")
+"""
+    )
+    assert "arbitrary-sizes-ok" in out
+
+
+def test_epoch_sweep_step_matches_host_process_epoch():
+    """The distributed epoch sweep (flag deltas + inactivity, psum'd
+    totals) must reproduce the host altair epoch functions bit-for-bit on
+    a real attested state with a NON-ALIGNED registry, sharded over the
+    8-device mesh."""
+    out = run_in_cpu_mesh(
+        """
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+import sys, os
+sys.path.insert(0, os.path.join(os.getcwd(), "tests"))
+from chain_utils import fresh_genesis_altair, make_attestation, produce_block_altair
+from ethereum_consensus_tpu.models.altair.state_transition import state_transition
+from ethereum_consensus_tpu.models.altair.slot_processing import process_slots
+from ethereum_consensus_tpu.models.altair import helpers as ah
+from ethereum_consensus_tpu.models.altair.epoch_processing import (
+    process_inactivity_updates, process_rewards_and_penalties,
+)
+from ethereum_consensus_tpu.ops.sweeps import pack_registry
+from ethereum_consensus_tpu.parallel import chip_mesh
+from ethereum_consensus_tpu.parallel.step import (
+    make_epoch_sweep_step, pad_registry_for_mesh,
+)
+
+state, ctx = fresh_genesis_altair(29, "minimal")  # non-aligned registry
+# advance past epoch 1 so the epoch stages are NOT the genesis no-op and
+# previous-epoch participation is real
+while state.slot < 2 * ctx.SLOTS_PER_EPOCH + 1:
+    target = state.slot + 1
+    atts = [make_attestation(state, state.slot, 0, ctx)] if state.slot + ctx.MIN_ATTESTATION_INCLUSION_DELAY <= target else []
+    signed = produce_block_altair(state.copy(), target, ctx, attestations=atts)
+    state_transition(state, signed, ctx)
+
+# host reference: the two epoch stages on a copy
+host = state.copy()
+process_inactivity_updates(host, ctx)
+process_rewards_and_penalties(host, ctx)
+
+# device: one sharded sweep over the 8-device mesh
+n = len(state.validators)
+prev = ah.get_previous_epoch(state, ctx)
+cur = ah.get_current_epoch(state, ctx)
+is_leaking = ah.is_in_inactivity_leak(state, ctx)
+packed = pack_registry(state, prev, use_current_participation=(prev == cur))
+active_cur = np.fromiter(
+    (v.activation_epoch <= cur < v.exit_epoch for v in state.validators),
+    np.bool_, n,
+)
+
+mesh = chip_mesh(8)
+sweep = make_epoch_sweep_step(mesh, ctx, is_leaking=is_leaking)
+padded = pad_registry_for_mesh(n, 8)
+
+def pad(arr, dtype):
+    out = np.zeros(padded, dtype)
+    out[:n] = arr
+    return jnp.asarray(out)
+
+new_balances, new_scores, total_active = jax.block_until_ready(
+    sweep(
+        pad(packed["balances"], np.uint64),
+        pad(packed["effective_balance"], np.uint64),
+        pad(packed["previous_participation"], np.uint8),
+        pad(packed["slashed"], np.bool_),
+        pad(packed["active_previous"], np.bool_),
+        pad(active_cur, np.bool_),
+        pad(packed["eligible"], np.bool_),
+        pad(packed["inactivity_scores"], np.uint64),
+    )
+)
+got_balances = [int(b) for b in np.asarray(new_balances)[:n]]
+got_scores = [int(s) for s in np.asarray(new_scores)[:n]]
+assert got_balances == [int(b) for b in host.balances], "balances mismatch"
+assert got_scores == [int(s) for s in host.inactivity_scores], "scores mismatch"
+assert int(total_active) == ah.get_total_active_balance(state, ctx)
+print("epoch-sweep-ok")
+"""
+    )
+    assert "epoch-sweep-ok" in out
