@@ -28,6 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# The limb kernels are meaningless without real uint64 lanes: without x64
+# mode jnp silently truncates to uint32 and every product is garbage.
+# Enabled at import — importing this module IS opting into device crypto.
+jax.config.update("jax_enable_x64", True)
+
 __all__ = [
     "P_INT",
     "LIMBS",
