@@ -47,6 +47,25 @@ class Context:
         self.execution_engine: bool = True
         self._kzg_settings = None
 
+    def scoped_execution_engine(self, engine):
+        """Context manager that swaps ``execution_engine`` for the scope
+        and restores it on exit — the explicit, leak-proof equivalent of
+        the reference's feature-gated field access (context.rs:143-147),
+        used by the conformance harness to inject expected payload
+        validity per test case."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _scope():
+            saved = self.execution_engine
+            self.execution_engine = engine
+            try:
+                yield self
+            finally:
+                self.execution_engine = saved
+
+        return _scope()
+
     # -- constructors (context.rs:152-424) ----------------------------------
     @classmethod
     def for_mainnet(cls) -> "Context":

@@ -81,18 +81,17 @@ class operations(SimpleNamespace):
             test.ssz_snappy(fixture)
         )
         context = test.context
+        engine = True
         if test.handler == "execution_payload":
             meta = test.yaml("execution") or {}
-            context.execution_engine = bool(meta.get("execution_valid", True))
+            engine = bool(meta.get("execution_valid", True))
         process = getattr(mod.block_processing, fn_name)
-        try:
+        with context.scoped_execution_engine(engine):
             if post is None:
                 _expect_error(lambda: process(pre, operation, context))
             else:
                 process(pre, operation, context)
                 _assert_states_equal(pre, post)
-        finally:
-            context.execution_engine = True
 
 
 # -- sanity (runners/sanity.rs:25-50) ----------------------------------------
