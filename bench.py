@@ -184,7 +184,7 @@ def bench_sync_agg():
     return {"ok": ok, "keys": SYNC_KEYS, "verify_s": elapsed}
 
 
-def bench_large_agg(n_points: int = 1 << 14):
+def bench_large_agg(n_points: int = 1 << 16):
     """Large-batch G1 pubkey aggregation (the data-parallel piece of the
     128k-signature north star, BASELINE config 1): device XOR-fold
     (ops/g1.py limb kernels) vs sequential native C++ adds."""

@@ -131,9 +131,16 @@ def _tree_reduce(points, levels: int):
     return jax.lax.fori_loop(0, levels, level, points)[0]
 
 
+_SEGMENT = 256  # phase-1 fold width for large batches
+
+
 def sum_points(points) -> jax.Array:
     """Sum an (N, 3, 24) batch of Jacobian points on device; returns the
-    (3, 24) Jacobian sum. Pads to a power of two with infinity."""
+    (3, 24) Jacobian sum. Pads to a power of two with infinity.
+
+    Large batches reduce in two phases — a segmented fold of
+    ``_SEGMENT``-point blocks, then a fold over the block sums — cutting
+    the full-width XOR fold's levels×W compute to ~(log2 SEGMENT)×W."""
     n = points.shape[0]
     if n == 0:
         return jnp.zeros((3, fq.LIMBS), jnp.uint32)
@@ -141,6 +148,10 @@ def sum_points(points) -> jax.Array:
     if width != n:
         pad = jnp.zeros((width - n, 3, fq.LIMBS), jnp.uint32)
         points = jnp.concatenate([points, pad], axis=0)
+    if width > _SEGMENT:
+        blocks = points.reshape(width // _SEGMENT, _SEGMENT, 3, fq.LIMBS)
+        points = _tree_reduce_segmented(blocks, (_SEGMENT - 1).bit_length())
+        width //= _SEGMENT
     return _tree_reduce(points, (width - 1).bit_length())
 
 
@@ -182,17 +193,17 @@ def sum_points_segmented(points) -> jax.Array:
 def points_from_raw(raws: "list[bytes]") -> jax.Array:
     """Affine raw96 points (x||y, 48-byte big-endian each — the native
     backend's decompressed format) → (N, 3, 24) Montgomery Jacobian batch.
-    All-zero raws (infinity) map to Z=0."""
+    All-zero raws (infinity) map to Z=0.
+
+    The byte→limb conversion is one numpy reinterpret: a 48-byte
+    big-endian coordinate IS its 24 16-bit limbs in reverse order."""
     n = len(raws)
+    words = np.frombuffer(b"".join(raws), dtype=">u2").reshape(n, 48)
     limbs = np.zeros((n, 3, fq.LIMBS), np.uint32)
-    for i, raw in enumerate(raws):
-        x = int.from_bytes(raw[:48], "big")
-        y = int.from_bytes(raw[48:], "big")
-        if x == 0 and y == 0:
-            continue  # infinity: Z stays 0
-        limbs[i, 0] = fq.to_limbs(x)
-        limbs[i, 1] = fq.to_limbs(y)
-        limbs[i, 2, 0] = 1
+    limbs[:, 0] = words[:, :24][:, ::-1]
+    limbs[:, 1] = words[:, 24:][:, ::-1]
+    live = (limbs[:, 0].any(axis=1)) | (limbs[:, 1].any(axis=1))
+    limbs[:, 2, 0] = live  # Z=1 for live points, 0 (infinity) otherwise
     dev = jnp.asarray(limbs)
     # one batched to-Montgomery pass over all coordinates
     return fq.to_mont(dev.reshape(n * 3, fq.LIMBS)).reshape(n, 3, fq.LIMBS)
