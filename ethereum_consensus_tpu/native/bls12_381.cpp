@@ -554,6 +554,12 @@ struct Point {
 typedef Point<FpOps> G1;
 typedef Point<Fp2Ops> G2;
 
+// fast-path subgroup membership (endomorphism criteria; defined with the
+// psi machinery below, validated before first use)
+static bool g1_in_subgroup(const G1& p);
+static bool g2_in_subgroup(const G2& p);
+static void validate_endomorphism_fast_paths();
+
 static Fp G1_B;    // 4
 static Fp2 G2_B;   // 4(u+1)
 static G1 G1_GEN;
@@ -745,7 +751,7 @@ static int g1_decompress(G1& out, const u8 in[48], bool check_subgroup = true) {
   if (!fp_sqrt(y, y2)) return DEC_NOT_ON_CURVE;
   if (fp_is_lex_largest(y) != !!(flags & FLAG_SIGN)) fp_neg(y, y);
   out = pt_from_affine<FpOps>(x, y);
-  if (check_subgroup && !pt_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
+  if (check_subgroup && !g1_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
   return DEC_OK;
 }
 
@@ -772,7 +778,7 @@ static int g2_decompress(G2& out, const u8 in[96], bool check_subgroup = true) {
   if (!fp2_sqrt(y, y2)) return DEC_NOT_ON_CURVE;
   if (fp2_is_lex_largest(y) != !!(flags & FLAG_SIGN)) fp2_neg(y, y);
   out = pt_from_affine<Fp2Ops>(x, y);
-  if (check_subgroup && !pt_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
+  if (check_subgroup && !g2_in_subgroup(out)) return DEC_NOT_IN_SUBGROUP;
   return DEC_OK;
 }
 
@@ -1098,6 +1104,9 @@ static void ensure_init() {
   for (int i = 0; i < 3; i++) fp2_from_raw(ISO_XD[i], ISO_X_DEN[i]);
   for (int i = 0; i < 4; i++) fp2_from_raw(ISO_YN[i], ISO_Y_NUM[i]);
   for (int i = 0; i < 4; i++) fp2_from_raw(ISO_YD[i], ISO_Y_DEN[i]);
+  // validate + enable the endomorphism fast paths (psi cofactor clearing,
+  // psi/GLV subgroup criteria) before any caller can race on their state
+  validate_endomorphism_fast_paths();
   INITIALIZED = true;
 }
 
@@ -1339,6 +1348,218 @@ static void iso_map_to_g2(G2& out, const Fp2& x, const Fp2& y) {
   out = pt_from_affine<Fp2Ops>(xo, yo);
 }
 
+// ---------------------------------------------------------------------------
+// Fast G2 cofactor clearing via the untwist-Frobenius-twist endomorphism
+// (Budroni–Pintore): [h_eff]P == [x²−x−1]P + [x−1]ψ(P) + ψ²([2]P), where
+// x is the (negative) BLS parameter. ψ(x, y) = (c_x·conj(x), c_y·conj(y))
+// with c_x = 1/ξ^((p−1)/3), c_y = 1/ξ^((p−1)/2) — the inverses of the
+// Frobenius gammas already computed for the pairing. Replaces the naive
+// 640-bit H_EFF double-and-add (~950 group ops) with two 64-bit
+// multiplications (~140 ops). The identity is cross-checked once per
+// process against the H_EFF path on the first (pre-clearing, generic)
+// mapped point; a mismatch demotes to the slow path permanently.
+// ---------------------------------------------------------------------------
+
+static Fp2 PSI_CX, PSI_CY;
+static int PSI_STATE = -1;   // set by validate_endomorphism_fast_paths
+static int G2_SUB_STATE = -1;
+// BLS_X_ABS (|x|; x itself is negative) comes from bls12_381_constants.h
+
+static void g2_psi(G2& o, const G2& p) {
+  Fp2 cx, cy, cz;
+  fp2_conj(cx, p.x);
+  fp2_conj(cy, p.y);
+  fp2_conj(cz, p.z);
+  fp2_mul(o.x, cx, PSI_CX);
+  fp2_mul(o.y, cy, PSI_CY);
+  o.z = cz;
+}
+
+static void g2_mul_bls_x_neg(G2& o, const G2& p) {
+  // [x]P = −[|x|]P
+  G2 t;
+  pt_mul(t, p, &BLS_X_ABS, 1);
+  pt_neg(o, t);
+}
+
+template <class Ops>
+static bool pt_eq_jacobian(const Point<Ops>& a, const Point<Ops>& b) {
+  // X1·Z2² == X2·Z1²  and  Y1·Z2³ == Y2·Z1³ (Jacobian equality)
+  typedef typename Ops::F F;
+  bool ai = a.is_inf(), bi = b.is_inf();
+  if (ai || bi) return ai == bi;
+  F z1z1, z2z2, l, r;
+  Ops::sqr(z1z1, a.z);
+  Ops::sqr(z2z2, b.z);
+  Ops::mul(l, a.x, z2z2);
+  Ops::mul(r, b.x, z1z1);
+  if (!Ops::eq(l, r)) return false;
+  F z1c, z2c;
+  Ops::mul(z1c, z1z1, a.z);
+  Ops::mul(z2c, z2z2, b.z);
+  Ops::mul(l, a.y, z2c);
+  Ops::mul(r, b.y, z1c);
+  return Ops::eq(l, r);
+}
+
+static bool g2_eq(const G2& a, const G2& b) { return pt_eq_jacobian<Fp2Ops>(a, b); }
+
+// ---------------------------------------------------------------------------
+// Fast G1 subgroup membership via the GLV endomorphism φ(x,y) = (βx, y)
+// (β a primitive cube root of unity in Fp): on G1, φ acts as
+// multiplication by λ = x²−1 (λ²+λ+1 ≡ 0 mod r), so
+//   P ∈ G1  ⟺  φ(P) + P == [x²]P
+// — two 64-bit multiplications instead of the 255-bit order mul. β and
+// the criterion are validated at first use against the slow check on the
+// generator (positive) and a synthesized off-subgroup curve point
+// (negative); any disagreement demotes permanently.
+// ---------------------------------------------------------------------------
+
+static Fp G1_BETA;
+static int G1_SUB_STATE = -1;  // set by validate_endomorphism_fast_paths
+
+static bool g1_in_subgroup_fast(const G1& p) {
+  if (p.is_inf()) return true;
+  G1 l, r, t;
+  l = p;
+  fp_mul(l.x, p.x, G1_BETA);      // φ(P) — Jacobian x scales the same way
+  pt_add(l, l, p);                // φ(P) + P
+  pt_mul(t, p, &BLS_X_ABS, 1);
+  pt_mul(r, t, &BLS_X_ABS, 1);    // [x²]P (sign of x is irrelevant squared)
+  return pt_eq_jacobian<FpOps>(l, r);
+}
+
+static bool g1_validate_fast_subgroup() {
+  // β = (2^((p−1)/6))² = 2^((p−1)/3); if it's 1, fall back (never for this p)
+  Fp two, g;
+  fp_from_u64(two, 2);
+  fp_pow(g, two, EXP_P_MINUS_1_DIV_6, 6);
+  fp_sqr(G1_BETA, g);
+  if (FpOps::eq(G1_BETA, FP_ONE)) return false;
+  // the GLV eigenvalue may correspond to β or β²; pick the one that fixes
+  // the generator under the criterion
+  if (!g1_in_subgroup_fast(G1_GEN)) {
+    fp_sqr(G1_BETA, G1_BETA);
+    if (!g1_in_subgroup_fast(G1_GEN)) return false;
+  }
+  if (!pt_in_subgroup(G1_GEN)) return false;
+  // negative case: find a curve point (x=2,3,...) that the slow check
+  // rejects (the cofactor is ~2^125, so the first few x all qualify)
+  for (u64 xi = 2; xi < 40; xi++) {
+    Fp x, y2, t, y;
+    fp_from_u64(x, xi);
+    fp_sqr(t, x);
+    fp_mul(y2, t, x);
+    fp_add(y2, y2, G1_B);
+    if (!fp_sqrt(y, y2)) continue;
+    G1 cand = pt_from_affine<FpOps>(x, y);
+    if (pt_in_subgroup(cand)) continue;  // astronomically unlikely
+    return !g1_in_subgroup_fast(cand);
+  }
+  return false;
+}
+
+static bool g1_in_subgroup(const G1& p) {
+  if (G1_SUB_STATE == 1) return g1_in_subgroup_fast(p);
+  return pt_in_subgroup(p);
+}
+
+static void g2_clear_cofactor_fast(G2& o, const G2& p) {
+  G2 t1, t2, t3, t4, n;
+  g2_mul_bls_x_neg(t1, p);          // [x]P
+  g2_psi(t2, p);                    // ψ(P)
+  pt_double(t3, p);
+  g2_psi(t3, t3);
+  g2_psi(t3, t3);                   // ψ²([2]P)
+  pt_neg(n, t2);
+  pt_add(t3, t3, n);                // ψ²(2P) − ψ(P)
+  pt_add(t4, t1, t2);               // [x]P + ψ(P)
+  g2_mul_bls_x_neg(t4, t4);         // [x²]P + [x]ψ(P)
+  pt_add(t3, t3, t4);
+  pt_neg(n, t1);
+  pt_add(t3, t3, n);                // − [x]P
+  pt_neg(n, p);
+  pt_add(t3, t3, n);                // − P
+  o = t3;
+}
+
+// ψ acts on G2 as multiplication by x (p ≡ x mod r for BLS curves), so
+// P ∈ G2  ⟺  ψ(P) == [x]P (Scott's criterion) — a 64-bit mul + ψ instead
+// of the 255-bit order multiplication.
+static bool g2_in_subgroup_fast(const G2& p) {
+  if (p.is_inf()) return true;
+  G2 l, r;
+  g2_psi(l, p);
+  g2_mul_bls_x_neg(r, p);
+  return g2_eq(l, r);
+}
+
+static bool g2_in_subgroup(const G2& p) {
+  if (G2_SUB_STATE == 1) return g2_in_subgroup_fast(p);
+  return pt_in_subgroup(p);
+}
+
+static void g2_clear_cofactor(G2& out, const G2& sum) {
+  if (PSI_STATE == 1) {
+    g2_clear_cofactor_fast(out, sum);
+  } else {
+    pt_mul(out, sum, H_EFF_G2_RAW, 10);
+  }
+}
+
+// Runs once at the tail of ensure_init: derives the endomorphism
+// constants, then validates every fast path against its slow reference on
+// the generator (in-subgroup) and a synthesized generic curve point
+// (off-subgroup, cofactors ≈ 2^125 / 2^507 make random curve points
+// off-subgroup with overwhelming probability). Any disagreement leaves
+// the corresponding path demoted to the slow, always-correct code.
+static void validate_endomorphism_fast_paths() {
+  // --- G1: GLV criterion ---
+  G1_SUB_STATE = g1_validate_fast_subgroup() ? 1 : -1;
+
+  // --- psi constants ---
+  fp2_inv(PSI_CX, FROB_GAMMA1[2]);  // 1/xi^((p-1)/3)
+  fp2_inv(PSI_CY, FROB_GAMMA1[3]);  // 1/xi^((p-1)/2)
+
+  // synthesize a generic point on the twist: x = (a, 0), a = 1, 2, ...
+  G2 cand;
+  bool have_cand = false;
+  for (u64 a = 1; a < 60 && !have_cand; a++) {
+    Fp2 x, y2, t, y;
+    fp_from_u64(x.c0, a);
+    x.c1 = FP_ZERO;
+    fp2_sqr(t, x);
+    fp2_mul(y2, t, x);
+    fp2_add(y2, y2, G2_B);
+    if (!fp2_sqrt(y, y2)) continue;
+    cand = pt_from_affine<Fp2Ops>(x, y);
+    if (pt_in_subgroup(cand)) continue;  // astronomically unlikely
+    have_cand = true;
+  }
+  if (!have_cand) {
+    PSI_STATE = -1;
+    G2_SUB_STATE = -1;
+    return;
+  }
+
+  // cofactor clearing: fast == slow on the generic point
+  G2 fast, slow;
+  g2_clear_cofactor_fast(fast, cand);
+  pt_mul(slow, cand, H_EFF_G2_RAW, 10);
+  PSI_STATE = g2_eq(fast, slow) ? 1 : -1;
+
+  // subgroup criterion: agree on the off-subgroup candidate (false) and
+  // the cleared point + generator (true)
+  if (PSI_STATE == 1) {
+    bool neg_ok = !g2_in_subgroup_fast(cand);
+    bool pos_ok = g2_in_subgroup_fast(slow) && pt_in_subgroup(slow) &&
+                  g2_in_subgroup_fast(G2_GEN);
+    G2_SUB_STATE = (neg_ok && pos_ok) ? 1 : -1;
+  } else {
+    G2_SUB_STATE = -1;
+  }
+}
+
 static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
                              const u8* dst, size_t dst_len) {
   u8 uniform[256];
@@ -1358,7 +1579,7 @@ static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
   iso_map_to_g2(q0, x0, y0);
   iso_map_to_g2(q1, x1, y1);
   pt_add(sum, q0, q1);
-  pt_mul(out, sum, H_EFF_G2_RAW, 10);
+  g2_clear_cofactor(out, sum);
   return true;
 }
 

@@ -241,3 +241,44 @@ def test_pairing_product_raw_bilinearity():
     assert native_bls.pairing_product_is_one_raw(
         [(bytes(96), True)], [(bytes(192), True)]
     )
+
+
+def test_g2_fast_subgroup_check_rejects_off_subgroup_points():
+    """The ψ-criterion subgroup check (validated against the order
+    multiplication at first use) must still reject curve points OUTSIDE
+    G2 — a random curve point is off-subgroup with overwhelming
+    probability."""
+    import secrets
+
+    found = 0
+    for _ in range(64):
+        cand = bytearray(secrets.token_bytes(96))
+        cand[0] = (cand[0] & 0x1F) | 0x80  # compressed, not infinity
+        rc, _raw, is_inf = native_bls.g2_decompress(bytes(cand), check_subgroup=False)
+        if rc != 0 or is_inf:
+            continue
+        rc2, _, _ = native_bls.g2_decompress(bytes(cand), check_subgroup=True)
+        assert rc2 == -6, f"off-subgroup point accepted (rc={rc2})"
+        found += 1
+        if found >= 3:
+            break
+    assert found >= 1, "never found a decompressible candidate"
+
+
+def test_g1_fast_subgroup_check_rejects_off_subgroup_points():
+    """GLV-criterion G1 membership must reject curve points outside G1."""
+    import secrets
+
+    found = 0
+    for _ in range(64):
+        cand = bytearray(secrets.token_bytes(48))
+        cand[0] = (cand[0] & 0x1F) | 0x80  # compressed, not infinity
+        rc, _raw, is_inf = native_bls.g1_decompress(bytes(cand), check_subgroup=False)
+        if rc != 0 or is_inf:
+            continue
+        rc2, _, _ = native_bls.g1_decompress(bytes(cand), check_subgroup=True)
+        assert rc2 == -6, f"off-subgroup G1 point accepted (rc={rc2})"
+        found += 1
+        if found >= 3:
+            break
+    assert found >= 1, "never found a decompressible candidate"

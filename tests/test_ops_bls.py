@@ -112,7 +112,7 @@ def test_point_add_corners():
     assert is_inf
 
 
-@pytest.mark.parametrize("n", [1, 2, 7, 64])
+@pytest.mark.parametrize("n", [1, 2, 7, 64, 257, 513])
 def test_sum_points_matches_native(n):
     raws = _random_g1_raws(n)
     got, got_inf = g1.aggregate_pubkeys_device(raws)
