@@ -154,16 +154,32 @@ def bench_att_batch():
     verdicts = bls.verify_signature_sets(sets)
     batch_s = time.perf_counter() - t0
 
+    # device-routed variant: per-set pubkey aggregation as one segmented
+    # device fold, native multi-pairing on the aggregates
+    from ethereum_consensus_tpu import ops
+
+    ops.install(bls_agg_min_n=1)
+    try:
+        bls.verify_signature_sets(sets)  # warm the fold compile
+        t0 = time.perf_counter()
+        dev_verdicts = bls.verify_signature_sets(sets)
+        device_s = time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 — report host numbers regardless
+        dev_verdicts, device_s = verdicts, None
+    finally:
+        ops.uninstall()
+
     sample = sets[:32]
     t0 = time.perf_counter()
     seq_ok = all(s.verify() for s in sample)
     seq_s = (time.perf_counter() - t0) * (ATT_SETS / len(sample))
 
     return {
-        "ok": all(verdicts) and seq_ok,
+        "ok": all(verdicts) and all(dev_verdicts) and seq_ok,
         "sets": ATT_SETS,
         "keys_per_set": ATT_KEYS,
         "batch_s": batch_s,
+        "batch_device_routed_s": device_s,
         "sequential_s_extrapolated": seq_s,
         "sets_per_s": ATT_SETS / batch_s,
         "backend": bls.backend_name(),
