@@ -833,13 +833,42 @@ struct MillerPair {
   G2 t;        // accumulator
 };
 
-static void line_to_fp12(Fp12& l, const Fp2& c00, const Fp2& c11, const Fp2& c12) {
-  l.c0.a0 = c00;
-  l.c0.a1 = FP2_ZERO;
-  l.c0.a2 = FP2_ZERO;
-  l.c1.a0 = FP2_ZERO;
-  l.c1.a1 = c11;
-  l.c1.a2 = c12;
+// f *= line, exploiting the line's sparsity: line = A + B·w with
+// A = (c00, 0, 0) and B = (0, c11, c12) in the Fp6[w]/(w²−v) tower.
+// Karatsuba over the halves costs 3 + 6 + 6 = 15 fp2_mul vs the full
+// fp12_mul's 18 — the saving lands on every Miller-loop step.
+static void fp12_mul_by_line(Fp12& f, const Fp2& c00, const Fp2& c11, const Fp2& c12) {
+  // t0 = f.c0 · A  (component scale by c00)
+  Fp6 t0;
+  fp2_mul(t0.a0, f.c0.a0, c00);
+  fp2_mul(t0.a1, f.c0.a1, c00);
+  fp2_mul(t0.a2, f.c0.a2, c00);
+  // t1 = f.c1 · B:  (a0 + a1 v + a2 v²)(b v + c v²) with v³ = ξ
+  //   = ξ(a1 c + a2 b) + (a0 b + ξ a2 c)·v + (a0 c + a1 b)·v²
+  Fp6 t1;
+  Fp2 u, w;
+  fp2_mul(u, f.c1.a1, c12);
+  fp2_mul(w, f.c1.a2, c11);
+  fp2_add(u, u, w);
+  fp2_mul_by_xi(t1.a0, u);
+  fp2_mul(u, f.c1.a0, c11);
+  fp2_mul(w, f.c1.a2, c12);
+  fp2_mul_by_xi(w, w);
+  fp2_add(t1.a1, u, w);
+  fp2_mul(u, f.c1.a0, c12);
+  fp2_mul(w, f.c1.a1, c11);
+  fp2_add(t1.a2, u, w);
+  // t2 = (f.c0 + f.c1) · (A + B); A + B = (c00, c11, c12) is dense
+  Fp6 sum, ab, t2;
+  fp6_add(sum, f.c0, f.c1);
+  ab.a0 = c00; ab.a1 = c11; ab.a2 = c12;
+  fp6_mul(t2, sum, ab);
+  // o.c0 = t0 + v·t1 ; o.c1 = t2 − t0 − t1
+  Fp6 vt;
+  fp6_mul_by_v(vt, t1);
+  fp6_add(f.c0, t0, vt);
+  fp6_sub(t2, t2, t0);
+  fp6_sub(f.c1, t2, t1);
 }
 
 // tangent line at pr.t evaluated at (xp, yp); multiplies into f
@@ -869,9 +898,7 @@ static void miller_double_step(Fp12& f, MillerPair& pr) {
   fp2_add(x2_3, x2_3, x2);
   fp2_mul(t, x2_3, z2);
   fp2_scalar_mul(c12, t, pr.xp);
-  Fp12 l;
-  line_to_fp12(l, c00, c11, c12);
-  fp12_mul(f, f, l);
+  fp12_mul_by_line(f, c00, c11, c12);
   pt_double(pr.t, pr.t);
 }
 
@@ -897,9 +924,7 @@ static void miller_add_step(Fp12& f, MillerPair& pr) {
   fp2_sub(c11, t, u);
   // c12 = lam_n * xp
   fp2_scalar_mul(c12, lam_n, pr.xp);
-  Fp12 l;
-  line_to_fp12(l, c00, c11, c12);
-  fp12_mul(f, f, l);
+  fp12_mul_by_line(f, c00, c11, c12);
   G2 q = pt_from_affine<Fp2Ops>(pr.xq, pr.yq);
   pt_add(pr.t, pr.t, q);
 }
