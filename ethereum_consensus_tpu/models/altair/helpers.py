@@ -249,8 +249,13 @@ def get_flag_index_deltas(state, flag_index: int, context):
         // context.EFFECTIVE_BALANCE_INCREMENT
     )
     not_leaking = not is_in_inactivity_leak(state, context)
+    # hoist the O(n) total-active-balance out of the per-validator loop
+    brpi = get_base_reward_per_increment(state, context)
+    increment = context.EFFECTIVE_BALANCE_INCREMENT
     for index in get_eligible_validator_indices(state, context):
-        base_reward = get_base_reward(state, index, context)
+        base_reward = (
+            state.validators[index].effective_balance // increment
+        ) * brpi
         if index in unslashed:
             if not_leaking:
                 reward_numerator = base_reward * weight * unslashed_increments

@@ -282,6 +282,9 @@ def process_attestation(state, attestation, context) -> None:
         else state.previous_epoch_participation
     )
     proposer_reward_numerator = 0
+    # hoist the O(n) total-active-balance out of the attester loop
+    brpi = h.get_base_reward_per_increment(state, context)
+    increment = context.EFFECTIVE_BALANCE_INCREMENT
     for index in attesting_indices:
         for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
             if flag_index in participation_flag_indices and not h.has_flag(
@@ -289,8 +292,8 @@ def process_attestation(state, attestation, context) -> None:
             ):
                 participation[index] = h.add_flag(participation[index], flag_index)
                 proposer_reward_numerator += (
-                    h.get_base_reward(state, index, context) * weight
-                )
+                    state.validators[index].effective_balance // increment
+                ) * brpi * weight
 
     proposer_reward_denominator = (
         (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT

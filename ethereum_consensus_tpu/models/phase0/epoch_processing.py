@@ -171,6 +171,24 @@ def get_base_reward(state, index: int, context) -> int:
     )
 
 
+def _base_reward_fn(state, context):
+    """Per-index base-reward closure with the O(n) total-active-balance
+    hoisted out — get_base_reward recomputes it per call, which turns the
+    whole-registry delta loops O(n²)."""
+    sqrt_total = h.integer_squareroot(h.get_total_active_balance(state, context))
+    factor = context.BASE_REWARD_FACTOR
+
+    def base_reward(index: int) -> int:
+        return (
+            state.validators[index].effective_balance
+            * factor
+            // sqrt_total
+            // BASE_REWARDS_PER_EPOCH
+        )
+
+    return base_reward
+
+
 BASE_REWARDS_PER_EPOCH = 4
 PROPOSER_REWARD_QUOTIENT = 8
 
@@ -207,17 +225,19 @@ def get_attestation_component_deltas(state, attestations, context):
     unslashed = get_unslashed_attesting_indices(state, attestations, context)
     attesting_balance = h.get_total_balance(state, unslashed, context)
     increment = context.EFFECTIVE_BALANCE_INCREMENT
+    base_reward = _base_reward_fn(state, context)
+    leaking = is_in_inactivity_leak(state, context)
     for index in get_eligible_validator_indices(state, context):
         if index in unslashed:
-            if is_in_inactivity_leak(state, context):
-                rewards[index] += get_base_reward(state, index, context)
+            if leaking:
+                rewards[index] += base_reward(index)
             else:
-                reward_numerator = get_base_reward(state, index, context) * (
+                reward_numerator = base_reward(index) * (
                     attesting_balance // increment
                 )
                 rewards[index] += reward_numerator // (total_balance // increment)
         else:
-            penalties[index] += get_base_reward(state, index, context)
+            penalties[index] += base_reward(index)
     return rewards, penalties
 
 
@@ -256,6 +276,7 @@ def get_inclusion_delay_deltas(state, context):
     source_attestations = get_matching_source_attestations(
         state, previous_epoch, context
     )
+    base_reward = _base_reward_fn(state, context)
     for index in get_unslashed_attesting_indices(state, source_attestations, context):
         candidates = [
             a
@@ -264,12 +285,9 @@ def get_inclusion_delay_deltas(state, context):
             in h.get_attesting_indices(state, a.data, a.aggregation_bits, context)
         ]
         attestation = min(candidates, key=lambda a: a.inclusion_delay)
-        rewards[attestation.proposer_index] += get_proposer_reward(
-            state, index, context
-        )
-        max_attester_reward = get_base_reward(state, index, context) - get_proposer_reward(
-            state, index, context
-        )
+        proposer_reward = base_reward(index) // context.PROPOSER_REWARD_QUOTIENT
+        rewards[attestation.proposer_index] += proposer_reward
+        max_attester_reward = base_reward(index) - proposer_reward
         rewards[index] += max_attester_reward // attestation.inclusion_delay
     return rewards, penalties
 
@@ -285,12 +303,11 @@ def get_inactivity_penalty_deltas(state, context):
             get_matching_target_attestations(state, previous_epoch, context),
             context,
         )
+        base_reward = _base_reward_fn(state, context)
         for index in get_eligible_validator_indices(state, context):
-            base_rewards = BASE_REWARDS_PER_EPOCH * get_base_reward(
-                state, index, context
-            )
+            base_rewards = BASE_REWARDS_PER_EPOCH * base_reward(index)
             penalties[index] += saturating_sub(
-                base_rewards, get_proposer_reward(state, index, context)
+                base_rewards, base_reward(index) // context.PROPOSER_REWARD_QUOTIENT
             )
             if index not in matching_target_attesting_indices:
                 effective = state.validators[index].effective_balance

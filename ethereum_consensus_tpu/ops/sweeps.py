@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# exact u64 spec arithmetic is meaningless without real uint64 lanes
+# (without x64 mode jnp silently truncates to uint32)
+jax.config.update("jax_enable_x64", True)
+
 from ..models.altair.constants import (
     PARTICIPATION_FLAG_WEIGHTS,
     TIMELY_HEAD_FLAG_INDEX,
