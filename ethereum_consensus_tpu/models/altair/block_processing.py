@@ -163,7 +163,7 @@ def process_attester_slashing(state, attester_slashing, context, slash_fn=None) 
         raise InvalidAttesterSlashing("no validator could be slashed")
 
 
-def process_deposit(state, deposit, context) -> None:
+def process_deposit(state, deposit, context, pubkey_index=None) -> None:
     """(phase0 block_processing.rs:405 with altair apply_deposit)"""
     leaf = DepositData.hash_tree_root(deposit.data)
     if not is_valid_merkle_branch(
@@ -175,7 +175,7 @@ def process_deposit(state, deposit, context) -> None:
     ):
         raise InvalidDeposit("invalid deposit inclusion proof")
     state.eth1_deposit_index = checked_add(state.eth1_deposit_index, 1)
-    apply_deposit(state, deposit.data, context)
+    apply_deposit(state, deposit.data, context, pubkey_index=pubkey_index)
 
 
 def add_validator_to_registry(
@@ -194,12 +194,16 @@ def add_validator_to_registry(
     state.inactivity_scores.append(0)
 
 
-def apply_deposit(state, deposit_data, context) -> None:
+def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
     """altair apply_deposit: new validators also get participation flags and
-    inactivity-score entries."""
+    inactivity-score entries. ``pubkey_index`` as in phase0 apply_deposit."""
     public_key = deposit_data.public_key
-    pubkeys = [v.public_key for v in state.validators]
-    if public_key not in pubkeys:
+    if pubkey_index is not None:
+        existing = pubkey_index.get(bytes(public_key))
+    else:
+        pubkeys = [v.public_key for v in state.validators]
+        existing = pubkeys.index(public_key) if public_key in pubkeys else None
+    if existing is None:
         deposit_message = DepositMessage(
             public_key=public_key,
             withdrawal_credentials=deposit_data.withdrawal_credentials,
@@ -222,9 +226,10 @@ def apply_deposit(state, deposit_data, context) -> None:
             deposit_data.amount,
             context,
         )
+        if pubkey_index is not None:
+            pubkey_index[bytes(public_key)] = len(state.validators) - 1
     else:
-        index = pubkeys.index(public_key)
-        h.increase_balance(state, index, deposit_data.amount)
+        h.increase_balance(state, existing, deposit_data.amount)
 
 
 def process_sync_aggregate(state, sync_aggregate, context) -> None:
@@ -325,8 +330,12 @@ def process_operations(
         process_attester_slashing(state, op, context, slash_fn=slash_fn)
     for op in body.attestations:
         attestation_fn(state, op, context)
-    for op in body.deposits:
-        deposit_fn(state, op, context)
+    if body.deposits:
+        pubkey_index = {
+            bytes(v.public_key): i for i, v in enumerate(state.validators)
+        }
+        for op in body.deposits:
+            deposit_fn(state, op, context, pubkey_index=pubkey_index)
     for op in body.voluntary_exits:
         voluntary_exit_fn(state, op, context)
 

@@ -256,11 +256,18 @@ def get_validator_from_deposit(deposit_data, context):
     )
 
 
-def apply_deposit(state, deposit_data, context) -> None:
-    """(block_processing.rs:351)"""
+def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
+    """(block_processing.rs:351)
+
+    ``pubkey_index`` (pubkey bytes → validator index) lets batch callers
+    avoid the O(n) registry scan per deposit; semantics are unchanged."""
     public_key = deposit_data.public_key
-    pubkeys = [v.public_key for v in state.validators]
-    if public_key not in pubkeys:
+    if pubkey_index is not None:
+        existing = pubkey_index.get(bytes(public_key))
+    else:
+        pubkeys = [v.public_key for v in state.validators]
+        existing = pubkeys.index(public_key) if public_key in pubkeys else None
+    if existing is None:
         deposit_message = DepositMessage(
             public_key=public_key,
             withdrawal_credentials=deposit_data.withdrawal_credentials,
@@ -278,12 +285,13 @@ def apply_deposit(state, deposit_data, context) -> None:
             return  # invalid deposit signatures are skipped, not errors
         state.validators.append(get_validator_from_deposit(deposit_data, context))
         state.balances.append(deposit_data.amount)
+        if pubkey_index is not None:
+            pubkey_index[bytes(public_key)] = len(state.validators) - 1
     else:
-        index = pubkeys.index(public_key)
-        h.increase_balance(state, index, deposit_data.amount)
+        h.increase_balance(state, existing, deposit_data.amount)
 
 
-def process_deposit(state, deposit, context) -> None:
+def process_deposit(state, deposit, context, pubkey_index=None) -> None:
     """(block_processing.rs:405)"""
     leaf = DepositData.hash_tree_root(deposit.data)
     if not is_valid_merkle_branch(
@@ -295,7 +303,7 @@ def process_deposit(state, deposit, context) -> None:
     ):
         raise InvalidDeposit("invalid deposit inclusion proof")
     state.eth1_deposit_index = checked_add(state.eth1_deposit_index, 1)
-    apply_deposit(state, deposit.data, context)
+    apply_deposit(state, deposit.data, context, pubkey_index=pubkey_index)
 
 
 def process_voluntary_exit(state, signed_voluntary_exit, context) -> None:
@@ -343,8 +351,13 @@ def process_operations(state, body, context) -> None:
         process_attester_slashing(state, op, context)
     for op in body.attestations:
         process_attestation(state, op, context)
-    for op in body.deposits:
-        process_deposit(state, op, context)
+    if body.deposits:
+        # one O(n) index instead of an O(n) scan per deposit
+        pubkey_index = {
+            bytes(v.public_key): i for i, v in enumerate(state.validators)
+        }
+        for op in body.deposits:
+            process_deposit(state, op, context, pubkey_index=pubkey_index)
     for op in body.voluntary_exits:
         process_voluntary_exit(state, op, context)
 

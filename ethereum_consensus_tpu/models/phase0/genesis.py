@@ -51,17 +51,44 @@ def initialize_beacon_state_from_eth1(
         randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
     )
 
-    # process deposits with an incrementally updated deposit root
-    leaves = [d.data for d in deposits]
-    from ...ssz import List as SSZList
+    # process deposits with an incrementally updated deposit root: a
+    # re-merkleization of the i-prefix per deposit is O(n² log n); the
+    # deposit-contract incremental branch computes each successive
+    # List[DepositData, 2^32] root in O(log n) (identical roots — the
+    # growing-list tree IS the incremental tree), and one shared pubkey
+    # index replaces the per-deposit O(n) registry scan
+    import hashlib as _hashlib
 
-    deposit_data_list_type = SSZList[DepositData, 2**32]
+    from ...ssz.merkle import zero_hash
+
+    depth = 32  # 2^32 list limit
+    branch = [b"\x00" * 32] * depth
+    pubkey_index = {
+        bytes(v.public_key): i for i, v in enumerate(state.validators)
+    }
     for index, deposit in enumerate(deposits):
-        deposit_data_list = leaves[: index + 1]
-        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
-            deposit_data_list
-        )
-        process_deposit(state, deposit, context)
+        # insert leaf index into the incremental branch
+        node = DepositData.hash_tree_root(deposit.data)
+        size = index + 1
+        for level in range(depth):
+            if size & 1:
+                branch[level] = node
+                break
+            node = _hashlib.sha256(branch[level] + node).digest()
+            size >>= 1
+        # root over the branch with zero-subtree siblings + length mix-in
+        node = b"\x00" * 32
+        size = index + 1
+        for level in range(depth):
+            if size & 1:
+                node = _hashlib.sha256(branch[level] + node).digest()
+            else:
+                node = _hashlib.sha256(node + zero_hash(level)).digest()
+            size >>= 1
+        state.eth1_data.deposit_root = _hashlib.sha256(
+            node + (index + 1).to_bytes(32, "little")
+        ).digest()
+        process_deposit(state, deposit, context, pubkey_index=pubkey_index)
 
     # activate bootstrap validators
     for index, validator in enumerate(state.validators):
