@@ -20,7 +20,14 @@ Semantics are preserved exactly:
 * Each deferred set carries the structured error its call site would have
   raised; ``flush`` raises the error of the FIRST failing set in
   insertion (i.e. spec) order, so error attribution still names the
-  specific invalid operation.
+  specific invalid operation. Caveat: that ordering holds *among
+  signature errors only*. Because verification is deferred to the flush,
+  a structurally invalid operation later in the block (e.g. a malformed
+  exit) raises at its call site BEFORE an earlier operation's bad
+  signature is ever checked — the sequential path would have surfaced
+  the signature error first. Either way the transition aborts with a
+  structured framework error and the state is discarded, so only the
+  error *type* differs in that cross case, never validity.
 * A failed flush aborts the whole transition — identical observable
   behavior to the sequential path, because an invalid block discards the
   state either way (the reference's Executor does the same;

@@ -231,17 +231,32 @@ def _inactivity_penalties(effective_balance, scores, not_target, bias: int, quot
 
 
 def inactivity_penalties_device(packed: dict, context, quotient: int):
-    """Device twin of get_inactivity_penalty_deltas (per-fork quotient)."""
+    """Device twin of get_inactivity_penalty_deltas (per-fork quotient).
+
+    The device kernel multiplies effective_balance * score in uint64,
+    which wraps once a score exceeds 2^64 / effective_balance (~5.8e8 at
+    32 ETH, ~9e6 at electra's 2048 ETH cap) — scores that large need an
+    inactivity leak lasting years, but they are representable, so the
+    spec's exact-bigint semantics are preserved by routing through an
+    exact object-int path whenever the max products could wrap."""
     participating = (
         ((packed["previous_participation"] >> np.uint8(1)) & 1).astype(bool)
         & ~packed["slashed"]
         & packed["active_previous"]
     )
     not_target = packed["eligible"] & ~participating
+    eff = packed["effective_balance"]
+    scores = packed["inactivity_scores"]
+    max_product = int(eff.max(initial=0)) * int(scores.max(initial=0))
+    if max_product >= 1 << 64:
+        denominator = context.inactivity_score_bias * quotient
+        products = eff.astype(object) * scores.astype(object)
+        exact = np.where(not_target, products // denominator, 0)
+        return exact.astype(np.uint64)
     return np.asarray(
         _inactivity_penalties(
-            jnp.asarray(packed["effective_balance"]),
-            jnp.asarray(packed["inactivity_scores"]),
+            jnp.asarray(eff),
+            jnp.asarray(scores),
             jnp.asarray(not_target),
             context.inactivity_score_bias,
             quotient,

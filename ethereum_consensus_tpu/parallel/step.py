@@ -190,6 +190,7 @@ def make_epoch_sweep_step(
     context,
     axis_name: str = SHARD_AXIS,
     is_leaking: bool = False,
+    check_score_bound: bool = True,
 ):
     """The distributed altair epoch sweep (the real per-epoch hot loop):
     inactivity-score updates, the three participation-flag delta sweeps,
@@ -204,7 +205,20 @@ def make_epoch_sweep_step(
     arrays → ``(new_balances, new_scores, total_active_balance)``.
     ``participation`` is the uint8 flag byte for the delta epoch
     (previous, or current in the genesis corner — the caller picks when
-    packing, see ops.sweeps.pack_registry)."""
+    packing, see ops.sweeps.pack_registry).
+
+    Precondition for the bit-identical guarantee: every
+    ``effective_balance * inactivity_score`` product must fit in uint64,
+    i.e. max score < 2^64 / max_effective_balance (~5.8e8 at 32 ETH,
+    ~9e6 at electra's 2048 ETH cap — both need a years-long leak).
+    Inside jit the sweep cannot branch on data, so by default the
+    returned step wraps the jitted kernel with a host-side check of
+    ``max(effective) * max(scores)`` (one small device reduction + sync
+    per call) and raises ``OverflowError`` when the bound is exceeded —
+    that epoch must then run through the host spec path (the
+    single-device twin, ops.sweeps.inactivity_penalties_device, reroutes
+    itself). Pass ``check_score_bound=False`` to get the raw jitted step
+    for composition inside a larger jit."""
     from ..models.altair.constants import (
         PARTICIPATION_FLAG_WEIGHTS,
         TIMELY_HEAD_FLAG_INDEX,
@@ -315,7 +329,7 @@ def make_epoch_sweep_step(
         return new_balances, new_scores, total_active
 
     spec = P(axis_name)
-    return jax.jit(
+    jitted = jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
@@ -324,3 +338,19 @@ def make_epoch_sweep_step(
             check_vma=False,
         )
     )
+    if not check_score_bound:
+        return jitted
+
+    def checked_step(balances, eff, participation, slashed, active_prev,
+                     active_cur, eligible, scores):
+        max_product = int(jnp.max(eff)) * int(jnp.max(scores))
+        if max_product >= 1 << 64:
+            raise OverflowError(
+                "inactivity score × effective balance exceeds uint64: the "
+                "device epoch sweep would wrap; route this epoch through "
+                "the host spec path (see make_epoch_sweep_step docstring)"
+            )
+        return jitted(balances, eff, participation, slashed, active_prev,
+                      active_cur, eligible, scores)
+
+    return checked_step

@@ -100,6 +100,26 @@ def test_inactivity_penalties_device_matches_host():
     assert host_rewards == [0] * 16
 
 
+def test_inactivity_penalties_exact_path_above_u64_bound():
+    """Scores large enough that effective_balance * score wraps uint64 must
+    route through the exact object-int branch and still match the host
+    spec function (which computes in unbounded Python ints)."""
+    state, ctx = _scrambled_state()
+    # push several scores past 2^64 / 32ETH ≈ 5.8e8 so the u64 product wraps
+    for i, score in ((0, 10**9), (1, 6 * 10**8), (7, 2**34)):
+        state.inactivity_scores[i] = score
+    previous_epoch = h.get_previous_epoch(state, ctx)
+    packed = sweeps.pack_registry(state, previous_epoch)
+    eff = packed["effective_balance"].astype(object)
+    scores = packed["inactivity_scores"].astype(object)
+    assert int((eff * scores).max()) >= 1 << 64  # the guard must trip
+    host_rewards, host_penalties = ah.get_inactivity_penalty_deltas(state, ctx)
+    got = sweeps.inactivity_penalties_device(
+        packed, ctx, ctx.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    )
+    assert got.tolist() == host_penalties
+
+
 def test_effective_balance_updates_device_matches_host():
     state, ctx = _scrambled_state()
     packed = sweeps.pack_registry(state, h.get_previous_epoch(state, ctx))
