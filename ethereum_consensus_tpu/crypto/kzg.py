@@ -79,6 +79,13 @@ _FR_TWO_ADICITY = 32
 
 _CEREMONY = None  # process-wide cache of the embedded ceremony setup
 
+# Pin of the pre-decompressed ceremony binary (native/_gen_trusted_setup.py);
+# a mismatch falls back to the validated-JSON slow path, never to trust.
+CEREMONY_AFFINE_MAGIC = b"ECTS\x01\x00"
+CEREMONY_AFFINE_SHA256 = (
+    "92199542ef523b03dbbbd1071709e21801a220161fb8374ebfeda64ed4b168c5"
+)
+
 
 def _roots_of_unity(order: int) -> list[int]:
     """The order-``order`` subgroup of Fr*, in natural order."""
@@ -239,21 +246,73 @@ class KzgSettings:
             return cls.from_json(f.read())
 
     @classmethod
+    def _from_affine_bin(cls, blob: bytes) -> "KzgSettings":
+        """Construct from the pre-decompressed binary rendered at build
+        time by native/_gen_trusted_setup.py (see its docstring for the
+        layout). No per-point validation — the caller pins the blob's
+        sha256, and the blob was derived from the fully validated JSON."""
+        import struct
+
+        from .fields import Fq, Fq2
+
+        if blob[:6] != CEREMONY_AFFINE_MAGIC:
+            raise KzgError("bad trusted_setup_affine.bin magic")
+        if len(blob) < 14:
+            raise KzgError("truncated trusted_setup_affine.bin")
+        n_g1, n_g2 = struct.unpack_from("<II", blob, 6)
+        off = 14
+        if len(blob) != off + 96 * n_g1 + 192 * n_g2:
+            raise KzgError("truncated trusted_setup_affine.bin")
+        g1_points = []
+        for _ in range(n_g1):
+            g1_points.append(G1Point.from_affine(
+                Fq(int.from_bytes(blob[off:off + 48], "big")),
+                Fq(int.from_bytes(blob[off + 48:off + 96], "big")),
+            ))
+            off += 96
+        g1_raw = blob[14:off]
+        g2_points, g2_raws = [], []
+        for _ in range(n_g2):
+            c = [int.from_bytes(blob[off + 48 * i:off + 48 * (i + 1)], "big")
+                 for i in range(4)]
+            g2_points.append(G2Point.from_affine(
+                Fq2(Fq(c[0]), Fq(c[1])), Fq2(Fq(c[2]), Fq(c[3]))
+            ))
+            g2_raws.append(blob[off:off + 192])
+            off += 192
+        # points arrive already bit-reversal-permuted — __init__ expects
+        # exactly that order (it never re-permutes), so construct normally
+        # and attach the raw-affine caches
+        settings = cls(g1_points, g2_points)
+        settings._g1_raw = g1_raw
+        settings._g2_raw = g2_raws[:2]
+        return settings
+
+    @classmethod
     def ceremony(cls) -> "KzgSettings":
         """The published mainnet ceremony setup, embedded with the package
         (same artifact the reference embeds:
         ethereum-consensus/src/deneb/presets/trusted_setup.json, loaded at
-        deneb/presets/mod.rs:10 / context.rs:206). Cached per process."""
+        deneb/presets/mod.rs:10 / context.rs:206). Cached per process.
+
+        Fast path: the build-time pre-decompressed binary (sha256-pinned,
+        rendered from the JSON by native/_gen_trusted_setup.py) loads in
+        tens of ms; the JSON + 4096 subgroup checks (seconds) is only the
+        fallback when the binary is missing or does not match its pin."""
         global _CEREMONY
         if _CEREMONY is None:
+            import hashlib
             import os
 
-            path = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "data",
-                "trusted_setup.json",
-            )
-            _CEREMONY = cls.from_file(path)
+            data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+            bin_path = os.path.join(data_dir, "trusted_setup_affine.bin")
+            if os.path.exists(bin_path):
+                with open(bin_path, "rb") as f:
+                    blob = f.read()
+                if hashlib.sha256(blob).hexdigest() == CEREMONY_AFFINE_SHA256:
+                    _CEREMONY = cls._from_affine_bin(blob)
+                    return _CEREMONY
+            _CEREMONY = cls.from_file(os.path.join(data_dir, "trusted_setup.json"))
         return _CEREMONY
 
     @classmethod

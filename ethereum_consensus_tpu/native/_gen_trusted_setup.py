@@ -1,0 +1,74 @@
+"""Emit crypto/data/trusted_setup_affine.bin from trusted_setup.json.
+
+First access to the embedded KZG ceremony used to cost seconds: 4096 G1
+decompressions with subgroup checks (the price the reference pays inside
+c-kzg's `load_trusted_setup`, crypto/kzg.rs:39). This build-time step pays
+that price ONCE — the JSON (the checked-in source of truth, byte-identical
+to the reference's ceremony artifact) is fully validated through
+`KzgSettings.from_json` (curve + subgroup checks per point), then the
+already-decompressed raw affine coordinates are written in a flat binary
+whose sha256 is pinned in crypto/kzg.py. Runtime load = read + hash check
++ object construction (<0.1s).
+
+Run from the repo root after any change to the JSON or the format:
+
+    python -m ethereum_consensus_tpu.native._gen_trusted_setup
+
+Layout (all integers little-endian):
+    6s   magic  b"ECTS\\x01\\x00"
+    u32  n_g1   (number of G1 Lagrange points)
+    u32  n_g2   (number of G2 monomial points)
+    n_g1 * 96 bytes   G1 affine (x||y, 48-byte big-endian each), BIT-
+                      REVERSAL-PERMUTED order (the blob-native order
+                      KzgSettings stores)
+    n_g2 * 192 bytes  G2 affine (x.c0||x.c1||y.c0||y.c1), natural order
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+
+DATA_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "crypto",
+    "data",
+)
+OUT = os.path.join(DATA_DIR, "trusted_setup_affine.bin")
+
+
+def render() -> bytes:
+    """Validate the JSON ceremony setup and render the binary form."""
+    from ..crypto.kzg import CEREMONY_AFFINE_MAGIC, KzgSettings
+
+    settings = KzgSettings.from_file(os.path.join(DATA_DIR, "trusted_setup.json"))
+    parts = [
+        CEREMONY_AFFINE_MAGIC,
+        struct.pack("<II", settings.n, len(settings.g2_monomial)),
+    ]
+    for pt in settings.g1_lagrange_brp:
+        x, y = pt.to_affine()
+        parts.append(x.n.to_bytes(48, "big") + y.n.to_bytes(48, "big"))
+    for pt in settings.g2_monomial:
+        x, y = pt.to_affine()
+        parts.append(
+            x.c0.n.to_bytes(48, "big")
+            + x.c1.n.to_bytes(48, "big")
+            + y.c0.n.to_bytes(48, "big")
+            + y.c1.n.to_bytes(48, "big")
+        )
+    return b"".join(parts)
+
+
+def main() -> None:
+    blob = render()
+    with open(OUT, "wb") as f:
+        f.write(blob)
+    print(f"wrote {OUT} ({len(blob)} bytes)")
+    print(f"sha256: {hashlib.sha256(blob).hexdigest()}")
+    print("pin this digest as CEREMONY_AFFINE_SHA256 in crypto/kzg.py")
+
+
+if __name__ == "__main__":
+    main()
