@@ -278,14 +278,15 @@ class KzgSettings:
             g2_points.append(G2Point.from_affine(
                 Fq2(Fq(c[0]), Fq(c[1])), Fq2(Fq(c[2]), Fq(c[3]))
             ))
-            g2_raws.append(blob[off:off + 192])
+            if len(g2_raws) < 2:  # g2_raw() only needs [1]_2 and [tau]_2
+                g2_raws.append(blob[off:off + 192])
             off += 192
         # points arrive already bit-reversal-permuted — __init__ expects
         # exactly that order (it never re-permutes), so construct normally
         # and attach the raw-affine caches
         settings = cls(g1_points, g2_points)
         settings._g1_raw = g1_raw
-        settings._g2_raw = g2_raws[:2]
+        settings._g2_raw = g2_raws
         return settings
 
     @classmethod
@@ -301,7 +302,6 @@ class KzgSettings:
         fallback when the binary is missing or does not match its pin."""
         global _CEREMONY
         if _CEREMONY is None:
-            import hashlib
             import os
 
             data_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
