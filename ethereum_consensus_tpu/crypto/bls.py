@@ -502,16 +502,12 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
         if agg is not None:
             if any(is_inf for _, is_inf in agg):
                 return False  # an identity aggregate never verifies
-            sets = [
-                SignatureSet(
-                    [PublicKey._from_valid_bytes(
-                        native_bls.g1_compress_raw(raw)
-                    )],
-                    s.message,
-                    s.signature,
-                )
-                for (raw, _), s in zip(agg, sets)
-            ]
+            new_sets = []
+            for (raw, _), s in zip(agg, sets):
+                pk = PublicKey._from_valid_bytes(native_bls.g1_compress_raw(raw))
+                pk._raw = raw  # already affine — don't re-pay the sqrt
+                new_sets.append(SignatureSet([pk], s.message, s.signature))
+            sets = new_sets
     scalars = [(1).to_bytes(16, "big")]
     for _ in range(len(sets) - 1):
         while True:
@@ -519,8 +515,11 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
             if any(s):
                 break
         scalars.append(s)
-    return native_bls.batch_verify(
-        [([pk.to_bytes() for pk in s.public_keys], s.message,
+    # raw-affine pubkeys: decompressed once per key (cached on the
+    # PublicKey — subgroup-checked at parse time), so repeat verifiers
+    # (the same validators every block) never pay the sqrt again
+    return native_bls.batch_verify_raw(
+        [([pk.raw_uncompressed() for pk in s.public_keys], s.message,
           s.signature.to_bytes()) for s in sets],
         dst,
         scalars,

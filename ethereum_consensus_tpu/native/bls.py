@@ -109,6 +109,9 @@ def _declare(lib) -> None:
         "ec_bls_aggregate_sigs": ([p8, sz, p8], i32),
         "ec_bls_aggregate_pubkeys": ([p8, sz, p8], i32),
         "ec_bls_batch_verify": ([sz, _u32p, p8, p8, _u32p, p8, p8, sz, p8], i32),
+        "ec_bls_batch_verify_raw": ([sz, _u32p, p8, p8, _u32p, p8, p8, sz, p8], i32),
+        "ec_miller_loop_raw": ([p8, p8, p8], i32),
+        "ec_fp12_final_exp_is_one": ([p8], i32),
         "ec_g1_msm": ([p8, p8, sz, p8, c.POINTER(i32)], i32),
         "ec_g2_msm": ([p8, p8, sz, p8, c.POINTER(i32)], i32),
         "ec_g1_mul_raw": ([p8, i32, p8, p8, c.POINTER(i32)], i32),
@@ -304,6 +307,30 @@ def batch_verify(sets: list[tuple[list[bytes], bytes, bytes]], dst: bytes,
     return rc == 1
 
 
+def batch_verify_raw(sets: list[tuple[list[bytes], bytes, bytes]], dst: bytes,
+                     scalars16: list[bytes]) -> bool:
+    """Like ``batch_verify`` but each set's pubkeys are 96-byte RAW AFFINE
+    points (x||y big-endian) whose subgroup membership the caller already
+    established (PublicKey caches them after its parse-time check) —
+    skips the per-set decompression sqrt, and the blinded signature sum
+    runs as one Pippenger MSM native-side."""
+    n = len(sets)
+    if n == 0:
+        return True
+    counts = (_c.c_uint32 * n)(*[len(s[0]) for s in sets])
+    pks = b"".join(bytes(pk) for s in sets for pk in s[0])
+    msgs = b"".join(bytes(s[1]) for s in sets)
+    mlens = (_c.c_uint32 * n)(*[len(s[1]) for s in sets])
+    sigs = b"".join(bytes(s[2]) for s in sets)
+    rand = b"".join(scalars16)
+    if len(rand) != 16 * n:
+        raise NativeBlsError("need one 16-byte scalar per set")
+    rc = _lib().ec_bls_batch_verify_raw(
+        n, counts, pks, msgs, mlens, sigs, bytes(dst), len(dst), rand,
+    )
+    return rc == 1
+
+
 # -- raw-point utilities (KZG / device interop) -----------------------------
 
 
@@ -353,6 +380,23 @@ def pairing_product_is_one_raw(g1_raws: list[tuple[bytes, bool]],
     i1 = bytes(1 if inf else 0 for _, inf in g1_raws)
     i2 = bytes(1 if inf else 0 for _, inf in g2_raws)
     rc = _lib().ec_pairing_product_is_one_raw(g1b, i1, g2b, i2, n)
+    if rc < 0:
+        raise NativeBlsError(decode_error_message(rc))
+    return rc == 1
+
+
+def miller_loop_raw(g1_raw: bytes, g2_raw: bytes) -> bytes:
+    """Single-pair Miller value, 576-byte raw Fq12 (device parity anchor)."""
+    out = _c.create_string_buffer(576)
+    rc = _lib().ec_miller_loop_raw(bytes(g1_raw), bytes(g2_raw), out)
+    if rc != 0:
+        raise NativeBlsError(decode_error_message(rc))
+    return out.raw
+
+
+def fp12_final_exp_is_one(f576: bytes) -> bool:
+    """Final-exponentiation verdict on a raw Fq12 Miller product."""
+    rc = _lib().ec_fp12_final_exp_is_one(bytes(f576))
     if rc < 0:
         raise NativeBlsError(decode_error_message(rc))
     return rc == 1

@@ -98,41 +98,206 @@ static inline void fp_neg(Fp& out, const Fp& a) {
 
 static inline void fp_dbl(Fp& out, const Fp& a) { fp_add(out, a, a); }
 
-// Montgomery CIOS multiplication: out = a*b*2^-384 mod p
-static void fp_mul(Fp& out, const Fp& a, const Fp& b) {
-  u64 t[NL + 2];
-  for (int i = 0; i < NL + 2; i++) t[i] = 0;
-  for (int i = 0; i < NL; i++) {
-    u64 c = 0;
-    for (int j = 0; j < NL; j++) {
-      u128 cur = (u128)t[j] + (u128)a.l[j] * b.l[i] + c;
-      t[j] = (u64)cur;
-      c = (u64)(cur >> 64);
-    }
-    u128 cur = (u128)t[NL] + c;
-    t[NL] = (u64)cur;
-    t[NL + 1] = (u64)(cur >> 64);
+// Montgomery "no-carry" CIOS multiplication: out = a*b*2^-384 mod p.
+// Valid because p's top limb (0x1a01..., 61 bits) leaves enough slack that
+// the per-round high words never overflow a single u64 accumulator
+// (requires top limb < (2^64-1)/2; the same precondition gnark documents).
+// ~30% faster than the classic 8-word CIOS on this compiler.
+static inline void madd1(u64 a, u64 b, u64 c, u64& hi, u64& lo) {
+  u128 r = (u128)a * b + c; hi = (u64)(r >> 64); lo = (u64)r;
+}
+static inline void madd2(u64 a, u64 b, u64 c, u64 d, u64& hi, u64& lo) {
+  u128 r = (u128)a * b + c + d; hi = (u64)(r >> 64); lo = (u64)r;
+}
 
-    u64 m = t[0] * FP_INV;
-    cur = (u128)t[0] + (u128)m * P_RAW.l[0];
-    c = (u64)(cur >> 64);
-    for (int j = 1; j < NL; j++) {
-      cur = (u128)t[j] + (u128)m * P_RAW.l[j] + c;
-      t[j - 1] = (u64)cur;
-      c = (u64)(cur >> 64);
-    }
-    cur = (u128)t[NL] + c;
-    t[NL - 1] = (u64)cur;
-    t[NL] = t[NL + 1] + (u64)(cur >> 64);
+#if defined(__x86_64__) && defined(__ADX__) && defined(__BMI2__)
+#define EC_FP_MUL_ADX 1
+// ADX/BMI2 dual-carry-chain rounds: the a*b[i] row streams lo words into
+// t[j] on the ADCX (CF) chain and hi words into t[j+1] on the ADOX (OF)
+// chain, so the two carry chains run in parallel; the m*p reduction row
+// does the same with t0 annihilated. Same no-carry invariant as the C
+// path (t6 never produces a carry-out) — the chains are folded into t6
+// with the zero register. ~25% faster than what the compiler emits for
+// the u128 formulation.
+static void fp_mul(Fp& out, const Fp& a, const Fp& b) {
+  u64 t0 = 0, t1 = 0, t2 = 0, t3 = 0, t4 = 0, t5 = 0, t6 = 0;
+  const u64* ap = a.l;
+  const u64* pp = P_RAW.l;
+  for (int i = 0; i < NL; i++) {
+    u64 bi = b.l[i];
+    asm volatile(
+        "xor %%r15d, %%r15d\n\t"
+        "movq %[bi], %%rdx\n\t"
+        "mulxq 0(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t0]\n\t"
+        "adoxq %%rbx, %[t1]\n\t"
+        "mulxq 8(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t1]\n\t"
+        "adoxq %%rbx, %[t2]\n\t"
+        "mulxq 16(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t2]\n\t"
+        "adoxq %%rbx, %[t3]\n\t"
+        "mulxq 24(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t3]\n\t"
+        "adoxq %%rbx, %[t4]\n\t"
+        "mulxq 32(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t4]\n\t"
+        "adoxq %%rbx, %[t5]\n\t"
+        "mulxq 40(%[ap]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t5]\n\t"
+        "adoxq %%rbx, %[t6]\n\t"
+        "adcxq %%r15, %[t6]\n\t"
+        : [t0]"+r"(t0), [t1]"+r"(t1), [t2]"+r"(t2), [t3]"+r"(t3),
+          [t4]"+r"(t4), [t5]"+r"(t5), [t6]"+r"(t6)
+        : [ap]"r"(ap), [bi]"r"(bi), "m"(*(const u64(*)[6])ap)
+        : "rax", "rbx", "rdx", "r15", "cc");
+    u64 m = t0 * FP_INV;
+    asm volatile(
+        "xor %%r15d, %%r15d\n\t"
+        "movq %[m], %%rdx\n\t"
+        "mulxq 0(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t0]\n\t"
+        "adoxq %%rbx, %[t1]\n\t"
+        "mulxq 8(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t1]\n\t"
+        "adoxq %%rbx, %[t2]\n\t"
+        "mulxq 16(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t2]\n\t"
+        "adoxq %%rbx, %[t3]\n\t"
+        "mulxq 24(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t3]\n\t"
+        "adoxq %%rbx, %[t4]\n\t"
+        "mulxq 32(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t4]\n\t"
+        "adoxq %%rbx, %[t5]\n\t"
+        "mulxq 40(%[pp]), %%rax, %%rbx\n\t"
+        "adcxq %%rax, %[t5]\n\t"
+        "adoxq %%rbx, %[t6]\n\t"
+        "adcxq %%r15, %[t6]\n\t"
+        : [t0]"+r"(t0), [t1]"+r"(t1), [t2]"+r"(t2), [t3]"+r"(t3),
+          [t4]"+r"(t4), [t5]"+r"(t5), [t6]"+r"(t6)
+        : [pp]"r"(pp), [m]"r"(m), "m"(*(const u64(*)[6])pp)
+        : "rax", "rbx", "rdx", "r15", "cc");
+    t0 = t1; t1 = t2; t2 = t3; t3 = t4; t4 = t5; t5 = t6; t6 = 0;
   }
-  for (int i = 0; i < NL; i++) out.l[i] = t[i];
-  if (t[NL] || fp_cmp_raw(out.l, P_RAW.l) >= 0) {
+  out.l[0] = t0; out.l[1] = t1; out.l[2] = t2;
+  out.l[3] = t3; out.l[4] = t4; out.l[5] = t5;
+  if (fp_cmp_raw(out.l, P_RAW.l) >= 0) {
     u64 borrow = 0;
     for (int i = 0; i < NL; i++) out.l[i] = sbb(out.l[i], P_RAW.l[i], borrow);
   }
 }
+#else
+static void fp_mul(Fp& out, const Fp& a, const Fp& b) {
+  u64 t0, t1, t2, t3, t4, t5;
+  u64 A, C, m;
+  {
+    u128 r = (u128)a.l[0] * b.l[0]; t0 = (u64)r; A = (u64)(r >> 64);
+    m = t0 * FP_INV;
+    r = (u128)m * P_RAW.l[0] + t0; C = (u64)(r >> 64);
+    madd1(a.l[1], b.l[0], A, A, t1); madd2(m, P_RAW.l[1], C, t1, C, t0);
+    madd1(a.l[2], b.l[0], A, A, t2); madd2(m, P_RAW.l[2], C, t2, C, t1);
+    madd1(a.l[3], b.l[0], A, A, t3); madd2(m, P_RAW.l[3], C, t3, C, t2);
+    madd1(a.l[4], b.l[0], A, A, t4); madd2(m, P_RAW.l[4], C, t4, C, t3);
+    madd1(a.l[5], b.l[0], A, A, t5); madd2(m, P_RAW.l[5], C, t5, C, t4);
+    t5 = C + A;
+  }
+  for (int i = 1; i < NL; i++) {
+    u64 bi = b.l[i];
+    madd1(a.l[0], bi, t0, A, t0);
+    m = t0 * FP_INV;
+    { u128 r = (u128)m * P_RAW.l[0] + t0; C = (u64)(r >> 64); }
+    madd2(a.l[1], bi, A, t1, A, t1); madd2(m, P_RAW.l[1], C, t1, C, t0);
+    madd2(a.l[2], bi, A, t2, A, t2); madd2(m, P_RAW.l[2], C, t2, C, t1);
+    madd2(a.l[3], bi, A, t3, A, t3); madd2(m, P_RAW.l[3], C, t3, C, t2);
+    madd2(a.l[4], bi, A, t4, A, t4); madd2(m, P_RAW.l[4], C, t4, C, t3);
+    madd2(a.l[5], bi, A, t5, A, t5); madd2(m, P_RAW.l[5], C, t5, C, t4);
+    t5 = C + A;
+  }
+  out.l[0] = t0; out.l[1] = t1; out.l[2] = t2;
+  out.l[3] = t3; out.l[4] = t4; out.l[5] = t5;
+  if (fp_cmp_raw(out.l, P_RAW.l) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < NL; i++) out.l[i] = sbb(out.l[i], P_RAW.l[i], borrow);
+  }
+}
+#endif  // EC_FP_MUL_ADX
 
+#ifdef EC_FP_MUL_ADX
+// With the ADX multiplier, mul(a, a) beats the dedicated C squaring
+// (measured 36ns vs 72ns: the 12-limb stack buffer costs more than the
+// saved cross products).
 static inline void fp_sqr(Fp& out, const Fp& a) { fp_mul(out, a, a); }
+#else
+// Dedicated Montgomery squaring: full 12-limb square (cross products
+// doubled by a 1-bit shift, diagonal added) + 6-round reduction.
+// ~30% faster again than fp_mul(a, a).
+static void fp_sqr(Fp& out, const Fp& a) {
+  u64 t[12];
+  u64 c;
+  {
+    u128 r;
+    r = (u128)a.l[0] * a.l[1];            t[1] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[0] * a.l[2] + c;        t[2] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[0] * a.l[3] + c;        t[3] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[0] * a.l[4] + c;        t[4] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[0] * a.l[5] + c;        t[5] = (u64)r; t[6] = (u64)(r >> 64);
+  }
+  {
+    u128 r;
+    r = (u128)a.l[1] * a.l[2] + t[3];     t[3] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[1] * a.l[3] + t[4] + c; t[4] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[1] * a.l[4] + t[5] + c; t[5] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[1] * a.l[5] + t[6] + c; t[6] = (u64)r; t[7] = (u64)(r >> 64);
+  }
+  {
+    u128 r;
+    r = (u128)a.l[2] * a.l[3] + t[5];     t[5] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[2] * a.l[4] + t[6] + c; t[6] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[2] * a.l[5] + t[7] + c; t[7] = (u64)r; t[8] = (u64)(r >> 64);
+  }
+  {
+    u128 r;
+    r = (u128)a.l[3] * a.l[4] + t[7];     t[7] = (u64)r; c = (u64)(r >> 64);
+    r = (u128)a.l[3] * a.l[5] + t[8] + c; t[8] = (u64)r; t[9] = (u64)(r >> 64);
+  }
+  {
+    u128 r;
+    r = (u128)a.l[4] * a.l[5] + t[9];     t[9] = (u64)r; t[10] = (u64)(r >> 64);
+  }
+  t[11] = t[10] >> 63;
+  for (int i = 10; i > 1; i--) t[i] = (t[i] << 1) | (t[i - 1] >> 63);
+  t[1] <<= 1;
+  u64 carry = 0;
+  t[0] = 0;
+  for (int i = 0; i < NL; i++) {
+    u128 sq = (u128)a.l[i] * a.l[i];
+    u128 lo = (u128)t[2 * i] + (u64)sq + carry;
+    t[2 * i] = (u64)lo;
+    u128 hi = (u128)t[2 * i + 1] + (u64)(sq >> 64) + (u64)(lo >> 64);
+    t[2 * i + 1] = (u64)hi;
+    carry = (u64)(hi >> 64);
+  }
+  u64 carry2 = 0;
+  for (int i = 0; i < NL; i++) {
+    u64 m = t[i] * FP_INV;
+    u64 cc = 0;
+    for (int j = 0; j < NL; j++) {
+      u128 cur = (u128)t[i + j] + (u128)m * P_RAW.l[j] + cc;
+      t[i + j] = (u64)cur;
+      cc = (u64)(cur >> 64);
+    }
+    u128 cur = (u128)t[i + 6] + cc + carry2;
+    t[i + 6] = (u64)cur;
+    carry2 = (u64)(cur >> 64);
+  }
+  for (int i = 0; i < NL; i++) out.l[i] = t[i + 6];
+  if (carry2 || fp_cmp_raw(out.l, P_RAW.l) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < NL; i++) out.l[i] = sbb(out.l[i], P_RAW.l[i], borrow);
+  }
+}
+#endif  // !EC_FP_MUL_ADX
 
 static void fp_to_mont(Fp& out, const Fp& std_form) { fp_mul(out, std_form, FP_R2); }
 static void fp_from_mont(Fp& out, const Fp& mont) {
@@ -140,23 +305,103 @@ static void fp_from_mont(Fp& out, const Fp& mont) {
   fp_mul(out, mont, one_std);
 }
 
-// exponent is a little-endian limb array; square-and-multiply MSB-first
+// exponent is a little-endian limb array; 4-bit fixed window (windows are
+// 4-aligned so they never straddle a limb). Halves the multiply count of
+// plain square-and-multiply on the 381-bit sqrt/legendre exponents.
 static void fp_pow(Fp& out, const Fp& base, const u64* exp, int exp_limbs) {
+  int bits = exp_limbs * 64;
+  while (bits > 0 && !((exp[(bits - 1) >> 6] >> ((bits - 1) & 63)) & 1)) bits--;
+  if (bits == 0) { out = FP_ONE; return; }
+  Fp tbl[15];  // base^1 .. base^15
+  tbl[0] = base;
+  for (int i = 1; i < 15; i++) fp_mul(tbl[i], tbl[i - 1], base);
   Fp result = FP_ONE;
   bool started = false;
-  for (int i = exp_limbs - 1; i >= 0; i--) {
-    for (int b = 63; b >= 0; b--) {
-      if (started) fp_sqr(result, result);
-      if ((exp[i] >> b) & 1) {
-        if (started) fp_mul(result, result, base);
-        else { result = base; started = true; }
-      }
+  for (int w = ((bits - 1) / 4) * 4; w >= 0; w -= 4) {
+    if (started) {
+      fp_sqr(result, result); fp_sqr(result, result);
+      fp_sqr(result, result); fp_sqr(result, result);
+    }
+    int d = (int)((exp[w >> 6] >> (w & 63)) & 15);
+    if (d) {
+      if (started) fp_mul(result, result, tbl[d - 1]);
+      else { result = tbl[d - 1]; started = true; }
     }
   }
-  out = started ? result : FP_ONE;
+  out = result;
 }
 
-static void fp_inv(Fp& out, const Fp& a) { fp_pow(out, a, EXP_P_MINUS_2, 6); }
+// Binary extended Euclid on standard-form limbs — ~10x faster than the
+// Fermat p-2 power ladder. Variable-time is fine here: inversion inputs
+// are public curve data (coordinates, pairing values), never secret keys.
+static inline bool limbs6_is_zero(const u64* a) {
+  return !(a[0] | a[1] | a[2] | a[3] | a[4] | a[5]);
+}
+static inline bool limbs6_is_one(const u64* a) {
+  return a[0] == 1 && !(a[1] | a[2] | a[3] | a[4] | a[5]);
+}
+static inline void limbs6_shr1(u64* a) {
+  for (int i = 0; i < 5; i++) a[i] = (a[i] >> 1) | (a[i + 1] << 63);
+  a[5] >>= 1;
+}
+static inline void limbs6_add_p_shr1(u64* a) {
+  // (a + p) / 2 where a + p may carry into a 7th word
+  u64 carry = 0;
+  u64 t[6];
+  for (int i = 0; i < 6; i++) {
+    u128 cur = (u128)a[i] + P_RAW.l[i] + carry;
+    t[i] = (u64)cur;
+    carry = (u64)(cur >> 64);
+  }
+  for (int i = 0; i < 5; i++) a[i] = (t[i] >> 1) | (t[i + 1] << 63);
+  a[5] = (t[5] >> 1) | (carry << 63);
+}
+static inline void limbs6_sub(u64* a, const u64* b) {
+  u64 borrow = 0;
+  for (int i = 0; i < 6; i++) a[i] = sbb(a[i], b[i], borrow);
+}
+static inline void limbs6_sub_mod_p(u64* a, const u64* b) {
+  // a = (a - b) mod p for a, b < p
+  u64 borrow = 0;
+  for (int i = 0; i < 6; i++) a[i] = sbb(a[i], b[i], borrow);
+  if (borrow) {
+    u64 carry = 0;
+    for (int i = 0; i < 6; i++) {
+      u128 cur = (u128)a[i] + P_RAW.l[i] + carry;
+      a[i] = (u64)cur;
+      carry = (u64)(cur >> 64);
+    }
+  }
+}
+
+static void fp_inv(Fp& out, const Fp& a) {
+  if (fp_is_zero(a)) { out = FP_ZERO; return; }  // matches 0^(p-2) == 0
+  Fp a_std;
+  fp_from_mont(a_std, a);
+  u64 u[6], v[6], x1[6] = {1, 0, 0, 0, 0, 0}, x2[6] = {0, 0, 0, 0, 0, 0};
+  for (int i = 0; i < 6; i++) { u[i] = a_std.l[i]; v[i] = P_RAW.l[i]; }
+  while (!limbs6_is_one(u) && !limbs6_is_one(v)) {
+    while (!(u[0] & 1)) {
+      limbs6_shr1(u);
+      if (x1[0] & 1) limbs6_add_p_shr1(x1); else limbs6_shr1(x1);
+    }
+    while (!(v[0] & 1)) {
+      limbs6_shr1(v);
+      if (x2[0] & 1) limbs6_add_p_shr1(x2); else limbs6_shr1(x2);
+    }
+    if (fp_cmp_raw(u, v) >= 0) {
+      limbs6_sub(u, v);
+      limbs6_sub_mod_p(x1, x2);
+    } else {
+      limbs6_sub(v, u);
+      limbs6_sub_mod_p(x2, x1);
+    }
+  }
+  Fp inv_std;
+  const u64* r = limbs6_is_one(u) ? x1 : x2;
+  for (int i = 0; i < 6; i++) inv_std.l[i] = r[i];
+  fp_to_mont(out, inv_std);
+}
 
 // returns false if not a square
 static bool fp_sqrt(Fp& out, const Fp& a) {
@@ -275,19 +520,28 @@ static void fp2_inv(Fp2& o, const Fp2& a) {
   fp_neg(o.c1, t);
 }
 
+// 4-bit fixed window, same shape as fp_pow
 static void fp2_pow(Fp2& out, const Fp2& base, const u64* exp, int exp_limbs) {
+  int bits = exp_limbs * 64;
+  while (bits > 0 && !((exp[(bits - 1) >> 6] >> ((bits - 1) & 63)) & 1)) bits--;
+  if (bits == 0) { out = FP2_ONE; return; }
+  Fp2 tbl[15];
+  tbl[0] = base;
+  for (int i = 1; i < 15; i++) fp2_mul(tbl[i], tbl[i - 1], base);
   Fp2 result = FP2_ONE;
   bool started = false;
-  for (int i = exp_limbs - 1; i >= 0; i--) {
-    for (int b = 63; b >= 0; b--) {
-      if (started) fp2_sqr(result, result);
-      if ((exp[i] >> b) & 1) {
-        if (started) fp2_mul(result, result, base);
-        else { result = base; started = true; }
-      }
+  for (int w = ((bits - 1) / 4) * 4; w >= 0; w -= 4) {
+    if (started) {
+      fp2_sqr(result, result); fp2_sqr(result, result);
+      fp2_sqr(result, result); fp2_sqr(result, result);
+    }
+    int d = (int)((exp[w >> 6] >> (w & 63)) & 15);
+    if (d) {
+      if (started) fp2_mul(result, result, tbl[d - 1]);
+      else { result = tbl[d - 1]; started = true; }
     }
   }
-  out = started ? result : FP2_ONE;
+  out = result;
 }
 
 static int fp2_sgn0(const Fp2& a) {
@@ -654,21 +908,84 @@ static void pt_neg(Point<Ops>& o, const Point<Ops>& p) {
   o.z = p.z;
 }
 
-// scalar given as little-endian u64 limbs; MSB-first double-and-add
+// scalar given as little-endian u64 limbs; width-4 NAF (digits in
+// {0, ±1, ±3, ±5, ±7}), ~1/5 addition density vs 1/2 for double-and-add.
+// Variable-time like the ladder it replaces (this backend verifies public
+// data; the reference's blst wrapper is the hardened path for signing).
 template <class Ops>
 static void pt_mul(Point<Ops>& o, const Point<Ops>& p, const u64* scalar, int limbs) {
-  Point<Ops> result = pt_infinity<Ops>();
-  bool started = false;
-  for (int i = limbs - 1; i >= 0; i--) {
-    for (int b = 63; b >= 0; b--) {
-      if (started) pt_double(result, result);
-      if ((scalar[i] >> b) & 1) {
-        if (started) pt_add(result, result, p);
-        else { result = p; started = true; }
+  if (p.is_inf() || limbs > 16) {  // limbs cap: largest caller is H_EFF (10)
+    o = pt_infinity<Ops>();
+    if (limbs <= 16) return;
+    // oversized scalar: fall back to the plain ladder (unreachable today)
+    Point<Ops> result = pt_infinity<Ops>();
+    bool started = false;
+    for (int i = limbs - 1; i >= 0; i--)
+      for (int b = 63; b >= 0; b--) {
+        if (started) pt_double(result, result);
+        if ((scalar[i] >> b) & 1) {
+          if (started) pt_add(result, result, p);
+          else { result = p; started = true; }
+        }
+      }
+    o = result;
+    return;
+  }
+  u64 n[17];
+  int L = limbs;
+  for (int i = 0; i < L; i++) n[i] = scalar[i];
+  n[L++] = 0;  // headroom for the +|d| carry in negative-digit recoding
+  signed char digits[1089];
+  int nd = 0;
+  for (;;) {
+    bool z = true;
+    for (int i = 0; i < L; i++) if (n[i]) { z = false; break; }
+    if (z) break;
+    int d = 0;
+    if (n[0] & 1) {
+      d = (int)(n[0] & 15);
+      if (d > 8) d -= 16;
+      if (d > 0) {
+        u64 borrow = (u64)d;
+        for (int i = 0; i < L && borrow; i++) {
+          u64 nv = n[i] - borrow;
+          borrow = nv > n[i];
+          n[i] = nv;
+        }
+      } else {
+        u64 carry = (u64)(-d);
+        for (int i = 0; i < L && carry; i++) {
+          u64 nv = n[i] + carry;
+          carry = nv < n[i];
+          n[i] = nv;
+        }
       }
     }
+    digits[nd++] = (signed char)d;
+    for (int i = 0; i < L - 1; i++) n[i] = (n[i] >> 1) | (n[i + 1] << 63);
+    n[L - 1] >>= 1;
   }
-  o = started ? result : pt_infinity<Ops>();
+  if (nd == 0) { o = pt_infinity<Ops>(); return; }
+  Point<Ops> tbl[4];  // P, 3P, 5P, 7P
+  tbl[0] = p;
+  Point<Ops> p2;
+  pt_double(p2, p);
+  pt_add(tbl[1], tbl[0], p2);
+  pt_add(tbl[2], tbl[1], p2);
+  pt_add(tbl[3], tbl[2], p2);
+  Point<Ops> result = pt_infinity<Ops>();
+  for (int i = nd - 1; i >= 0; i--) {
+    pt_double(result, result);
+    int d = digits[i];
+    if (d > 0) {
+      pt_add(result, result, tbl[(d - 1) >> 1]);
+    } else if (d < 0) {
+      Point<Ops> m;
+      pt_neg(m, tbl[((-d) - 1) >> 1]);
+      pt_add(result, result, m);
+    }
+  }
+  o = result;
 }
 
 template <class Ops>
@@ -871,62 +1188,106 @@ static void fp12_mul_by_line(Fp12& f, const Fp2& c00, const Fp2& c11, const Fp2&
   fp6_sub(f.c1, t2, t1);
 }
 
-// tangent line at pr.t evaluated at (xp, yp); multiplies into f
+// tangent line at pr.t evaluated at (xp, yp), multiplied into f, FUSED
+// with the doubling T <- 2T (dbl-2009-l) so X², Y², Z², 3X² are computed
+// once for both the line and the new point.
 static void miller_double_step(Fp12& f, MillerPair& pr) {
   const Fp2 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
-  Fp2 y2, z2, z3, yz3_2, x2, x3, c00, c11, c12, t;
-  fp2_sqr(y2, Y);
-  fp2_sqr(z2, Z);
-  fp2_mul(z3, z2, Z);
-  fp2_mul(yz3_2, Y, z3);
-  fp2_dbl(yz3_2, yz3_2);             // 2YZ^3
-  fp2_sqr(x2, X);
-  fp2_mul(x3, x2, X);
+  Fp2 A, B, C, Z2, Z3c, L, X3c, E, c00, c11, c12, t, u;
+  fp2_sqr(A, X);                     // X^2
+  fp2_sqr(B, Y);                     // Y^2
+  fp2_sqr(C, B);                     // Y^4
+  fp2_sqr(Z2, Z);
+  fp2_mul(Z3c, Z2, Z);               // Z^3
   // c00 = -xi * (2YZ^3 * yp)
-  fp2_scalar_mul(t, yz3_2, pr.yp);
+  fp2_mul(L, Y, Z3c);
+  fp2_dbl(L, L);
+  fp2_scalar_mul(t, L, pr.yp);
   fp2_mul_by_xi(t, t);
   fp2_neg(c00, t);
   // c11 = 2Y^2 - 3X^3
-  Fp2 x3_3;
-  fp2_dbl(c11, y2);
-  fp2_add(x3_3, x3, x3);
-  fp2_add(x3_3, x3_3, x3);
-  fp2_sub(c11, c11, x3_3);
-  // c12 = 3 X^2 Z^2 * xp
-  Fp2 x2_3;
-  fp2_add(x2_3, x2, x2);
-  fp2_add(x2_3, x2_3, x2);
-  fp2_mul(t, x2_3, z2);
+  fp2_mul(X3c, A, X);
+  fp2_dbl(c11, B);
+  fp2_add(u, X3c, X3c);
+  fp2_add(u, u, X3c);
+  fp2_sub(c11, c11, u);
+  // c12 = 3X^2 Z^2 * xp   (E = 3X^2 is also the doubling's slope term)
+  fp2_add(E, A, A);
+  fp2_add(E, E, A);
+  fp2_mul(t, E, Z2);
   fp2_scalar_mul(c12, t, pr.xp);
   fp12_mul_by_line(f, c00, c11, c12);
-  pt_double(pr.t, pr.t);
+  // T <- 2T reusing A, B, C, E (dbl-2009-l)
+  Fp2 D, F, x3, y3, z3, c8;
+  fp2_add(t, X, B);
+  fp2_sqr(t, t);
+  fp2_sub(t, t, A);
+  fp2_sub(D, t, C);
+  fp2_dbl(D, D);                     // 2((X+Y^2)^2 - X^2 - Y^4)
+  fp2_sqr(F, E);
+  fp2_sub(x3, F, D);
+  fp2_sub(x3, x3, D);
+  fp2_dbl(c8, C);
+  fp2_dbl(c8, c8);
+  fp2_dbl(c8, c8);                   // 8Y^4
+  fp2_sub(t, D, x3);
+  fp2_mul(y3, E, t);
+  fp2_sub(y3, y3, c8);
+  fp2_mul(z3, Y, Z);
+  fp2_dbl(z3, z3);
+  pr.t.x = x3; pr.t.y = y3; pr.t.z = z3;
 }
 
-// line through pr.t and (xq, yq) evaluated at (xp, yp); multiplies into f
+// line through pr.t and affine (xq, yq) evaluated at (xp, yp), multiplied
+// into f, FUSED with the mixed addition T <- T + Q (madd-2007-bl; Q has
+// z = 1). T == ±Q never occurs inside the Miller loop: T = [k]Q with
+// 1 < k < |x| << r, so the doubling/infinity arms of the generic add are
+// unreachable and omitted.
 static void miller_add_step(Fp12& f, MillerPair& pr) {
   const Fp2 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
-  Fp2 z2, z3, lam_n, lam_d, t, c00, c11, c12;
-  fp2_sqr(z2, Z);
-  fp2_mul(z3, z2, Z);
-  fp2_mul(t, pr.yq, z3);
-  fp2_sub(lam_n, Y, t);              // Y - yq Z^3
-  fp2_mul(t, pr.xq, z2);
-  fp2_sub(lam_d, X, t);
-  fp2_mul(lam_d, lam_d, Z);          // (X - xq Z^2) Z
+  Fp2 Z2, Z3c, U2, S2, lam_n, lam_d, t, u, c00, c11, c12;
+  fp2_sqr(Z2, Z);
+  fp2_mul(Z3c, Z2, Z);
+  fp2_mul(U2, pr.xq, Z2);            // xq Z^2
+  fp2_mul(S2, pr.yq, Z3c);           // yq Z^3
+  fp2_sub(lam_n, Y, S2);             // Y - yq Z^3
+  fp2_sub(t, X, U2);
+  fp2_mul(lam_d, t, Z);              // (X - xq Z^2) Z
   // c00 = -xi * (lam_d * yp)
-  fp2_scalar_mul(t, lam_d, pr.yp);
-  fp2_mul_by_xi(t, t);
-  fp2_neg(c00, t);
+  fp2_scalar_mul(u, lam_d, pr.yp);
+  fp2_mul_by_xi(u, u);
+  fp2_neg(c00, u);
   // c11 = yq*lam_d - lam_n*xq
-  Fp2 u;
   fp2_mul(t, pr.yq, lam_d);
   fp2_mul(u, lam_n, pr.xq);
   fp2_sub(c11, t, u);
   // c12 = lam_n * xp
   fp2_scalar_mul(c12, lam_n, pr.xp);
   fp12_mul_by_line(f, c00, c11, c12);
-  G2 q = pt_from_affine<Fp2Ops>(pr.xq, pr.yq);
-  pt_add(pr.t, pr.t, q);
+  // T <- T + Q, mixed addition reusing Z2, Z3c, U2, S2
+  Fp2 H, HH, I, J, rr, V, x3, y3, z3;
+  fp2_sub(H, U2, X);
+  fp2_sqr(HH, H);
+  fp2_dbl(I, HH);
+  fp2_dbl(I, I);                     // 4 H^2
+  fp2_mul(J, H, I);
+  fp2_sub(rr, S2, Y);
+  fp2_dbl(rr, rr);                   // 2(S2 - Y) = -2 lam_n
+  fp2_mul(V, X, I);
+  fp2_sqr(x3, rr);
+  fp2_sub(x3, x3, J);
+  fp2_sub(x3, x3, V);
+  fp2_sub(x3, x3, V);
+  fp2_sub(t, V, x3);
+  fp2_mul(y3, rr, t);
+  fp2_mul(u, Y, J);
+  fp2_dbl(u, u);
+  fp2_sub(y3, y3, u);
+  fp2_add(z3, Z, H);
+  fp2_sqr(z3, z3);
+  fp2_sub(z3, z3, Z2);
+  fp2_sub(z3, z3, HH);
+  pr.t.x = x3; pr.t.y = y3; pr.t.z = z3;
 }
 
 // product of Miller loops, one shared squaring chain; pairs must be finite
@@ -948,12 +1309,58 @@ static void multi_miller_loop(Fp12& f, MillerPair* pairs, size_t n) {
   fp12_conj(f, f);
 }
 
+// Granger–Scott cyclotomic squaring: for elements of the cyclotomic
+// subgroup (everything after the easy final-exp part), squaring costs
+// three Fp4 squarings (9 fp2_sqr) instead of a generic fp12_sqr's 12
+// fp2_mul — ~3x cheaper, and it dominates the exponentiation chains of
+// the hard part. Validated once at init against fp12_sqr on a cyclotomic
+// element (CYCLO_STATE); a mismatch demotes to the generic squaring.
+static int CYCLO_STATE = -1;
+
+// (a + b·s with s² = ξ): returns (a² + ξ·b², (a+b)² − a² − b²)
+static void fp4_sqr(Fp2& out0, Fp2& out1, const Fp2& a, const Fp2& b) {
+  Fp2 t0, t1, t2;
+  fp2_sqr(t0, a);
+  fp2_sqr(t1, b);
+  fp2_mul_by_xi(out0, t1);
+  fp2_add(out0, out0, t0);
+  fp2_add(t2, a, b);
+  fp2_sqr(t2, t2);
+  fp2_sub(t2, t2, t0);
+  fp2_sub(out1, t2, t1);
+}
+
+static void fp12_cyclo_sqr(Fp12& o, const Fp12& a) {
+  // w-power basis components (see fp12_frob comment for the layout)
+  Fp2 z0 = a.c0.a0, z4 = a.c0.a1, z3 = a.c0.a2;
+  Fp2 z2 = a.c1.a0, z1 = a.c1.a1, z5 = a.c1.a2;
+  Fp2 t0, t1, t2, t3, u;
+  fp4_sqr(t0, t1, z0, z1);
+  fp2_sub(u, t0, z0); fp2_dbl(u, u); fp2_add(z0, u, t0);   // 3t0 − 2z0
+  fp2_add(u, t1, z1); fp2_dbl(u, u); fp2_add(z1, u, t1);   // 3t1 + 2z1
+  fp4_sqr(t0, t1, z2, z3);
+  fp4_sqr(t2, t3, z4, z5);
+  fp2_sub(u, t0, z4); fp2_dbl(u, u); fp2_add(z4, u, t0);
+  fp2_add(u, t1, z5); fp2_dbl(u, u); fp2_add(z5, u, t1);
+  Fp2 xt3;
+  fp2_mul_by_xi(xt3, t3);
+  fp2_add(u, xt3, z2); fp2_dbl(u, u); fp2_add(z2, u, xt3);
+  fp2_sub(u, t2, z3); fp2_dbl(u, u); fp2_add(z3, u, t2);
+  o.c0.a0 = z0; o.c0.a1 = z4; o.c0.a2 = z3;
+  o.c1.a0 = z2; o.c1.a1 = z1; o.c1.a2 = z5;
+}
+
+static inline void fp12_sqr_cyclotomic_input(Fp12& o, const Fp12& a) {
+  if (CYCLO_STATE == 1) fp12_cyclo_sqr(o, a);
+  else fp12_sqr(o, a);
+}
+
 // f^|x| then conjugate (x negative); input must be in cyclotomic subgroup
 static void fp12_pow_neg_x(Fp12& o, const Fp12& a) {
   Fp12 result;
   bool started = false;
   for (int b = 63; b >= 0; b--) {
-    if (started) fp12_sqr(result, result);
+    if (started) fp12_sqr_cyclotomic_input(result, result);
     if ((BLS_X_ABS >> b) & 1) {
       if (started) fp12_mul(result, result, a);
       else { result = a; started = true; }
@@ -1583,6 +1990,25 @@ static void validate_endomorphism_fast_paths() {
   } else {
     G2_SUB_STATE = -1;
   }
+
+  // --- cyclotomic squaring: build a cyclotomic-subgroup element the same
+  // way the final exponentiation does (easy part of a Miller value),
+  // then require fp12_cyclo_sqr == fp12_sqr on it ---
+  {
+    MillerPair mp;
+    pt_to_affine<FpOps>(mp.xp, mp.yp, G1_GEN);
+    pt_to_affine<Fp2Ops>(mp.xq, mp.yq, G2_GEN);
+    Fp12 f, inv, c, f1, f2, t, a, b;
+    multi_miller_loop(f, &mp, 1);
+    fp12_inv(inv, f);
+    fp12_conj(c, f);
+    fp12_mul(f1, c, inv);           // f^(p^6 - 1)
+    fp12_frob_n(t, f1, 2);
+    fp12_mul(f2, t, f1);            // ^(p^2 + 1): cyclotomic
+    fp12_sqr(a, f2);
+    fp12_cyclo_sqr(b, f2);
+    CYCLO_STATE = fp12_eq(a, b) ? 1 : -1;
+  }
 }
 
 static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
@@ -1947,6 +2373,75 @@ int ec_bls_batch_verify(size_t n_sets, const u32* pk_counts, const u8* pks,
   return ok ? 1 : 0;
 }
 
+// Batch verify with PRE-DECOMPRESSED pubkeys (96-byte raw affine, already
+// validated at parse time by the caller — on-curve is re-checked, the
+// subgroup check was paid once when the key was first seen). Compared to
+// ec_bls_batch_verify this removes the per-set per-key sqrt, and the
+// blinded signature aggregation sum(r_i * sig_i) runs as one Pippenger
+// MSM instead of n separate scalar mults.
+int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
+                            const u8* pks_raw, const u8* msgs,
+                            const u32* msg_lens, const u8* sigs,
+                            const u8* dst, size_t dst_len,
+                            const u8* scalars16) {
+  ensure_init();
+  if (n_sets == 0) return 1;
+  G1* ps = new G1[n_sets + 1];
+  G2* qs = new G2[n_sets + 1];
+  G2* sig_pts = new G2[n_sets];
+  u64* sig_scalars = new u64[4 * n_sets];
+  size_t pk_off = 0, msg_off = 0;
+  bool ok = true;
+  for (size_t i = 0; i < n_sets && ok; i++) {
+    u32 cnt = pk_counts[i];
+    if (cnt == 0) { ok = false; break; }
+    G1 agg = pt_infinity<FpOps>();
+    for (u32 j = 0; j < cnt; j++) {
+      G1 pk;
+      if (!g1_from_raw(pk, pks_raw + 96 * (pk_off + j), 0) || pk.is_inf()) {
+        ok = false;
+        break;
+      }
+      pt_add(agg, agg, pk);
+    }
+    pk_off += cnt;
+    if (!ok) break;
+    G2 sig;
+    if (g2_decompress(sig, sigs + 96 * i, true) != DEC_OK || sig.is_inf() ||
+        agg.is_inf()) {
+      ok = false;
+      break;
+    }
+    u64 r[4] = {0, 0, 0, 0};
+    for (int b = 0; b < 8; b++) r[1] = (r[1] << 8) | scalars16[16 * i + b];
+    for (int b = 8; b < 16; b++) r[0] = (r[0] << 8) | scalars16[16 * i + b];
+    if ((r[0] | r[1]) == 0) { ok = false; break; }
+    G1 rp;
+    pt_mul(rp, agg, r, 2);
+    ps[i] = rp;
+    sig_pts[i] = sig;
+    sig_scalars[4 * i] = r[0]; sig_scalars[4 * i + 1] = r[1];
+    sig_scalars[4 * i + 2] = 0; sig_scalars[4 * i + 3] = 0;
+    if (!hash_to_g2_point(qs[i], msgs + msg_off, msg_lens[i], dst, dst_len)) {
+      ok = false;
+      break;
+    }
+    msg_off += msg_lens[i];
+  }
+  if (ok) {
+    G2 sig_acc;
+    pt_msm(sig_acc, sig_pts, sig_scalars, n_sets, 128);
+    pt_neg(ps[n_sets], G1_GEN);
+    qs[n_sets] = sig_acc;
+    ok = pairing_product_is_one(ps, qs, n_sets + 1);
+  }
+  delete[] ps;
+  delete[] qs;
+  delete[] sig_pts;
+  delete[] sig_scalars;
+  return ok ? 1 : 0;
+}
+
 int ec_g1_msm(const u8* points_raw, const u8* scalars32, size_t n, u8* out_raw,
               int* out_inf) {
   ensure_init();
@@ -2046,6 +2541,58 @@ int ec_pairing_product_is_one_raw(const u8* g1_raw, const u8* g1_inf,
   delete[] ps;
   delete[] qs;
   return ok ? 1 : 0;
+}
+
+// --- Fq12 handoff for the device batched pairing (ops/pairing.py) ---------
+// Raw layout: 12 coefficients, 48-byte big-endian standard form each, in
+// (c0.a0.c0, c0.a0.c1, c0.a1.c0, c0.a1.c1, c0.a2.c0, c0.a2.c1,
+//  c1.a0.c0, ..., c1.a2.c1) order — matching ops/fq12.fp12_to_ints.
+
+static void fp12_to_raw576(u8* out, const Fp12& f) {
+  const Fp2* comps[6] = {&f.c0.a0, &f.c0.a1, &f.c0.a2,
+                         &f.c1.a0, &f.c1.a1, &f.c1.a2};
+  for (int i = 0; i < 6; i++) {
+    fp_to_bytes(out + 96 * i, comps[i]->c0);
+    fp_to_bytes(out + 96 * i + 48, comps[i]->c1);
+  }
+}
+
+static bool fp12_from_raw576(Fp12& f, const u8* in) {
+  Fp2* comps[6] = {&f.c0.a0, &f.c0.a1, &f.c0.a2,
+                   &f.c1.a0, &f.c1.a1, &f.c1.a2};
+  for (int i = 0; i < 6; i++) {
+    if (!fp_from_bytes(comps[i]->c0, in + 96 * i) ||
+        !fp_from_bytes(comps[i]->c1, in + 96 * i + 48))
+      return false;
+  }
+  return true;
+}
+
+// single-pair Miller loop, raw in/out — the device kernel's parity anchor
+int ec_miller_loop_raw(const u8* g1_raw, const u8* g2_raw, u8* out576) {
+  ensure_init();
+  G1 p;
+  G2 q;
+  if (!g1_from_raw(p, g1_raw, 0) || !g2_from_raw(q, g2_raw, 0)) return -5;
+  if (p.is_inf() || q.is_inf()) { fp12_to_raw576(out576, FP12_ONE); return 0; }
+  MillerPair mp;
+  pt_to_affine<FpOps>(mp.xp, mp.yp, p);
+  pt_to_affine<Fp2Ops>(mp.xq, mp.yq, q);
+  Fp12 f;
+  multi_miller_loop(f, &mp, 1);
+  fp12_to_raw576(out576, f);
+  return 0;
+}
+
+// final-exponentiation verdict on a raw Fq12 (the device hands its
+// tree-reduced Miller product here; only the predicate crosses back)
+int ec_fp12_final_exp_is_one(const u8* f576) {
+  ensure_init();
+  Fp12 f;
+  if (!fp12_from_raw576(f, f576)) return -4;
+  Fp12 fe;
+  final_exp_for_verdict(fe, f);
+  return fp12_is_one(fe) ? 1 : 0;
 }
 
 }  // extern "C"
