@@ -15,6 +15,7 @@ from __future__ import annotations
 SWEEPS_MIN_N: int | None = None
 SHUFFLE_MIN_N: int | None = None
 BLS_AGG_MIN_N: int | None = None
+PAIRING_MIN_SETS: int | None = None
 
 
 def sweeps_enabled(n: int) -> bool:
@@ -34,3 +35,10 @@ def bls_agg_enabled(n: int) -> bool:
     ``n``-point batch? (Below the threshold the native C++ adds win —
     the device fold is latency-bound, not work-bound.)"""
     return BLS_AGG_MIN_N is not None and n >= BLS_AGG_MIN_N
+
+
+def pairing_enabled(n_sets: int) -> bool:
+    """Route the RLC batch verification (blinder mults + Miller loops +
+    Fq12 product) to the device pairing kernels for an ``n_sets``
+    batch? The native multi-pairing wins below the threshold."""
+    return PAIRING_MIN_SETS is not None and n_sets >= PAIRING_MIN_SETS

@@ -247,18 +247,33 @@ class Signature:
     parse time (it is needed for the eth_fast_aggregate_verify rule) but
     never verifies against a real message/pubkey pair."""
 
-    __slots__ = ("_point", "_bytes")
+    __slots__ = ("_point", "_bytes", "_raw")
 
     def __init__(self, point: G2Point):
         self._point = point
         self._bytes = None
+        self._raw = None
 
     @classmethod
     def _from_valid_bytes(cls, data: bytes) -> "Signature":
         self = cls.__new__(cls)
         self._point = None
         self._bytes = bytes(data)
+        self._raw = None
         return self
+
+    def raw_uncompressed(self) -> bytes:
+        """Affine x.c0||x.c1||y.c0||y.c1 (192 bytes, big-endian), cached.
+        Subgroup membership was established at parse time; all-zero for
+        the identity. Native backend only (callers gate on it)."""
+        if self._raw is None:
+            rc, raw, is_inf = native_bls.g2_decompress(
+                self.to_bytes(), check_subgroup=False
+            )
+            if rc != 0:
+                raise InvalidSignatureError(native_bls.decode_error_message(rc))
+            self._raw = b"\x00" * 192 if is_inf else raw
+        return self._raw
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Signature":
@@ -515,6 +530,10 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
             if any(s):
                 break
         scalars.append(s)
+    if _device_flags.pairing_enabled(len(sets)):
+        verdict = _batch_device_pairing(sets, dst, scalars)
+        if verdict is not None:
+            return verdict
     # raw-affine pubkeys: decompressed once per key (cached on the
     # PublicKey — subgroup-checked at parse time), so repeat verifiers
     # (the same validators every block) never pay the sqrt again
@@ -524,6 +543,53 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
         dst,
         scalars,
     )
+
+
+def _batch_device_pairing(
+    sets: list[SignatureSet], dst: bytes, scalars: list[bytes]
+) -> "bool | None":
+    """The device pairing route for the RLC batch: per-set pubkey
+    aggregation (host raw adds or already device-aggregated), native
+    hash_to_g2 per message, then blinder mults + N+1 Miller loops + the
+    Fq12 product on device (ops/pairing.py) with the native final-exp
+    verdict. None = device unusable, caller falls back; False verdicts
+    are exact (same RLC soundness as the native batch)."""
+    try:
+        from ..ops import pairing as device_pairing
+    except Exception:  # noqa: BLE001 — no jax, no device route
+        return None
+    try:
+        pk_raws = []
+        for s in sets:
+            if len(s.public_keys) == 1:
+                pk_raws.append(s.public_keys[0].raw_uncompressed())
+            else:
+                raw, inf = s.public_keys[0].raw_uncompressed(), False
+                for pk in s.public_keys[1:]:
+                    raw, inf = native_bls.g1_add_raw(
+                        raw, inf, pk.raw_uncompressed(), False
+                    )
+                if inf:
+                    return False  # identity aggregate never verifies
+                pk_raws.append(raw)
+        h_raws = []
+        for s in sets:
+            h_c = native_bls.hash_to_g2_compressed(s.message, dst)
+            rc, raw, _ = native_bls.g2_decompress(h_c, check_subgroup=False)
+            if rc != 0:
+                return None
+            h_raws.append(raw)
+        sig_raws = []
+        for s in sets:
+            if s.signature.is_infinity():
+                return False  # an identity signature never verifies
+            sig_raws.append(s.signature.raw_uncompressed())
+        return device_pairing.batch_verify_device(
+            pk_raws, h_raws, sig_raws,
+            [int.from_bytes(sc, "big") for sc in scalars],
+        )
+    except Exception:  # noqa: BLE001 — device trouble must not change verdicts
+        return None
 
 
 def verify_signature_sets(

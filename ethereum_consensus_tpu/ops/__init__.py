@@ -20,12 +20,22 @@ from .sha256 import install_device_hasher, sha256_64b_pallas, sha256_64b_xla
 DEFAULT_SWEEPS_MIN_N = 1 << 17
 DEFAULT_SHUFFLE_MIN_N = 1 << 15
 DEFAULT_BLS_AGG_MIN_N = 1 << 12
+# Device RLC multi-pairing (ops/pairing.py): disabled by default. The
+# kernel is bit-identical to the native backend and fully routed, but on
+# chips without native wide-integer multiply (v5e: u64 lane products are
+# emulated) the measured Miller throughput loses to the ADX C++ path
+# (~3.2ms vs ~0.55ms per pair at 4k batch). Opt in via install(
+# pairing_min_sets=N) where the fleet's chips do better — the planned
+# int8 MXU product kernel (schoolbook columns as an int8 matmul against
+# a constant anti-diagonal matrix) is the path to flipping the default.
+DEFAULT_PAIRING_MIN_SETS = None
 
 
 def install(
     sweeps_min_n: int = DEFAULT_SWEEPS_MIN_N,
     shuffle_min_n: int = DEFAULT_SHUFFLE_MIN_N,
     bls_agg_min_n: int = DEFAULT_BLS_AGG_MIN_N,
+    pairing_min_sets: "int | None" = DEFAULT_PAIRING_MIN_SETS,
 ) -> None:
     """Install all device fast paths into the host layers:
 
@@ -48,6 +58,7 @@ def install(
     _device_flags.SWEEPS_MIN_N = sweeps_min_n
     _device_flags.SHUFFLE_MIN_N = shuffle_min_n
     _device_flags.BLS_AGG_MIN_N = bls_agg_min_n
+    _device_flags.PAIRING_MIN_SETS = pairing_min_sets
 
 
 def uninstall() -> None:
@@ -55,6 +66,7 @@ def uninstall() -> None:
     _device_flags.SWEEPS_MIN_N = None
     _device_flags.SHUFFLE_MIN_N = None
     _device_flags.BLS_AGG_MIN_N = None
+    _device_flags.PAIRING_MIN_SETS = None
     from ..models.phase0 import helpers as _phase0_helpers
 
     _phase0_helpers._SHUFFLE_CACHE.clear()

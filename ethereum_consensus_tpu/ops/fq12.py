@@ -1,0 +1,188 @@
+"""Device Fq6/Fq12 tower arithmetic for the batched pairing, on the
+bound-tracked lazy field (ops/fql.py).
+
+Tower (identical to crypto/fields.py and native/bls12_381.cpp):
+    Fq6  = Fq2[v]/(v³ − ξ),  ξ = u + 1
+    Fq12 = Fq6[w]/(w² − v)
+
+Shapes: an Fq6 element is an LV over (..., 3, 2, 24) — v-power, the Fq2
+pair, limbs; Fq12 is (..., 2, 3, 2, 24) with the w-half first. Products
+use SCHOOLBOOK component formulas routed through fq2.mul_many, so one
+fp6 multiply is ONE stacked Montgomery instance (36 Fq products) — the
+graph-size discipline that keeps the Miller loop compilable. The lazy
+pad ladder (fql.lv_sub) absorbs every subtraction with trace-time bound
+checks.
+
+Cross-checked against native/bls12_381.cpp and crypto/fields.py on
+canonical exports in tests/test_ops_pairing.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import fq2, fql
+from .fql import LV
+
+__all__ = [
+    "fp6_comp",
+    "fp6_pack",
+    "fp6_add",
+    "fp6_sub",
+    "fp6_neg",
+    "fp6_mul",
+    "fp6_mul_by_v",
+    "fp12_one",
+    "fp12_comp",
+    "fp12_pack",
+    "fp12_mul",
+    "fp12_sqr",
+    "fp12_conj",
+    "fp12_mul_by_line",
+    "fp12_to_ints",
+    "fp12_from_ints",
+]
+
+
+def fp6_comp(a: LV, i: int) -> LV:
+    return LV(a.arr[..., i, :, :], a.vmax, a.cmax)
+
+
+def fp6_pack(c0: LV, c1: LV, c2: LV) -> LV:
+    return LV(
+        jnp.stack([c0.arr, c1.arr, c2.arr], axis=-3),
+        max(c0.vmax, c1.vmax, c2.vmax),
+        max(c0.cmax, c1.cmax, c2.cmax),
+    )
+
+
+def fp6_add(a: LV, b: LV) -> LV:
+    return fql.lv_add(a, b)
+
+
+def fp6_sub(a: LV, b: LV) -> LV:
+    return fql.lv_sub(a, b)
+
+
+def fp6_neg(a: LV) -> LV:
+    return fql.lv_sub(fql.lv_zero_like(a), a)
+
+
+def fp6_mul(a: LV, b: LV) -> LV:
+    """Schoolbook over Fq2 — 9 products, ONE stacked mont:
+    c0 = a0b0 + ξ(a1b2 + a2b1)
+    c1 = a0b1 + a1b0 + ξ(a2b2)
+    c2 = a0b2 + a1b1 + a2b0"""
+    a0, a1, a2 = (fp6_comp(a, i) for i in range(3))
+    b0, b1, b2 = (fp6_comp(b, i) for i in range(3))
+    p = fq2.mul_many([
+        (a0, b0), (a1, b2), (a2, b1),
+        (a0, b1), (a1, b0), (a2, b2),
+        (a0, b2), (a1, b1), (a2, b0),
+    ])
+    c0 = fq2.add(p[0], fq2.mul_by_xi(fq2.add(p[1], p[2])))
+    c1 = fq2.add(fq2.add(p[3], p[4]), fq2.mul_by_xi(p[5]))
+    c2 = fq2.add(fq2.add(p[6], p[7]), p[8])
+    return fp6_pack(c0, c1, c2)
+
+
+def fp6_mul_by_v(a: LV) -> LV:
+    """(a0, a1, a2) → (ξ·a2, a0, a1)."""
+    return fp6_pack(
+        fq2.mul_by_xi(fp6_comp(a, 2)), fp6_comp(a, 0), fp6_comp(a, 1)
+    )
+
+
+# -- Fq12 -------------------------------------------------------------------
+
+def fp12_comp(a: LV, i: int) -> LV:
+    return LV(a.arr[..., i, :, :, :], a.vmax, a.cmax)
+
+
+def fp12_pack(c0: LV, c1: LV) -> LV:
+    return LV(
+        jnp.stack([c0.arr, c1.arr], axis=-4),
+        max(c0.vmax, c1.vmax),
+        max(c0.cmax, c1.cmax),
+    )
+
+
+def fp12_one(batch_shape=()) -> LV:
+    one6 = np.stack([
+        np.stack([fql.to_mont_cols(1), np.zeros(24, np.uint64)]),
+        np.zeros((2, 24), np.uint64),
+        np.zeros((2, 24), np.uint64),
+    ])
+    base = np.stack([one6, np.zeros_like(one6)])
+    arr = jnp.broadcast_to(jnp.asarray(base), tuple(batch_shape) + base.shape)
+    return fql.lv_canon(arr)
+
+
+def fp12_mul(a: LV, b: LV) -> LV:
+    """Karatsuba over the w-halves — 3 fp6 multiplies."""
+    a0, a1 = fp12_comp(a, 0), fp12_comp(a, 1)
+    b0, b1 = fp12_comp(b, 0), fp12_comp(b, 1)
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    t2 = fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1))
+    t2 = fp6_sub(fp6_sub(t2, t0), t1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    return fp12_pack(c0, t2)
+
+
+def fp12_sqr(a: LV) -> LV:
+    """Complex squaring — 2 fp6 multiplies."""
+    a0, a1 = fp12_comp(a, 0), fp12_comp(a, 1)
+    u = fp6_mul(a0, a1)
+    t = fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1)))
+    t = fp6_sub(t, u)
+    c0 = fp6_sub(t, fp6_mul_by_v(u))
+    c1 = fp6_add(u, u)
+    return fp12_pack(c0, c1)
+
+
+def fp12_conj(a: LV) -> LV:
+    """f^(p^6): negate the w-half."""
+    return fp12_pack(fp12_comp(a, 0), fp6_neg(fp12_comp(a, 1)))
+
+
+def fp12_mul_by_line(f: LV, c00: LV, c11: LV, c12: LV) -> LV:
+    """f · (A + B·w) with A = (c00, 0, 0), B = (0, c11, c12) — the sparse
+    Miller-line multiply: the 9 cross products run as one stacked mont,
+    the dense (f0+f1)(A+B) correction as one fp6_mul."""
+    f0, f1 = fp12_comp(f, 0), fp12_comp(f, 1)
+    g0, g1, g2 = (fp6_comp(f1, i) for i in range(3))
+    h0, h1, h2 = (fp6_comp(f0, i) for i in range(3))
+    p = fq2.mul_many([
+        (h0, c00), (h1, c00), (h2, c00),      # t0 = f0 · A
+        (g1, c12), (g2, c11),                 # t1 v^0 parts (×ξ)
+        (g0, c11), (g2, c12),                 # t1 v^1 parts
+        (g0, c12), (g1, c11),                 # t1 v^2 parts
+    ])
+    t0 = fp6_pack(p[0], p[1], p[2])
+    t1 = fp6_pack(
+        fq2.mul_by_xi(fq2.add(p[3], p[4])),
+        fq2.add(p[5], fq2.mul_by_xi(p[6])),
+        fq2.add(p[7], p[8]),
+    )
+    ab = fp6_pack(c00, c11, c12)
+    t2 = fp6_mul(fp6_add(f0, f1), ab)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(t2, t0), t1)
+    return fp12_pack(c0, c1)
+
+
+# -- host interop -----------------------------------------------------------
+
+def fp12_to_ints(a) -> list[int]:
+    """LV (or raw (2, 3, 2, 24) array) → 12 canonical ints in
+    (c0.a0.c0, c0.a0.c1, c0.a1.c0, ..., c1.a2.c1) order (host side)."""
+    arr = np.asarray(a.arr if isinstance(a, LV) else a)
+    return fql.from_mont_ints(arr.reshape(-1, 24))
+
+
+def fp12_from_ints(vals) -> LV:
+    """Inverse of fp12_to_ints: 12 ints → R'-Montgomery LV."""
+    arr = fql.to_mont_cols(list(vals)).reshape(2, 3, 2, 24)
+    return fql.lv_canon(jnp.asarray(arr))

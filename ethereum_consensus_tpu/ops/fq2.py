@@ -1,0 +1,227 @@
+"""Device BLS12-381 Fq2 = Fq[u]/(u² + 1) on the bound-tracked lazy field.
+
+An Fq2 element is an ``fql.LV`` whose array is (..., 2, 24) uint64
+columns in R' = 2^416 Montgomery form — index 0 is c0, index 1 is c1 —
+with static value/column bounds carried beside the trace (fql.py).
+Multiplications STACK their independent Montgomery products into a
+single `fql` mont call so the compiled graph stays small, and use
+SCHOOLBOOK component formulas (c0 = a0b0 − a1b1, c1 = a0b1 + a1b0)
+rather than Karatsuba: one extra product per multiply, but every
+subtrahend is then a fresh mont output, which keeps the lazy-sub pad
+ladder shallow — the compile-time/bound-growth tradeoff that makes the
+Miller loop traceable at all.
+
+Reference parity: the role blst's fp2 layer plays under crypto/bls.rs
+(C6); canonical exports match crypto/fields.py Fq2 exactly
+(tests/test_ops_pairing.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fql
+from .fql import LV
+
+__all__ = [
+    "one",
+    "zero_like",
+    "to_lv",
+    "from_lv_ints",
+    "add",
+    "sub",
+    "neg",
+    "dbl",
+    "mul",
+    "square",
+    "scalar_mul",
+    "mul_by_xi",
+    "conj",
+    "inv",
+    "is_zero",
+]
+
+
+def _c0(a: LV):
+    return LV(a.arr[..., 0, :], a.vmax, a.cmax)
+
+
+def _c1(a: LV):
+    return LV(a.arr[..., 1, :], a.vmax, a.cmax)
+
+
+def _pack(c0: LV, c1: LV) -> LV:
+    return LV(
+        jnp.stack([c0.arr, c1.arr], axis=-2),
+        max(c0.vmax, c1.vmax),
+        max(c0.cmax, c1.cmax),
+    )
+
+
+def one(batch_shape=()) -> LV:
+    base = np.stack([fql.to_mont_cols(1), np.zeros(24, np.uint64)])
+    arr = jnp.broadcast_to(jnp.asarray(base), tuple(batch_shape) + base.shape)
+    return fql.lv_canon(arr)
+
+
+def zero_like(a: LV) -> LV:
+    return fql.lv_zero_like(a)
+
+
+def to_lv(c0: int, c1: int) -> LV:
+    """(c0 + c1·u) canonical ints → a (2, 24) R'-Montgomery LV."""
+    arr = np.stack([fql.to_mont_cols(c0), fql.to_mont_cols(c1)])
+    return fql.lv_canon(jnp.asarray(arr))
+
+
+def from_lv_ints(a) -> tuple:
+    """LV (or raw (..., 2, 24) array) → canonical (c0, c1) ints (host)."""
+    arr = np.asarray(a.arr if isinstance(a, LV) else a)
+    return fql.from_mont_ints(arr[..., 0, :]), fql.from_mont_ints(arr[..., 1, :])
+
+
+def add(a: LV, b: LV) -> LV:
+    return fql.lv_add(a, b)
+
+
+def sub(a: LV, b: LV) -> LV:
+    return fql.lv_sub(a, b)
+
+
+def dbl(a: LV) -> LV:
+    return fql.lv_add(a, a)
+
+
+def neg(a: LV) -> LV:
+    return fql.lv_sub(fql.lv_zero_like(a), a)
+
+
+def mul(a: LV, b: LV) -> LV:
+    """Schoolbook: c0 = a0b0 − a1b1, c1 = a0b1 + a1b0 — four independent
+    products in ONE stacked mont; both outputs are shallow (one sub of a
+    mont output / one add)."""
+    a0, a1 = _c0(a), _c1(a)
+    b0, b1 = _c0(b), _c1(b)
+    lhs = fql.lv_stack([a0, a1, a0, a1])
+    rhs = fql.lv_stack([b0, b1, b1, b0])
+    t = fql.lv_mont(lhs, rhs)
+    t0 = LV(t.arr[0], t.vmax, t.cmax)
+    t1 = LV(t.arr[1], t.vmax, t.cmax)
+    t2 = LV(t.arr[2], t.vmax, t.cmax)
+    t3 = LV(t.arr[3], t.vmax, t.cmax)
+    return _pack(fql.lv_sub(t0, t1), fql.lv_add(t2, t3))
+
+
+def square(a: LV) -> LV:
+    """c0 = a0² − a1², c1 = 2·a0a1 — three products, one stacked mont."""
+    a0, a1 = _c0(a), _c1(a)
+    lhs = fql.lv_stack([a0, a1, a0])
+    rhs = fql.lv_stack([a0, a1, a1])
+    t = fql.lv_mont(lhs, rhs)
+    t0 = LV(t.arr[0], t.vmax, t.cmax)
+    t1 = LV(t.arr[1], t.vmax, t.cmax)
+    t2 = LV(t.arr[2], t.vmax, t.cmax)
+    return _pack(fql.lv_sub(t0, t1), fql.lv_add(t2, t2))
+
+
+def mul_many(pairs: "list[tuple[LV, LV]]") -> "list[LV]":
+    """All the listed Fq2 products in ONE stacked mont call (4 Fq
+    products each, schoolbook) — the graph-size lever: a whole fp6/fp12
+    multiply becomes a single mont instance."""
+    lhs, rhs = [], []
+    for a, b in pairs:
+        a0, a1 = _c0(a), _c1(a)
+        b0, b1 = _c0(b), _c1(b)
+        lhs += [a0, a1, a0, a1]
+        rhs += [b0, b1, b1, b0]
+    t = fql.lv_mont(fql.lv_stack(lhs), fql.lv_stack(rhs))
+    outs = []
+    for k in range(len(pairs)):
+        t0 = LV(t.arr[4 * k], t.vmax, t.cmax)
+        t1 = LV(t.arr[4 * k + 1], t.vmax, t.cmax)
+        t2 = LV(t.arr[4 * k + 2], t.vmax, t.cmax)
+        t3 = LV(t.arr[4 * k + 3], t.vmax, t.cmax)
+        outs.append(_pack(fql.lv_sub(t0, t1), fql.lv_add(t2, t3)))
+    return outs
+
+
+def square_many(items: "list[LV]") -> "list[LV]":
+    """All the listed Fq2 squares in one stacked mont (3 products each)."""
+    lhs, rhs = [], []
+    for a in items:
+        a0, a1 = _c0(a), _c1(a)
+        lhs += [a0, a1, a0]
+        rhs += [a0, a1, a1]
+    t = fql.lv_mont(fql.lv_stack(lhs), fql.lv_stack(rhs))
+    outs = []
+    for k in range(len(items)):
+        t0 = LV(t.arr[3 * k], t.vmax, t.cmax)
+        t1 = LV(t.arr[3 * k + 1], t.vmax, t.cmax)
+        t2 = LV(t.arr[3 * k + 2], t.vmax, t.cmax)
+        outs.append(_pack(fql.lv_sub(t0, t1), fql.lv_add(t2, t2)))
+    return outs
+
+
+def scalar_mul(a: LV, k: LV) -> LV:
+    """a · k with k an Fq scalar LV of shape (..., 24)."""
+    lhs = fql.lv_stack([_c0(a), _c1(a)])
+    rhs = fql.lv_stack([k, k])
+    t = fql.lv_mont(lhs, rhs)
+    return _pack(LV(t.arr[0], t.vmax, t.cmax), LV(t.arr[1], t.vmax, t.cmax))
+
+
+def mul_by_xi(a: LV) -> LV:
+    """a · (u + 1) = (a0 − a1) + (a0 + a1)·u."""
+    a0, a1 = _c0(a), _c1(a)
+    return _pack(fql.lv_sub(a0, a1), fql.lv_add(a0, a1))
+
+
+def conj(a: LV) -> LV:
+    a0, a1 = _c0(a), _c1(a)
+    return _pack(a0, fql.lv_sub(fql.lv_zero_like(a1), a1))
+
+
+def is_zero(a: LV):
+    """a ≡ 0 mod p, safe for any redundant value (canonicalizing mont)."""
+    t = fql.mont(
+        jnp.stack([a.arr[..., 0, :], a.arr[..., 1, :]]),
+        jnp.asarray(fql._ONE_COLS),
+    )
+    return fql.is_zero_cols(t[0]) & fql.is_zero_cols(t[1])
+
+
+# p − 2 bits MSB-first (static), for the Fermat inversion scans
+_P_MINUS_2_BITS = np.array(
+    [int(b) for b in bin(fql.P_INT - 2)[2:]], dtype=np.bool_
+)
+
+
+def fq_inv_raw(a):
+    """Fq inversion a^(p−2) over raw (..., 24) R'-Montgomery mont-output
+    arrays (bounds are scan-stable: every carry is a mont output).
+    0 maps to 0. Used in batch affine conversions only."""
+    bits = jnp.asarray(_P_MINUS_2_BITS[1:])  # MSB consumed by init
+
+    def step(acc, bit):
+        acc2 = fql.mont(acc, acc)
+        with_mul = fql.mont(acc2, a)
+        return jnp.where(bit, with_mul, acc2), None
+
+    out, _ = jax.lax.scan(step, a, bits)
+    return out
+
+
+def inv(a: LV) -> LV:
+    """1 / (a0 + a1·u) = (a0 − a1·u) / (a0² + a1²)."""
+    a0, a1 = _c0(a), _c1(a)
+    t = fql.lv_mont(fql.lv_stack([a0, a1]), fql.lv_stack([a0, a1]))
+    norm = LV(t.arr[0], t.vmax, t.cmax)
+    norm = fql.lv_add(norm, LV(t.arr[1], t.vmax, t.cmax))
+    # one extra mont canonicalizes the sum for the scan-stable ladder
+    ninv = fq_inv_raw(fql.lv_mont(norm, fql.lv_const(1)).arr)
+    ninv_lv = fql.lv_canon(ninv)
+    lhs = fql.lv_stack([a0, fql.lv_sub(fql.lv_zero_like(a1), a1)])
+    out = fql.lv_mont(lhs, fql.lv_stack([ninv_lv, ninv_lv]))
+    return _pack(LV(out.arr[0], out.vmax, out.cmax), LV(out.arr[1], out.vmax, out.cmax))
