@@ -470,16 +470,38 @@ def fp12_product(fs) -> jax.Array:
 _CHUNK = 8192  # pairs per device dispatch (bounds peak HBM for the f batch)
 
 
+def _generator_raws() -> "tuple[bytes, bytes]":
+    from ..native import bls as native_bls
+
+    return native_bls.g1_generator_raw(), native_bls.g2_generator_raw()
+
+
+def _pad_pow2(items: list, filler) -> list:
+    n = len(items)
+    width = 1 << (n - 1).bit_length() if n > 1 else 1
+    return items + [filler] * (width - n)
+
+
 def miller_product_device(g1_raws: "list[bytes]", g2_raws: "list[bytes]") -> "list[int]":
     """Π_i miller(P_i, Q_i) over raw affine inputs, as 12 canonical-int
     Fq12 coefficients (the native backend's final-exp handoff format).
-    Inputs must be finite points (callers skip infinity pairs)."""
+    Inputs must be finite points (callers skip infinity pairs).
+
+    Batches are padded to the next power of two with generator pairs —
+    the padding lanes' Miller values are sliced off before the product —
+    so the jitted kernels compile for at most log2(_CHUNK) shapes instead
+    of one shape per distinct set count."""
     assert len(g1_raws) == len(g2_raws) and g1_raws
+    n_total = len(g1_raws)
+    g1f, g2f = _generator_raws()
     chunks = []
-    for lo in range(0, len(g1_raws), _CHUNK):
-        xp, yp = g1_affine_from_raw(g1_raws[lo:lo + _CHUNK])
-        xq, yq = g2_affine_from_raw(g2_raws[lo:lo + _CHUNK])
-        fs = miller_loop_batched(xp.arr, yp.arr, xq.arr, yq.arr)
+    for lo in range(0, n_total, _CHUNK):
+        g1c = g1_raws[lo:lo + _CHUNK]
+        g2c = g2_raws[lo:lo + _CHUNK]
+        n = len(g1c)
+        xp, yp = g1_affine_from_raw(_pad_pow2(g1c, g1f))
+        xq, yq = g2_affine_from_raw(_pad_pow2(g2c, g2f))
+        fs = miller_loop_batched(xp.arr, yp.arr, xq.arr, yq.arr)[:n]
         chunks.append(fp12_product(fs))
     total = fp12_product(jnp.stack(chunks)) if len(chunks) > 1 else chunks[0]
     return fq12.fp12_to_ints(total)
@@ -573,22 +595,34 @@ def batch_verify_device(
     n = len(pk_raws)
     assert n and len(h_raws) == n and len(sig_raws) == n and len(scalars) == n
 
-    pk_jac = _g1_jac_from_affine_raws(pk_raws)
-    pk_blinded = g1_mul_batched(pk_jac, scalars, bits=128)
+    # pad to the next power of two so the jitted kernels see log2-many
+    # shapes: pk/H lanes pad with generator points and blinder 1 (their
+    # Miller values are sliced off before the product); sig lanes pad
+    # with blinder 0, whose scalar mult is the identity — the branchless
+    # sum skips it
+    g1f, g2f = _generator_raws()
+    pk_padded = _pad_pow2(pk_raws, g1f)
+    h_padded = _pad_pow2(h_raws, g2f)
+    sig_padded = _pad_pow2(sig_raws, g2f)
+    pk_scalars = _pad_pow2(list(scalars), 1)
+    sig_scalars = list(scalars) + [0] * (len(pk_padded) - n)
+
+    pk_jac = _g1_jac_from_affine_raws(pk_padded)
+    pk_blinded = g1_mul_batched(pk_jac, pk_scalars, bits=128)
     xp, yp = _g1_jacobian_to_affine(pk_blinded.arr)
 
-    xq, yq = g2_affine_from_raw(h_raws)
+    xq, yq = g2_affine_from_raw(h_padded)
 
-    sx, sy = g2_affine_from_raw(sig_raws)
+    sx, sy = g2_affine_from_raw(sig_padded)
     one2 = jnp.broadcast_to(
         jnp.asarray(np.stack([fql.to_mont_cols(1), np.zeros(24, np.uint64)])),
         sy.arr.shape,
     )
     sig_jac = _env(jnp.stack([sx.arr, sy.arr, one2], axis=-3))
-    sig_sum = g2_sum_points(g2_mul_batched(sig_jac, scalars, bits=128))
+    sig_sum = g2_sum_points(g2_mul_batched(sig_jac, sig_scalars, bits=128))
     s_raw, s_inf = _g2_point_to_raw(sig_sum)
 
-    fs = miller_loop_batched(xp, yp, xq.arr, yq.arr)
+    fs = miller_loop_batched(xp, yp, xq.arr, yq.arr)[:n]
     f_total = fp12_product(fs)
     if not s_inf:
         f_extra_ints = fq12.fp12_to_ints(

@@ -400,9 +400,43 @@ def _deserialize_homogeneous(elem: SSZType, data: bytes, count: int | None) -> l
 
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
-        packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
+        if (
+            isinstance(elem, _UintType)
+            and elem.byte_length == 8
+            and values
+            and all(type(v) is int for v in values)
+        ):
+            # vectorized u64 packing (balances/inactivity lists dominate);
+            # the explicit little-endian dtype matches serialize(), the
+            # type pre-check keeps serialize()'s rejections (bool/float),
+            # and numpy's OverflowError fires exactly where serialize
+            # would raise for out-of-range ints
+            try:
+                import numpy as _np
+
+                packed = pack_bytes(
+                    _np.asarray(values, dtype="<u8").tobytes()
+                )
+            except (OverflowError, TypeError, ValueError):
+                packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
+        else:
+            packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
         limit = (limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
         return merkleize_chunks(packed, limit=limit)
+    if (
+        isinstance(elem, ByteVector)
+        and elem.length == BYTES_PER_CHUNK
+        and all(
+            isinstance(v, (bytes, bytearray)) and len(v) == BYTES_PER_CHUNK
+            for v in values
+        )
+    ):
+        # a 32-byte vector's root IS its bytes — skip 2 Python calls per
+        # element (block_roots/state_roots/randao_mixes are tens of
+        # thousands of these on a mainnet state); anything not exactly
+        # 32 bytes falls through to the per-element path and its errors
+        chunks = b"".join(values)
+        return merkleize_chunks(chunks, limit=limit_elems)
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
     return merkleize_chunks(chunks, limit=limit_elems)
 
@@ -637,6 +671,17 @@ class _ContainerMeta(type):
             if isinstance(val, (SSZType, _ContainerMeta)):
                 fields[key] = val
         cls.__ssz_fields__ = fields
+        # Scalar-leaf containers (every field an immutable-valued scalar:
+        # uints, booleans, fixed byte vectors — no nested containers, no
+        # lists) can cache their hash_tree_root on the instance, with
+        # attribute assignment as the only invalidation point. This is
+        # the cross-slot cache the per-slot state root leans on: 32k+
+        # Validator records of which a block touches a handful
+        # (reference hot path: phase0/slot_processing.rs:45).
+        cls.__ssz_scalar_leaf__ = bool(fields) and all(
+            isinstance(t, (_UintType, _BooleanType, ByteVector))
+            for t in fields.values()
+        )
         return cls
 
 
@@ -665,6 +710,12 @@ class Container(metaclass=_ContainerMeta):
             )
 
     # -- python niceties ----------------------------------------------------
+    def __setattr__(self, key, value):
+        # any field write invalidates the cached root (scalar-leaf
+        # containers only pay a dict pop; others never populate it)
+        self.__dict__.pop("_htr_cache", None)
+        object.__setattr__(self, key, value)
+
     def __eq__(self, other) -> bool:
         if type(self) is not type(other):
             return NotImplemented
@@ -785,11 +836,25 @@ class Container(metaclass=_ContainerMeta):
 
     @classmethod
     def hash_tree_root(cls, value: "Container") -> bytes:
+        if cls.__ssz_scalar_leaf__:
+            cached = value.__dict__.get("_htr_cache")
+            if cached is not None:
+                return cached
         chunks = b"".join(
             typ.hash_tree_root(getattr(value, key))
             for key, typ in cls.__ssz_fields__.items()
         )
-        return merkleize_chunks(chunks)
+        root = merkleize_chunks(chunks)
+        if cls.__ssz_scalar_leaf__ and all(
+            isinstance(value.__dict__.get(k), (int, bool, bytes))
+            for k in cls.__ssz_fields__
+        ):
+            # cache only when every field VALUE is immutable — a
+            # bytearray in a ByteVector field could mutate in place
+            # without passing through __setattr__.
+            # (bypass __setattr__, which would immediately evict it)
+            value.__dict__["_htr_cache"] = root
+        return root
 
     @classmethod
     def chunk_count(cls) -> int:

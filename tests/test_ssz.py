@@ -352,3 +352,45 @@ def test_union():
     assert t.deserialize(b"\x01" + (5).to_bytes(8, "little")) == (1, 5)
     sel_root = h(uint64.hash_tree_root(5) + (1).to_bytes(32, "little"))
     assert t.hash_tree_root((1, 5)) == sel_root
+
+
+def test_scalar_leaf_root_cache_invalidation():
+    """Scalar-leaf containers cache hash_tree_root on the instance; any
+    field write must invalidate it, and containers with mutable-valued
+    fields (lists, nested containers) must never cache."""
+
+    class Leaf(Container):
+        a: uint64
+        b: ByteVector[32]
+
+    assert Leaf.__ssz_scalar_leaf__
+    x = Leaf(a=1, b=b"\x11" * 32)
+    r1 = Leaf.hash_tree_root(x)
+    assert Leaf.hash_tree_root(x) == r1  # cached path
+    x.a = 2
+    r2 = Leaf.hash_tree_root(x)
+    assert r2 != r1
+    assert r2 == Leaf.hash_tree_root(Leaf(a=2, b=b"\x11" * 32))
+    # copies never share a stale cache
+    y = x.copy()
+    y.a = 3
+    assert Leaf.hash_tree_root(x) == r2
+    assert Leaf.hash_tree_root(y) == Leaf.hash_tree_root(Leaf(a=3, b=b"\x11" * 32))
+
+    class WithList(Container):
+        xs: List[uint64, 16]
+
+    assert not WithList.__ssz_scalar_leaf__
+    w = WithList(xs=[1, 2])
+    r1 = WithList.hash_tree_root(w)
+    w.xs.append(3)  # in-place mutation a cache could never see
+    assert WithList.hash_tree_root(w) != r1
+
+    class WithNested(Container):
+        inner: Leaf
+
+    assert not WithNested.__ssz_scalar_leaf__
+    n = WithNested(inner=Leaf(a=9, b=b"\x00" * 32))
+    r1 = WithNested.hash_tree_root(n)
+    n.inner.a = 10  # aliased child mutation
+    assert WithNested.hash_tree_root(n) != r1
