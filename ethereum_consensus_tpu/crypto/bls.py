@@ -411,8 +411,15 @@ def fast_aggregate_verify(
                 if agg is None:
                     return False  # identity aggregate never verifies
                 return verify_signature(agg, message, signature, dst)
-        rc = native_bls.fast_aggregate_verify(
-            [pk.to_bytes() for pk in public_keys], message,
+        # an identity pubkey in the list never verifies (PublicKey
+        # semantics, bls.rs:114) — checked here because the raw path's
+        # all-zero encoding would otherwise surface as a parse error
+        if any(pk.is_infinity() for pk in public_keys):
+            return False
+        # cached raw affine keys skip the per-key decompression sqrt
+        # (subgroup membership was established at parse time)
+        rc = native_bls.fast_aggregate_verify_raw(
+            [pk.raw_uncompressed() for pk in public_keys], message,
             signature.to_bytes(), dst,
         )
         if rc >= 0:

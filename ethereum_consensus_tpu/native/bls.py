@@ -34,6 +34,7 @@ __all__ = [
     "hash_to_g2_compressed",
     "verify",
     "fast_aggregate_verify",
+    "fast_aggregate_verify_raw",
     "aggregate_verify",
     "aggregate_signatures",
     "aggregate_public_keys",
@@ -105,6 +106,7 @@ def _declare(lib) -> None:
         "ec_bls_sign": ([p8, p8, sz, p8, sz, p8], i32),
         "ec_bls_verify": ([p8, p8, sz, p8, sz, p8, i32], i32),
         "ec_bls_fast_aggregate_verify": ([p8, sz, p8, sz, p8, sz, p8, i32], i32),
+        "ec_bls_fast_aggregate_verify_raw": ([p8, sz, p8, sz, p8, sz, p8, i32], i32),
         "ec_bls_aggregate_verify": ([p8, sz, p8, _u32p, p8, sz, p8, i32], i32),
         "ec_bls_aggregate_sigs": ([p8, sz, p8], i32),
         "ec_bls_aggregate_pubkeys": ([p8, sz, p8], i32),
@@ -258,6 +260,18 @@ def fast_aggregate_verify(pks: list[bytes], message: bytes, sig96: bytes,
     cat = b"".join(bytes(pk) for pk in pks)
     return _lib().ec_bls_fast_aggregate_verify(
         cat, len(pks), bytes(message), len(message), bytes(dst), len(dst),
+        bytes(sig96), int(assume_valid),
+    )
+
+
+def fast_aggregate_verify_raw(pk_raws: list[bytes], message: bytes,
+                              sig96: bytes, dst: bytes,
+                              assume_valid: bool = False) -> int:
+    """fast_aggregate_verify from cached raw affine pubkeys (96 bytes
+    each, subgroup-checked at parse) — no per-key decompression sqrt."""
+    return _lib().ec_bls_fast_aggregate_verify_raw(
+        b"".join(bytes(p) for p in pk_raws), len(pk_raws),
+        bytes(message), len(message), bytes(dst), len(dst),
         bytes(sig96), int(assume_valid),
     )
 

@@ -2252,6 +2252,34 @@ int ec_bls_fast_aggregate_verify(const u8* pks, size_t n, const u8* msg,
   return pairing_product_is_one(ps, qs, 2) ? 1 : 0;
 }
 
+// fast_aggregate_verify from PRE-DECOMPRESSED raw affine pubkeys (the
+// PublicKey cache) — skips the per-key sqrt that dominates large
+// aggregates; on-curve is re-checked, subgroup was checked at parse.
+int ec_bls_fast_aggregate_verify_raw(const u8* pks_raw, size_t n,
+                                     const u8* msg, size_t msg_len,
+                                     const u8* dst, size_t dst_len,
+                                     const u8* sig96, int assume_valid) {
+  ensure_init();
+  if (n == 0) return 0;
+  G1 acc = pt_infinity<FpOps>();
+  for (size_t i = 0; i < n; i++) {
+    G1 pk;
+    if (!g1_from_raw(pk, pks_raw + 96 * i, 0) || pk.is_inf()) return -5;
+    pt_add(acc, acc, pk);
+  }
+  G2 sig;
+  int rc = g2_decompress(sig, sig96, assume_valid == 0);
+  if (rc != DEC_OK) return -rc;
+  if (acc.is_inf() || sig.is_inf()) return 0;
+  G2 h;
+  if (!hash_to_g2_point(h, msg, msg_len, dst, dst_len)) return -1;
+  G1 neg_gen;
+  pt_neg(neg_gen, G1_GEN);
+  G1 ps[2] = {acc, neg_gen};
+  G2 qs[2] = {h, sig};
+  return pairing_product_is_one(ps, qs, 2) ? 1 : 0;
+}
+
 int ec_bls_aggregate_verify(const u8* pks, size_t n, const u8* msgs,
                             const u32* msg_lens, const u8* dst, size_t dst_len,
                             const u8* sig96, int assume_valid) {

@@ -31,6 +31,10 @@ g.dryrun_multichip(8)
 """
     )
     assert "dryrun_multichip ok" in out
+    # the widened tail: mesh-sharded set aggregation + a full signed block
+    # (attestations + sync aggregate, batched sigs) device==host
+    assert "sharded_set_agg" in out
+    assert "device==host root" in out
 
 
 def test_entry_compiles():
@@ -219,3 +223,34 @@ print("epoch-sweep-ok")
 """
     )
     assert "epoch-sweep-ok" in out
+
+
+def test_sharded_signature_set_aggregation_uneven_shapes():
+    """The batch-verify set axis sharded over the mesh with UNEVEN shapes
+    — a set count not divisible by the mesh and ragged per-set key counts
+    (the padded segmented-fold path) — cross-checked key-exact against
+    the host aggregator. Complements the aligned-shape case exercised by
+    the dryrun (test_chain_step_dryrun); VERDICT r2 item 5."""
+    out = run_in_cpu_mesh(
+        """
+import numpy as np
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.native import bls as native_bls
+from ethereum_consensus_tpu.ops import g1 as device_g1
+
+key_counts = [3, 1, 5, 2, 4, 2, 1, 6, 3, 2, 1, 4, 2]  # 13 sets, ragged
+sks, sets = [], []
+i = 0
+for count in key_counts:
+    group = [bls.SecretKey(700 + i + j) for j in range(count)]
+    i += count
+    sks.append(group)
+    sets.append([sk.public_key().raw_uncompressed() for sk in group])
+agg = device_g1.aggregate_pubkey_sets_device(sets)
+for s, (raw, inf) in enumerate(agg):
+    want = bls.eth_aggregate_public_keys([sk.public_key() for sk in sks[s]])
+    assert not inf and native_bls.g1_compress_raw(raw) == want.to_bytes(), s
+print("sharded-set-agg-ok")
+"""
+    )
+    assert "sharded-set-agg-ok" in out
