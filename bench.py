@@ -301,7 +301,7 @@ def bench_sig_128k(n_sigs: int = 1 << 17, distinct: int = 1 << 12):
     }
 
 
-def bench_process_block_mainnet(validators: int = 1 << 14, atts: int = 16):
+def bench_process_block_mainnet(validators: int = 1 << 13, atts: int = 16):
     """BASELINE config 5 faithfully: mainnet preset, a real registry,
     multiple signed attestations, all signature sets batched, full
     per-slot state HTR. (The minimal-preset variant below measures the
