@@ -1,0 +1,121 @@
+"""Experimental MXU-shaped Montgomery multiplication (8-bit limb columns).
+
+The lazy tower (ops/fql.py) made the batched pairing COMPILE and run
+correct, but on chips that emulate wide-integer lane multiplies (v5e)
+its u64 column products lose to the native ADX backend. The TPU's
+arithmetic actually lives in the MXU, whose integer path is
+int8×int8→int32. This module re-shapes the schoolbook column product to
+feed it:
+
+    a, b in 48 8-bit limbs;   outer[n, i, j] = a8[n, i] · b8[n, j]
+    cols[n, k] = Σ_{i+j=k} outer[n, i, j]
+               = (outer reshaped to (n, 2304)) @ M        # one matmul
+    with M[(i, j), k] = [i + j == k], a constant 0/1 (2304, 95) operand.
+
+Every accumulation is exact in int32 (48 terms × 255² < 2^22), and the
+contraction against the constant anti-diagonal matrix is a real matmul
+XLA can tile onto the MXU. The Montgomery reduction that follows is the
+same column-serial 26-round sweep as fql.mont, but with the row
+products also expressible as (m-digit × constant-p-matrix) contractions.
+
+STATUS: correctness-complete and cross-checked against fql.mont
+(tests/test_ops_pairing.py::test_fq8_matmul_product_matches_fql); NOT
+routed into the pairing yet — flipping ops/pairing.py onto this layer
+(and measuring it on real hardware) is the planned path to enabling
+`install(pairing_min_sets=...)` by default. See docs/DEVICE_PAIRING.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import fql
+
+__all__ = ["product_cols8", "mont8"]
+
+L8 = 48          # 8-bit limbs per 384-bit value
+COLS8 = 2 * L8 - 1
+
+# constant anti-diagonal contraction matrix: (i*48+j, k) -> [i+j == k]
+_M = np.zeros((L8 * L8, COLS8), dtype=np.int8)
+for _i in range(L8):
+    for _j in range(L8):
+        _M[_i * L8 + _j, _i + _j] = 1
+
+
+def _to8(cols16):
+    """(..., 24) 16-bit columns -> (..., 48) 8-bit columns (int32 lanes).
+    Inputs must be mont outputs (exact 16-bit columns)."""
+    lo = (cols16 & jnp.uint64(0xFF)).astype(jnp.int32)
+    hi = ((cols16 >> jnp.uint64(8)) & jnp.uint64(0xFF)).astype(jnp.int32)
+    return jnp.stack([lo, hi], axis=-1).reshape(cols16.shape[:-1] + (L8,))
+
+
+def product_cols8(a16, b16):
+    """Full 95-column schoolbook product of two 16-bit-column values via
+    the outer-product ⊗ constant-matrix contraction. Returns (..., 95)
+    int64 columns of the exact integer product (8-bit column weights)."""
+    a8 = _to8(a16)
+    b8 = _to8(b16)
+    outer = (a8[..., :, None] * b8[..., None, :]).reshape(
+        a8.shape[:-1] + (L8 * L8,)
+    )
+    # the MXU-shaped contraction: (..., 2304) @ (2304, 95) with exact
+    # int32 accumulation (48 terms x 255^2 < 2^22)
+    cols = jax.lax.dot_general(
+        outer,
+        jnp.asarray(_M, jnp.int32),
+        (((outer.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return cols.astype(jnp.int64)
+
+
+_P8 = np.zeros(L8, dtype=np.int64)
+for _i in range(L8):
+    _P8[_i] = (fql.P_INT >> (8 * _i)) & 0xFF
+
+
+def mont8(a16, b16):
+    """Montgomery product a·b·(2^416)⁻¹ mod-ish p, MXU-product variant.
+
+    The 95-column exact product feeds the same column-serial reduction as
+    fql.mont but at 8-bit granularity (52 rounds): m = low byte × n0',
+    add m·p's byte columns, shift. Output is identical to
+    ``fql.mont(a16, b16)`` — 16-bit columns, value < 1.1p — verified
+    column-exact in tests."""
+    n0_8 = (-pow(fql.P_INT, -1, 1 << 8)) % (1 << 8)
+    cols = product_cols8(a16, b16)
+    batch = cols.shape[:-1]
+    t = jnp.concatenate(
+        [cols, jnp.zeros(batch + (5,), jnp.int64)], axis=-1
+    ).astype(jnp.uint64)
+    p8 = jnp.asarray(_P8.astype(np.uint64))
+    mask8 = jnp.uint64(0xFF)
+    rounds = 52  # R' = 2^416 = 2^(8·52)
+
+    def step(i, t):
+        m = (t[..., 0] * jnp.uint64(n0_8)) & mask8
+        t = t.at[..., :L8].add(m[..., None] * p8)
+        carry0 = t[..., 0] >> jnp.uint64(8)
+        shifted = jnp.concatenate(
+            [t[..., 1:], jnp.zeros(batch + (1,), jnp.uint64)], axis=-1
+        )
+        return shifted.at[..., 0].add(carry0)
+
+    t = jax.lax.fori_loop(0, rounds, step, t)
+
+    def carry_step(carry, col):
+        v = col + carry
+        return v >> jnp.uint64(8), v & mask8
+
+    _, limbs8 = jax.lax.scan(
+        carry_step, jnp.zeros(batch, jnp.uint64), jnp.moveaxis(t, -1, 0)
+    )
+    limbs8 = jnp.moveaxis(limbs8, 0, -1)[..., :L8]
+    # back to 16-bit columns
+    lo = limbs8[..., 0::2]
+    hi = limbs8[..., 1::2]
+    return lo | (hi << jnp.uint64(8))
