@@ -12,11 +12,17 @@ feed it:
                = (outer reshaped to (n, 2304)) @ M        # one matmul
     with M[(i, j), k] = [i + j == k], a constant 0/1 (2304, 95) operand.
 
-Every accumulation is exact in int32 (48 terms × 255² < 2^22), and the
-contraction against the constant anti-diagonal matrix is a real matmul
-XLA can tile onto the MXU. The Montgomery reduction that follows is the
-same column-serial 26-round sweep as fql.mont, but with the row
-products also expressible as (m-digit × constant-p-matrix) contractions.
+Every accumulation is exact in int32 (48 terms × 255² < 2^22). The
+contraction as implemented is int32×int32 (outer-product values exceed
+int8, and jax dot_general needs matching operand dtypes): it reshapes
+the reduction into MXU-tileable matmul form but does NOT yet hit the
+int8×int8→int32 fast path itself — that needs the digits as a matmul
+operand, i.e. ≤7-bit limbs (55 per value) so they fit SIGNED int8, with
+per-element shift matrices. This module is the first step (a correct
+matmul-shaped product + byte-granular reduction); the 7-bit
+reformulation is the follow-up, to be measured on hardware before any
+routing. The Montgomery reduction that follows is the same
+column-serial sweep as fql.mont at byte granularity (52 rounds).
 
 STATUS: correctness-complete and cross-checked against fql.mont
 (tests/test_ops_pairing.py::test_fq8_matmul_product_matches_fql); NOT
@@ -33,7 +39,7 @@ import numpy as np
 
 from . import fql
 
-__all__ = ["product_cols8", "mont8"]
+__all__ = ["product_cols8", "mont8", "lv_mont8"]
 
 L8 = 48          # 8-bit limbs per 384-bit value
 COLS8 = 2 * L8 - 1
@@ -45,9 +51,22 @@ for _i in range(L8):
         _M[_i * L8 + _j, _i + _j] = 1
 
 
+def lv_mont8(a: "fql.LV", b: "fql.LV") -> "fql.LV":
+    """Bound-checked entry point: mont8 REQUIRES canonical 16-bit columns
+    (mont outputs) — unlike fql.mont it does NOT accept lazily-redundant
+    values (_to8 would silently drop bits 16+). The trace-time assert
+    makes that precondition loud, the same discipline as fql.lv_mont."""
+    assert a.cmax <= (1 << 16) and b.cmax <= (1 << 16), (
+        "mont8 needs canonical 16-bit columns; canonicalize redundant "
+        f"values first (got cmax {a.cmax:#x}, {b.cmax:#x})"
+    )
+    return fql.lv_canon(mont8(a.arr, b.arr))
+
+
 def _to8(cols16):
     """(..., 24) 16-bit columns -> (..., 48) 8-bit columns (int32 lanes).
-    Inputs must be mont outputs (exact 16-bit columns)."""
+    Inputs MUST be mont outputs (exact 16-bit columns) — higher bits are
+    dropped; use lv_mont8 for the checked entry point."""
     lo = (cols16 & jnp.uint64(0xFF)).astype(jnp.int32)
     hi = ((cols16 >> jnp.uint64(8)) & jnp.uint64(0xFF)).astype(jnp.int32)
     return jnp.stack([lo, hi], axis=-1).reshape(cols16.shape[:-1] + (L8,))

@@ -1,8 +1,12 @@
 """Device SHA-256 + merkle kernels vs hashlib: bit-identical checks.
 
 Runs on whatever backend the environment provides (real TPU under axon,
-CPU elsewhere); the Pallas kernel additionally runs in interpreter mode so
-kernel logic is validated even without TPU hardware.
+CPU elsewhere). The Pallas kernel additionally runs in interpreter mode
+so kernel logic (tiling/grid included) is validated without TPU
+hardware — but interpret-mode emulation on a CPU-ONLY backend takes
+>30min/test, so there the interpret tests are skipped unless
+EC_RUN_INTERPRET_TESTS=1 opts in (the sha256_xla_* tests still cover the
+compression math on CPU).
 """
 
 import hashlib
@@ -52,6 +56,20 @@ def test_sha256_xla_edge_patterns():
         assert (got[:, 0] == expect).all()
 
 
+import os  # noqa: E402
+
+import jax  # noqa: E402
+
+_interpret_skip = pytest.mark.skipif(
+    jax.default_backend() == "cpu"
+    and not os.environ.get("EC_RUN_INTERPRET_TESTS"),
+    reason="pallas interpret-mode emulation is pathologically slow on a "
+    "CPU-only backend (>30min/test); set EC_RUN_INTERPRET_TESTS=1 to "
+    "run them anyway — the sha256_xla_* tests cover the math on CPU",
+)
+
+
+@_interpret_skip
 def test_sha256_pallas_interpret_matches_hashlib():
     n = 1024  # one tile
     rng = np.random.default_rng(0)
@@ -60,6 +78,7 @@ def test_sha256_pallas_interpret_matches_hashlib():
     assert (got.T == _ref_hashes(msgs, n)).all()
 
 
+@_interpret_skip
 def test_sha256_pallas_interpret_multi_tile():
     n = 2048  # two grid steps
     rng = np.random.default_rng(1)
