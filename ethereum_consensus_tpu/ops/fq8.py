@@ -16,12 +16,11 @@ Every accumulation is exact in int32 (48 terms × 255² < 2^22). The
 contraction as implemented is int32×int32 (outer-product values exceed
 int8, and jax dot_general needs matching operand dtypes): it reshapes
 the reduction into MXU-tileable matmul form but does NOT yet hit the
-int8×int8→int32 fast path itself — that needs the digits as a matmul
-operand, i.e. ≤7-bit limbs (55 per value) so they fit SIGNED int8, with
-per-element shift matrices. This module is the first step (a correct
-matmul-shaped product + byte-granular reduction); the 7-bit
-reformulation is the follow-up, to be measured on hardware before any
-routing. The Montgomery reduction that follows is the same
+int8×int8→int32 fast path itself. `product_cols7`/`mont7` DO: 7-bit
+digits (55 per value, fitting SIGNED int8) form per-element shifted
+digit matrices, and the whole product is one batched int8 dot_general
+with exact int32 accumulation (55 terms × 127² < 2^20) — the MXU's
+native integer path. Hardware measurement decides routing. The Montgomery reduction that follows is the same
 column-serial sweep as fql.mont at byte granularity (52 rounds).
 
 STATUS: correctness-complete and cross-checked against fql.mont
@@ -39,7 +38,7 @@ import numpy as np
 
 from . import fql
 
-__all__ = ["product_cols8", "mont8", "lv_mont8"]
+__all__ = ["product_cols8", "mont8", "lv_mont8", "product_cols7", "mont7"]
 
 L8 = 48          # 8-bit limbs per 384-bit value
 COLS8 = 2 * L8 - 1
@@ -97,20 +96,12 @@ for _i in range(L8):
     _P8[_i] = (fql.P_INT >> (8 * _i)) & 0xFF
 
 
-def mont8(a16, b16):
-    """Montgomery product a·b·(2^416)⁻¹ mod-ish p, MXU-product variant.
-
-    The 95-column exact product feeds the same column-serial reduction as
-    fql.mont but at 8-bit granularity (52 rounds): m = low byte × n0',
-    add m·p's byte columns, shift. Output is identical to
-    ``fql.mont(a16, b16)`` — 16-bit columns, value < 1.1p — verified
-    column-exact in tests."""
+def _reduce8(t):
+    """Byte-granular Montgomery reduction of deferred uint64 byte columns
+    (value weight 2^(8i)): 52 rounds for R' = 2^416, carry-normalize,
+    regroup to 16-bit columns. Shared by mont8 and mont7."""
     n0_8 = (-pow(fql.P_INT, -1, 1 << 8)) % (1 << 8)
-    cols = product_cols8(a16, b16)
-    batch = cols.shape[:-1]
-    t = jnp.concatenate(
-        [cols, jnp.zeros(batch + (5,), jnp.int64)], axis=-1
-    ).astype(jnp.uint64)
+    batch = t.shape[:-1]
     p8 = jnp.asarray(_P8.astype(np.uint64))
     mask8 = jnp.uint64(0xFF)
     rounds = 52  # R' = 2^416 = 2^(8·52)
@@ -134,7 +125,92 @@ def mont8(a16, b16):
         carry_step, jnp.zeros(batch, jnp.uint64), jnp.moveaxis(t, -1, 0)
     )
     limbs8 = jnp.moveaxis(limbs8, 0, -1)[..., :L8]
-    # back to 16-bit columns
     lo = limbs8[..., 0::2]
     hi = limbs8[..., 1::2]
     return lo | (hi << jnp.uint64(8))
+
+
+def mont8(a16, b16):
+    """Montgomery product a·b·(2^416)⁻¹ mod-ish p, MXU-shaped product
+    (int32 contraction) + byte-granular reduction. Output is identical to
+    ``fql.mont(a16, b16)`` — 16-bit columns, value < 1.1p — verified
+    column-exact in tests."""
+    cols = product_cols8(a16, b16)
+    batch = cols.shape[:-1]
+    t = jnp.concatenate(
+        [cols, jnp.zeros(batch + (5,), jnp.int64)], axis=-1
+    ).astype(jnp.uint64)
+    return _reduce8(t)
+
+
+# -- the TRUE int8×int8→int32 form: 7-bit digits ---------------------------
+
+L7 = 55          # 7-bit digits per 384-bit value (55·7 = 385)
+COLS7 = 2 * L7 - 1
+
+
+def _to7(cols16):
+    """(..., 24) exact 16-bit columns → (..., 55) 7-bit digits as SIGNED
+    int8 (digits ≤ 127 fit). Same canonical-input precondition as _to8."""
+    # bits via pairwise extraction: digit d covers bits [7d, 7d+7)
+    out = []
+    for d in range(L7):
+        lo_bit = 7 * d
+        q, r = divmod(lo_bit, 16)
+        v = cols16[..., q] >> jnp.uint64(r)
+        if r > 9 and q + 1 < 24:  # digit straddles the column boundary
+            v = v | (cols16[..., q + 1] << jnp.uint64(16 - r))
+        out.append((v & jnp.uint64(0x7F)).astype(jnp.int8))
+    return jnp.stack(out, axis=-1)
+
+
+def product_cols7(a16, b16):
+    """Exact 109-column 7-bit-weighted product via a BATCHED int8 matmul:
+    cols7[n, k] = Σ_j b7[n, j] · A[n, j, k] with A[n, j, k] = a7[n, k−j]
+    (shifted copies of a's digit vector). Both dot_general operands are
+    int8 with int32 accumulation — the MXU's native integer path — and
+    every sum is exact (55 terms × 127² < 2^20)."""
+    a7 = _to7(a16)
+    b7 = _to7(b16)
+    batch = a7.shape[:-1]
+    shifted = []
+    zero = jnp.zeros(batch + (1,), jnp.int8)
+    for j in range(L7):
+        row = a7
+        if j:
+            pad = jnp.zeros(batch + (j,), jnp.int8)
+            row = jnp.concatenate([pad, a7], axis=-1)
+        tail = COLS7 - row.shape[-1]
+        if tail > 0:
+            row = jnp.concatenate(
+                [row, jnp.zeros(batch + (tail,), jnp.int8)], axis=-1
+            )
+        shifted.append(row)
+    A = jnp.stack(shifted, axis=-2)          # (..., 55, 109) int8
+    del zero
+    # batched (..., 1, 55) @ (..., 55, 109) int8 matmul, int32 accumulate
+    nb = len(batch)
+    cols = jax.lax.dot_general(
+        b7[..., None, :],
+        A,
+        (((nb + 1,), (nb,)), (tuple(range(nb)), tuple(range(nb)))),
+        preferred_element_type=jnp.int32,
+    )[..., 0, :]
+    return cols.astype(jnp.int64)
+
+
+def mont7(a16, b16):
+    """Montgomery product via the TRUE int8 MXU product (product_cols7):
+    the 7-bit-weighted columns regroup into byte-weighted uint64 columns
+    (static shift-adds, exact), then the shared byte-granular reduction.
+    Column-exact vs fql.mont — verified in tests."""
+    cols7 = product_cols7(a16, b16).astype(jnp.uint64)
+    batch = cols7.shape[:-1]
+    t = jnp.zeros(batch + (2 * L8 + 4,), jnp.uint64)
+    for i in range(COLS7):
+        lo_bit = 7 * i
+        q, r = divmod(lo_bit, 8)
+        t = t.at[..., q].add(cols7[..., i] << jnp.uint64(r))
+    # columns now byte-weighted but with values up to ~2^27 each — the
+    # deferred-carry reduction tolerates that (accumulator ≪ 2^64)
+    return _reduce8(t)

@@ -252,3 +252,23 @@ def test_fq8_matmul_product_matches_fql():
         vb = sum(int(c) << (16 * i) for i, c in enumerate(np.asarray(b[n])))
         vp = sum(int(c) << (8 * i) for i, c in enumerate(cols[n]))
         assert vp == va * vb, n
+
+
+def test_fq7_true_int8_product_matches_fql():
+    """mont7 — the batched int8×int8→int32 dot_general form (7-bit
+    digits, per-element shift matrices) — must also be column-exact
+    against fql.mont, and its raw 109-column product integer-exact."""
+    import jax.numpy as jnp
+
+    from ethereum_consensus_tpu.ops import fq8
+
+    rng = np.random.default_rng(13)
+    a = jnp.asarray(rng.integers(0, 1 << 16, size=(16, 24), dtype=np.uint64))
+    b = jnp.asarray(rng.integers(0, 1 << 16, size=(16, 24), dtype=np.uint64))
+    assert (np.asarray(fql.mont(a, b)) == np.asarray(fq8.mont7(a, b))).all()
+    cols = np.asarray(fq8.product_cols7(a, b))
+    for n in range(4):
+        va = sum(int(c) << (16 * i) for i, c in enumerate(np.asarray(a[n])))
+        vb = sum(int(c) << (16 * i) for i, c in enumerate(np.asarray(b[n])))
+        vp = sum(int(c) << (7 * i) for i, c in enumerate(cols[n]))
+        assert vp == va * vb, n
