@@ -382,3 +382,33 @@ def test_infinity_signature_never_verifies():
     _, pk = _keypair(500)
     inf_sig = bls.Signature(G2Point.infinity())
     assert not bls.verify_signature(pk, b"m", inf_sig)
+
+
+def _bisection_sets(n, bad, keys=4):
+    sks = [bls.SecretKey(31 + i) for i in range(keys)]
+    pks = [sk.public_key() for sk in sks]
+    sets = []
+    for i in range(n):
+        msg = bytes([i]) * 32
+        agg = bls.aggregate([sk.sign(msg) for sk in sks])
+        sets.append(bls.SignatureSet(pks, msg, agg))
+    for i in bad:
+        other = sets[(i + 1) % n]
+        sets[i] = bls.SignatureSet(pks, sets[i].message, other.signature)
+    return sets
+
+
+def test_verify_signature_sets_attribution_scattered():
+    """Bad sets scattered through a failing batch (adjacent + both
+    boundaries) must each be blamed exactly by the pre-aggregated
+    per-set attribution fallback."""
+    bad = {0, 6, 7, 15}
+    verdicts = bls.verify_signature_sets(_bisection_sets(16, bad))
+    assert verdicts == [i not in bad for i in range(16)]
+
+
+def test_verify_signature_sets_attribution_single():
+    """A single bad set among many: everything else must read True."""
+    bad = {11}
+    verdicts = bls.verify_signature_sets(_bisection_sets(32, bad))
+    assert verdicts == [i not in bad for i in range(32)]
