@@ -606,36 +606,17 @@ def verify_signature_sets(
 
     Native path: one random-linear-combination multi-pairing proves all N
     at once (N+1 Miller loops, one shared final exponentiation). On
-    failure, blame is attributed per set over PRE-AGGREGATED single-key
-    sets — each multi-key set's pubkey sum is computed once (raw affine
-    adds, no per-set re-aggregation during attribution). Bisection-style
-    batch probing was tried and measured a wash-to-loss here: a probe
-    over m sets pays the same per-set hash_to_g2 + Miller work a direct
-    verify pays, so the only sharing is the final exponentiation, which
-    the probe ladder re-spends on overlapping ranges. A forged set
-    passes the blinded batch with probability <= 2^-128."""
+    failure, blame is attributed by verifying each set directly —
+    ``SignatureSet.verify`` already aggregates multi-key sets in one
+    native pass (and rejects identity pubkeys/empty keysets cleanly), so
+    no pre-aggregation here can save work. Bisection-style batch probing
+    was tried and measured a wash-to-loss here: a probe over m sets pays
+    the same per-set hash_to_g2 + Miller work a direct verify pays, so
+    the only sharing is the final exponentiation, which the probe ladder
+    re-spends on overlapping ranges. A forged set passes the blinded
+    batch with probability <= 2^-128."""
     if not sets:
         return []
-    if _native() and len(sets) > 1:
-        if _batch_all_valid(sets, dst):
-            return [True] * len(sets)
-        verdicts: list[bool] = []
-        for s in sets:
-            if len(s.public_keys) == 1:
-                verdicts.append(s.verify(dst))
-                continue
-            raw, inf = s.public_keys[0].raw_uncompressed(), False
-            for pk in s.public_keys[1:]:
-                raw, inf = native_bls.g1_add_raw(
-                    raw, inf, pk.raw_uncompressed(), False
-                )
-            if inf:
-                verdicts.append(False)  # identity aggregate never verifies
-                continue
-            agg = PublicKey._from_valid_bytes(native_bls.g1_compress_raw(raw))
-            agg._raw = raw
-            verdicts.append(
-                SignatureSet([agg], s.message, s.signature).verify(dst)
-            )
-        return verdicts
+    if _native() and len(sets) > 1 and _batch_all_valid(sets, dst):
+        return [True] * len(sets)
     return [s.verify(dst) for s in sets]
