@@ -591,9 +591,16 @@ def _batch_device_pairing(
             if s.signature.is_infinity():
                 return False  # an identity signature never verifies
             sig_raws.append(s.signature.raw_uncompressed())
+        blinders = [int.from_bytes(sc, "big") for sc in scalars]
+        import jax
+
+        if len(jax.devices()) > 1:
+            # multi-chip: the set axis shards over the mesh (SURVEY §2.5)
+            from ..parallel.pairing import batch_verify_sharded
+
+            return batch_verify_sharded(pk_raws, h_raws, sig_raws, blinders)
         return device_pairing.batch_verify_device(
-            pk_raws, h_raws, sig_raws,
-            [int.from_bytes(sc, "big") for sc in scalars],
+            pk_raws, h_raws, sig_raws, blinders
         )
     except Exception:  # noqa: BLE001 — device trouble must not change verdicts
         return None

@@ -46,6 +46,7 @@ __all__ = [
     "g1_mul_batched",
     "g2_mul_batched",
     "batch_verify_device",
+    "finalize_verdict",
 ]
 
 BLS_X_ABS = 0xD201000000010000
@@ -624,6 +625,17 @@ def batch_verify_device(
 
     fs = miller_loop_batched(xp, yp, xq.arr, yq.arr)[:n]
     f_total = fp12_product(fs)
+    return finalize_verdict(f_total, s_raw, s_inf)
+
+
+def finalize_verdict(f_total, s_raw: bytes, s_inf: bool) -> bool:
+    """Close an RLC batch from its device partials: multiply the Fq12
+    Miller product by the extra pair e(−G, Σ [r_i]·sig_i) and ask the
+    native backend for the final-exponentiation verdict. Shared by the
+    single-device route above and the mesh-sharded route
+    (parallel/pairing.py)."""
+    from ..native import bls as native_bls
+
     if not s_inf:
         f_extra_ints = fq12.fp12_to_ints(
             miller_loop_batched(

@@ -254,3 +254,61 @@ print("sharded-set-agg-ok")
 """
     )
     assert "sharded-set-agg-ok" in out
+
+
+def test_sharded_batch_pairing_matches_host_verdicts():
+    """The mesh-sharded RLC batch pairing (parallel/pairing.py): an
+    UNEVEN set count (11 over 8 devices — one ragged lane per shard plus
+    padding) must accept a valid batch and reject a tampered one, and
+    `verify_signature_sets` with the pairing flag installed must route
+    through the sharded path to the same verdicts as the host batch;
+    VERDICT r2 item 5 (shard the signature batch over the mesh)."""
+    out = run_in_cpu_mesh(
+        """
+import jax
+jax.config.update("jax_enable_x64", True)
+from ethereum_consensus_tpu import ops
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.native import bls as native_bls
+from ethereum_consensus_tpu.parallel.mesh import chip_mesh
+from ethereum_consensus_tpu.parallel.pairing import batch_verify_sharded
+
+n = 11
+sks = [bls.SecretKey(i + 101) for i in range(n)]
+pk_raws, h_raws, sig_raws, scalars, sets = [], [], [], [], []
+for i, sk in enumerate(sks):
+    msg = b"m" * 31 + bytes([i])
+    sig = sk.sign(msg)
+    pk_raws.append(sk.public_key().raw_uncompressed())
+    rc, raw, _ = native_bls.g2_decompress(
+        native_bls.hash_to_g2_compressed(msg, bls.ETH_DST),
+        check_subgroup=False,
+    )
+    assert rc == 0
+    h_raws.append(raw)
+    sig_raws.append(sig.raw_uncompressed())
+    scalars.append(i * 7 + 3)
+    sets.append(bls.SignatureSet([sk.public_key()], msg, sig))
+
+mesh = chip_mesh()
+assert mesh.devices.size == 8
+assert batch_verify_sharded(pk_raws, h_raws, sig_raws, scalars, mesh=mesh)
+bad_sigs = list(sig_raws)
+bad_sigs[5] = sig_raws[6]
+assert not batch_verify_sharded(pk_raws, h_raws, bad_sigs, scalars, mesh=mesh)
+
+# end-to-end routing: verify_signature_sets -> sharded pairing
+ops.install(pairing_min_sets=1)
+try:
+    assert bls.verify_signature_sets(sets) == [True] * n
+    forged = list(sets)
+    forged[4] = bls.SignatureSet(
+        [sks[4].public_key()], b"f" * 32, sets[4].signature
+    )
+    assert bls.verify_signature_sets(forged) == [True] * 4 + [False] + [True] * 6
+finally:
+    ops.uninstall()
+print("sharded-pairing-ok")
+"""
+    )
+    assert "sharded-pairing-ok" in out
