@@ -556,7 +556,7 @@ def _batch_device_pairing(
     sets: list[SignatureSet], dst: bytes, scalars: list[bytes]
 ) -> "bool | None":
     """The device pairing route for the RLC batch: per-set pubkey
-    aggregation (host raw adds or already device-aggregated), native
+    aggregation as ONE segmented device fold (ops/g1.py), native
     hash_to_g2 per message, then blinder mults + N+1 Miller loops + the
     Fq12 product on device (ops/pairing.py) with the native final-exp
     verdict. None = device unusable, caller falls back; False verdicts
@@ -567,18 +567,23 @@ def _batch_device_pairing(
         return None
     try:
         pk_raws = []
-        for s in sets:
-            if len(s.public_keys) == 1:
-                pk_raws.append(s.public_keys[0].raw_uncompressed())
-            else:
-                raw, inf = s.public_keys[0].raw_uncompressed(), False
-                for pk in s.public_keys[1:]:
-                    raw, inf = native_bls.g1_add_raw(
-                        raw, inf, pk.raw_uncompressed(), False
-                    )
-                if inf:
-                    return False  # identity aggregate never verifies
-                pk_raws.append(raw)
+        if any(len(s.public_keys) > 1 for s in sets):
+            # multi-key sets: ONE segmented device fold aggregates every
+            # set at once (ops/g1.py) — the device owns the O(total keys)
+            # work; a serial host add loop here would cost O(keys) point
+            # adds before the device saw anything (512 for a sync
+            # aggregate, altair/block_processing.rs:192-243)
+            from ..ops import g1 as device_g1
+
+            agg = device_g1.aggregate_pubkey_sets_device(
+                [[pk.raw_uncompressed() for pk in s.public_keys]
+                 for s in sets]
+            )
+            if any(is_inf for _, is_inf in agg):
+                return False  # an identity aggregate never verifies
+            pk_raws = [raw for raw, _ in agg]
+        else:
+            pk_raws = [s.public_keys[0].raw_uncompressed() for s in sets]
         h_raws = []
         for s in sets:
             h_c = native_bls.hash_to_g2_compressed(s.message, dst)

@@ -31,6 +31,8 @@ invisible outside this package.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -104,12 +106,42 @@ def sub(a, b):
     return (a + jnp.asarray(SUB_PAD)) - b
 
 
+# Which product kernel `mont` runs: "u64" = the CIOS fori_loop below
+# (wide-integer lane products); "mxu" = the int8 digit matmul
+# (fq8.mont7r — the MXU's native int8×int8→int32 path). Same contract
+# either way; the switch exists because which one wins is a per-chip
+# hardware question (v5e emulates u64 lane products; see
+# docs/DEVICE_PAIRING.md and bench.py bench_pairing_device).
+_MULTIPLIER = os.environ.get("EC_PAIRING_MULT", "u64")
+
+
+def set_multiplier(kind: str) -> None:
+    """Switch the pairing-stack product kernel ("u64" | "mxu").
+
+    Clears every jit cache: compiled pairing traces bake the multiplier
+    in, so stale executables must not outlive the switch."""
+    global _MULTIPLIER
+    assert kind in ("u64", "mxu"), kind
+    if kind != _MULTIPLIER:
+        _MULTIPLIER = kind
+        jax.clear_caches()
+
+
+def get_multiplier() -> str:
+    return _MULTIPLIER
+
+
 def mont(a, b):
     """Montgomery product a·b·R'⁻¹ (mod p up to one multiple): inputs are
     redundant columns (< 2^24, value < 2^397), output has exact 16-bit
     columns and value < 1.1·p. 26 CIOS rounds under one `fori_loop`,
     carry-normalized by one scan — no comparisons, no conditional
-    subtraction."""
+    subtraction. With the "mxu" multiplier selected the same contract is
+    served by the int8 digit matmul instead (fq8.mont7r)."""
+    if _MULTIPLIER == "mxu":
+        from . import fq8
+
+        return fq8.mont7r(a, b)
     p64 = jnp.asarray(P_COLS)
     n0 = jnp.uint64(N0_INT)
     mask = jnp.uint64(MASK)

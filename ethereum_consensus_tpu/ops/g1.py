@@ -245,18 +245,13 @@ def aggregate_pubkey_sets_device(
     """S independent pubkey aggregations on device — the batch boundary of
     verify_signature_sets: one aggregation per signature set (attestation /
     sync aggregate), padded to the widest set with infinity, all folded in
-    one segmented kernel."""
-    if not raw_sets:
-        return []
-    widest = max(len(s) for s in raw_sets)
-    flat: list[bytes] = []
-    for s in raw_sets:
-        flat.extend(s)
-        flat.extend([b"\x00" * 96] * (widest - len(s)))
-    batch = points_from_raw(flat).reshape(len(raw_sets), widest, 3, fq.LIMBS)
-    sums = sum_points_segmented(batch)
-    # one batched Montgomery exit, then host-side affine conversion
-    canon = np.asarray(
-        fq.from_mont(sums.reshape(len(raw_sets) * 3, fq.LIMBS))
-    ).reshape(len(raw_sets), 3, fq.LIMBS)
-    return [_canonical_jacobian_to_raw(row) for row in canon]
+    one segmented kernel.
+
+    Runs over the LAZY field (ops/pairing.g1_sum_sets): identical sums,
+    but the fold compiles in seconds where this module's strict-field
+    kernels cost ~130s of cold XLA compile — the strict path stays for
+    the single huge sum (sum_points), whose one compile amortizes over
+    the 128k-point north-star batches."""
+    from . import pairing as _lazy
+
+    return _lazy.g1_sum_sets(raw_sets)

@@ -15,9 +15,11 @@ of the local Fq12 product by a validity column (slicing cannot cross
 shard boundaries), and padded signature lanes carry blinder 0, whose
 scalar multiple is the identity the branchless sum skips.
 
-Reference role: the multi-node work distribution of the reference's
-NCCL/MPI-backed batch verification, re-shaped onto XLA collectives
-(SURVEY.md §2.5); blst's pairing engine under crypto/bls.rs (C6).
+Reference role: blst's pairing engine under crypto/bls.rs (C6). The
+reference itself has NO distributed backend (SURVEY.md §2.5 — it is a
+single-process library); this mesh decomposition is the green-field
+TPU-native scale-out of its batch-verification semantics, not a port
+of any reference communication layer.
 """
 
 from __future__ import annotations

@@ -54,16 +54,47 @@ def run_in_cpu_mesh(
     n_devices: int = 8,
     timeout: int = 600,
     repo_root: str | None = None,
+    stream: bool = False,
 ) -> str:
     """Run ``code`` in a subprocess on the virtual CPU mesh; returns stdout.
 
-    Raises RuntimeError (with both streams) on nonzero exit.
+    With ``stream=True`` the child inherits this process's stdout so
+    per-stage progress reaches the caller's output LIVE (a kill at any
+    outer timeout still leaves the stages that ran on record); the
+    return value is then "". Raises RuntimeError (with captured streams
+    and the timeout) on nonzero exit or timeout.
     """
     if repo_root is None:
         repo_root = _default_repo_root()
+    env = cpu_mesh_env(n_devices, repo_root=repo_root)
+    if stream:
+        sys.stdout.flush()
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            env=env,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=repo_root,
+        )
+        try:
+            _, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+            raise RuntimeError(
+                f"cpu-mesh subprocess exceeded {timeout}s (stages that "
+                "completed are on stdout above)"
+            )
+        if proc.returncode != 0:
+            tail = "\n".join((err or "").splitlines()[-25:])
+            raise RuntimeError(
+                f"cpu-mesh subprocess failed (rc={proc.returncode}):\n"
+                f"stderr tail:\n{tail}"
+            )
+        return ""
     proc = subprocess.run(
         [sys.executable, "-c", code],
-        env=cpu_mesh_env(n_devices, repo_root=repo_root),
+        env=env,
         capture_output=True,
         text=True,
         timeout=timeout,

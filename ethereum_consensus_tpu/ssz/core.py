@@ -57,8 +57,16 @@ OFFSET_SIZE = 4
 MAX_LENGTH = 2**32  # offsets are u32
 
 
-class DeserializeError(ValueError):
-    """Malformed SSZ input."""
+from ..error import DeserializationError as _DeserializationError  # noqa: E402
+
+
+class DeserializeError(_DeserializationError, ValueError):
+    """Malformed SSZ input.
+
+    Part of BOTH hierarchies: the structured taxonomy
+    (``error.DeserializationError`` — the reference surfaces ssz_rs
+    failures through its Error enum, error.rs:15-33) and ``ValueError``
+    (the natural Python contract for malformed bytes)."""
 
 
 # ---------------------------------------------------------------------------

@@ -272,3 +272,76 @@ def test_fq7_true_int8_product_matches_fql():
         vb = sum(int(c) << (16 * i) for i, c in enumerate(np.asarray(b[n])))
         vp = sum(int(c) << (7 * i) for i, c in enumerate(cols[n]))
         assert vp == va * vb, n
+
+
+def test_mont7r_redundant_inputs_match_fql():
+    """mont7r — the routed MXU multiplier — takes the SAME redundant
+    inputs as fql.mont (uint64 columns < 2^24, values < ~2^397) and must
+    be column-exact against it; carry_norm must be value-exact."""
+    import jax.numpy as jnp
+
+    from ethereum_consensus_tpu.ops import fq8
+
+    rng = np.random.default_rng(17)
+    # redundant columns: up to 24 bits per column, values ~2^397
+    a = jnp.asarray(rng.integers(0, 1 << 24, size=(16, 24), dtype=np.uint64))
+    b = jnp.asarray(rng.integers(0, 1 << 24, size=(16, 24), dtype=np.uint64))
+    want = np.asarray(fql.mont(a, b))
+    got = np.asarray(fq8.mont7r(a, b))
+    assert (want == got).all()
+    # carry_norm: exact 16-bit columns preserving the integer value
+    norm = np.asarray(fq8.carry_norm(a))
+    assert (norm < (1 << 16)).all()
+    for n in range(4):
+        va = sum(int(c) << (16 * i) for i, c in enumerate(np.asarray(a[n])))
+        vn = sum(int(c) << (16 * i) for i, c in enumerate(norm[n]))
+        assert vn == va, n
+    # canonical inputs too (the common mont-output-to-mont-input case)
+    c = jnp.asarray(rng.integers(0, 1 << 16, size=(8, 24), dtype=np.uint64))
+    d = jnp.asarray(rng.integers(0, 1 << 16, size=(8, 24), dtype=np.uint64))
+    assert (np.asarray(fql.mont(c, d)) == np.asarray(fq8.mont7r(c, d))).all()
+
+
+def test_mxu_multiplier_pairing_parity(cpu_mesh):
+    """With EC_PAIRING_MULT=mxu the ENTIRE device pairing stack must
+    produce the same Miller product and batch verdicts as the u64 path —
+    run in a subprocess so the multiplier is set before any trace."""
+    out = cpu_mesh(
+        """
+import os
+os.environ["EC_PAIRING_MULT"] = "mxu"
+import secrets
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.native import bls as native_bls
+from ethereum_consensus_tpu.ops import fql, pairing
+
+assert fql.get_multiplier() == "mxu"
+sks = [bls.SecretKey(i + 31) for i in range(4)]
+pk_raws, h_raws, sig_raws = [], [], []
+for i, sk in enumerate(sks):
+    msg = b"q" * 31 + bytes([i])
+    sig = sk.sign(msg)
+    pk_raws.append(sk.public_key().raw_uncompressed())
+    rc, raw, _ = native_bls.g2_decompress(
+        native_bls.hash_to_g2_compressed(msg, bls.ETH_DST),
+        check_subgroup=False,
+    )
+    assert rc == 0
+    h_raws.append(raw)
+    sig_raws.append(sig.raw_uncompressed())
+scalars = [1, 5, 9, 13]
+assert pairing.batch_verify_device(pk_raws, h_raws, sig_raws, scalars)
+bad = list(sig_raws)
+bad[1], bad[2] = bad[2], bad[1]
+assert not pairing.batch_verify_device(pk_raws, h_raws, bad, scalars)
+print("mxu-pairing-ok")
+""",
+        n_devices=1,
+    )
+    assert "mxu-pairing-ok" in out
