@@ -256,6 +256,8 @@ def bench_large_agg(n_points: int = 1 << 16):
 
     if not native_bls.available():
         return {"error": "native backend unavailable"}
+    if _degraded():
+        return {"skipped": "cpu fallback: device-vs-native ratio is chip-only"}
     gen = native_bls.g1_generator_raw()
     base = []
     for i in range(512):
@@ -319,6 +321,22 @@ def bench_sig_128k(n_sigs: int = 1 << 17, distinct: int = 1 << 12):
     t0 = time.perf_counter()
     ok = bls.fast_aggregate_verify(all_pks, msg, agg)
     native_s = time.perf_counter() - t0
+
+    if _degraded():
+        # the device fold's strict-field kernels cost minutes of cold
+        # CPU compile for a number that only matters on the chip; the
+        # native figure above is the hardware-independent one
+        return {
+            "ok": bool(ok),
+            "signatures": n_sigs,
+            "distinct_keys": distinct,
+            "native_s": native_s,
+            "sigs_per_s_native": n_sigs / native_s,
+            "device_routed_s": None,
+            "device_skipped": "cpu fallback: device fold is chip-only",
+            "baseline_kind": "native-cpp single-core (this repo)",
+            "blst_class_estimate_s": round(n_sigs * 5e-7 + 0.0015, 3),
+        }
 
     # device-routed aggregation variant (the segmented G1 fold)
     from ethereum_consensus_tpu import ops
