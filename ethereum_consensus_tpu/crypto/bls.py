@@ -147,6 +147,21 @@ class SecretKey:
     __hash__ = None
 
 
+# process-wide decompressed-pubkey cache (FIFO eviction): compressed48 →
+# affine raw96 of a VALID key. Entries enter ONLY from from_bytes'
+# subgroup-checked, identity-rejecting decompression, so a hit proves
+# validity; raw_uncompressed (which skips the subgroup check and accepts
+# identity aggregates) reads but never writes it. ~15MB at capacity.
+_RAW_PK_CACHE: "dict[bytes, bytes]" = {}
+_RAW_PK_CACHE_MAX = 1 << 16
+
+
+def _pk_cache_put(data: bytes, raw: bytes) -> None:
+    if len(_RAW_PK_CACHE) >= _RAW_PK_CACHE_MAX:
+        _RAW_PK_CACHE.pop(next(iter(_RAW_PK_CACHE)))
+    _RAW_PK_CACHE[data] = raw
+
+
 class PublicKey:
     """G1 point, 48-byte compressed. Infinity is rejected at parse time
     (blst key_validate semantics); an *aggregate* of valid keys may still
@@ -175,14 +190,26 @@ class PublicKey:
 
     def raw_uncompressed(self) -> bytes:
         """Affine x||y (96 bytes, big-endian), decompressed once and
-        cached. Native backend only (callers gate on it)."""
+        cached — on the instance AND in the process-wide LRU keyed by
+        compressed bytes, because the chain workload rebuilds PublicKey
+        objects from state bytes every block for the SAME validators.
+        Native backend only (callers gate on it)."""
         if self._raw is None:
+            data = self.to_bytes()
+            hit = _RAW_PK_CACHE.get(data)
+            if hit is not None:
+                self._raw = hit
+                return hit
             rc, raw, is_inf = native_bls.g1_decompress(
-                self.to_bytes(), check_subgroup=False
+                data, check_subgroup=False
             )
             if rc != 0:
                 raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
             self._raw = b"\x00" * 96 if is_inf else raw
+            # deliberately NOT inserted into _RAW_PK_CACHE: this path
+            # skips the subgroup check and accepts identity (aggregate
+            # results are legitimately reachable here), so its entries
+            # must never satisfy from_bytes' validation
         return self._raw
 
     @classmethod
@@ -193,12 +220,21 @@ class PublicKey:
                 f"public key must be {PUBLIC_KEY_SIZE} bytes, got {len(data)}"
             )
         if _native():
-            rc, _raw, is_inf = native_bls.g1_decompress(data, check_subgroup=True)
+            cached_raw = _RAW_PK_CACHE.get(data)
+            if cached_raw is not None:
+                # a cache hit was subgroup-checked when it entered
+                self = cls._from_valid_bytes(data)
+                self._raw = cached_raw
+                return self
+            rc, raw, is_inf = native_bls.g1_decompress(data, check_subgroup=True)
             if rc != 0:
                 raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
             if is_inf:
                 raise InvalidPublicKeyError("public key cannot be the identity")
-            return cls._from_valid_bytes(data)
+            self = cls._from_valid_bytes(data)
+            self._raw = raw
+            _pk_cache_put(data, raw)
+            return self
         try:
             point = G1Point.deserialize(data)
         except InvalidPointError as exc:

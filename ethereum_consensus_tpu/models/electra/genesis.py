@@ -58,6 +58,9 @@ def initialize_beacon_state_from_eth1(
         if validator.effective_balance >= context.MIN_ACTIVATION_BALANCE:
             validator.activation_eligibility_epoch = GENESIS_EPOCH
             validator.activation_epoch = GENESIS_EPOCH
+    # direct current-epoch activation is unique to genesis: drop the
+    # (future-epoch-mutation-invariant) active-set cache it violates
+    state.__dict__.pop("_active_idx_cache", None)
 
     state.genesis_validators_root = type(state).__ssz_fields__[
         "validators"

@@ -194,11 +194,48 @@ def compute_shuffled_indices(indices: list[int], seed: bytes, context) -> list[i
 
 
 # full shuffle-result LRU — committee lookups hit the same seed for every
-# committee of an epoch, so one device shuffle serves them all. Keyed by
-# (seed, round count, digest of the index list) so differing presets or
-# active sets can never alias.
+# committee of an epoch, so one whole-list shuffle (device kernel or the
+# vectorized host map below) serves them all. Keyed by (seed, round count,
+# digest of the index list) so differing presets or active sets can never
+# alias.
 _SHUFFLE_CACHE: dict = {}
 _SHUFFLE_CACHE_MAX = 4
+
+# Host whole-list threshold: below this the per-index map is cheaper than
+# building (and caching) the full permutation.
+HOST_SHUFFLE_MIN_N = 256
+
+
+def compute_shuffled_indices_vectorized(
+    indices: list[int], seed: bytes, context
+) -> list[int]:
+    """The per-index swap-or-not map for ALL indices at once as numpy
+    column ops: result[i] == indices[compute_shuffled_index(i, n, seed)]
+    bit-for-bit, with ~rounds·(1 + n/256) digests instead of rounds·n —
+    the host twin of the device kernel (ops/shuffle.py), playing the
+    role of the reference's `shuffling` optimized feature
+    (helpers.rs:287)."""
+    import numpy as _np
+
+    n = len(indices)
+    if n == 0:
+        return []
+    idx = _np.arange(n, dtype=_np.int64)
+    n_chunks = ((n - 1) >> 8) + 1
+    for round_ in range(context.SHUFFLE_ROUND_COUNT):
+        round_byte = round_.to_bytes(1, "little")
+        pivot = int.from_bytes(_sha256(seed + round_byte)[:8], "little") % n
+        flip = (pivot + n - idx) % n
+        pos = _np.maximum(idx, flip)
+        blob = b"".join(
+            _sha256(seed + round_byte + chunk.to_bytes(4, "little"))
+            for chunk in range(n_chunks)
+        )
+        source = _np.frombuffer(blob, dtype=_np.uint8)
+        bit = (source[pos >> 3] >> (pos & 7).astype(_np.uint8)) & 1
+        idx = _np.where(bit.astype(bool), flip, idx)
+    arr = _np.asarray(indices, dtype=_np.int64)
+    return arr[idx].tolist()
 
 
 def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
@@ -208,9 +245,12 @@ def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
     key = (seed, context.SHUFFLE_ROUND_COUNT, digest)
     hit = _SHUFFLE_CACHE.get(key)
     if hit is None:
-        from ...ops.shuffle import compute_shuffled_indices_device
+        if _device_flags.shuffle_enabled(len(indices)):
+            from ...ops.shuffle import compute_shuffled_indices_device
 
-        hit = compute_shuffled_indices_device(indices, seed, context)
+            hit = compute_shuffled_indices_device(indices, seed, context)
+        else:
+            hit = compute_shuffled_indices_vectorized(indices, seed, context)
         if len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
             _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
         _SHUFFLE_CACHE[key] = hit
@@ -221,13 +261,15 @@ def compute_committee(
     indices: list[int], seed: bytes, index: int, count: int, context
 ) -> list[int]:
     """Slice ``index``/``count`` of the shuffled active set (spec
-    compute_committee). Above the installed threshold the whole active set
-    is shuffled once on device (ops/shuffle.py, bit-identical to the
-    per-index map) and cached per seed, so every committee of the epoch
-    reuses one kernel run."""
+    compute_committee). Above HOST_SHUFFLE_MIN_N the whole active set is
+    shuffled once — on device when installed (ops/shuffle.py), else via
+    the vectorized host map — and cached per seed, so every committee of
+    the epoch reuses one permutation."""
     start = len(indices) * index // count
     end = len(indices) * (index + 1) // count
-    if _device_flags.shuffle_enabled(len(indices)):
+    if len(indices) >= HOST_SHUFFLE_MIN_N or _device_flags.shuffle_enabled(
+        len(indices)
+    ):
         return _shuffled_active_set(indices, seed, context)[start:end]
     return [
         indices[compute_shuffled_index(i, len(indices), seed, context)]
@@ -252,9 +294,26 @@ def compute_proposer_index(state, indices: list[int], seed: bytes, context) -> i
 
 
 def get_active_validator_indices(state, epoch: int) -> list[int]:
-    return [
+    """Active-validator index list, cached on the state per
+    (epoch, registry length).
+
+    Soundness: every spec mutation of the activity schedule targets a
+    FUTURE epoch — `compute_activation_exit_epoch` is ≥ epoch+1+lookahead
+    for both activations (registry updates) and exits/ejections
+    (`initiate_validator_exit`), and slashing leaves activity unchanged —
+    so within one (epoch, registry-length) window the active set is
+    constant. Deposits append validators with far-future activation,
+    changing the length key. (helpers.rs has no such cache; the sweep is
+    free in Rust and 8k-element Python loops are not.)"""
+    cached = state.__dict__.get("_active_idx_cache")
+    key = (epoch, len(state.validators))
+    if cached is not None and cached[0] == key:
+        return list(cached[1])  # fresh list: callers may sort/mutate
+    out = [
         i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
     ]
+    state.__dict__["_active_idx_cache"] = (key, out)
+    return list(out)
 
 
 def get_validator_churn_limit(state, context) -> int:

@@ -406,6 +406,84 @@ def _deserialize_homogeneous(elem: SSZType, data: bytes, count: int | None) -> l
     return values
 
 
+class CachedRootList(list):
+    """A list that carries per-descriptor hash_tree_root caches, cleared
+    by every mutating method. Containers wrap their plain-list field
+    values in this (constructor, setattr, copy), so the big immutable-
+    element collections of a BeaconState — randao_mixes (65,536 chunks
+    mainnet), block_roots/state_roots (8,192), balances, slashings —
+    merkleize once per mutation instead of once per hash_tree_root call
+    (3-4 full-state roots per block, phase0/slot_processing.rs:45).
+
+    The cache is CONSULTED only for collections whose elements are
+    immutable values (uints, booleans, byte vectors): a list of
+    containers can mutate through an element without touching the list,
+    so those never populate it. NOTE: wrapping copies the caller's list
+    — a detached alias of the original plain list no longer writes
+    through (spec code always mutates via ``state.field[...]``, which is
+    instrumented)."""
+
+    __slots__ = ("_root_cache",)
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self._root_cache: dict = {}
+
+    def _invalidate(self):
+        self._root_cache.clear()
+
+    def __reduce__(self):
+        # pickle as a plain rebuild (fresh empty cache on restore)
+        return (type(self), (list(self),))
+
+
+def _instrument(name):
+    base = getattr(list, name)
+
+    def method(self, *args, **kwargs):
+        self._root_cache.clear()
+        return base(self, *args, **kwargs)
+
+    method.__name__ = name
+    return method
+
+
+for _name in (
+    "__setitem__",
+    "__delitem__",
+    "__iadd__",
+    "__imul__",
+    "append",
+    "extend",
+    "insert",
+    "pop",
+    "remove",
+    "clear",
+    "sort",
+    "reverse",
+):
+    setattr(CachedRootList, _name, _instrument(_name))
+del _name
+
+
+def _cacheable_elem(elem: SSZType) -> bool:
+    """Element TYPES whose canonical values are immutable ⇒ the
+    list-level root cache can engage (values still re-checked at store
+    time by _cacheable_values)."""
+    return isinstance(elem, (_UintType, _BooleanType, ByteVector))
+
+
+def _cacheable_values(elem: SSZType, values: list) -> bool:
+    """Store-time guard matching the container cache's: a bytearray in a
+    ByteVector slot could mutate in place without passing through any
+    instrumented CachedRootList method, so only all-`bytes` collections
+    may cache. Uint/boolean values are ints/bools (immutable) — their
+    lists always qualify."""
+    if isinstance(elem, ByteVector):
+        return all(type(v) is bytes for v in values)
+    return True
+
+
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
         if (
@@ -473,6 +551,13 @@ class Vector(_Parametrized, SSZType):
     def hash_tree_root(self, value: list) -> bytes:
         if len(value) != self.length:
             raise ValueError(f"{self!r}: expected {self.length} elements, got {len(value)}")
+        if isinstance(value, CachedRootList) and _cacheable_elem(self.elem):
+            hit = value._root_cache.get(self)
+            if hit is None:
+                hit = _merkleize_homogeneous(self.elem, value, self.length)
+                if _cacheable_values(self.elem, value):
+                    value._root_cache[self] = hit
+            return hit
         return _merkleize_homogeneous(self.elem, value, self.length)
 
     def chunk_count(self) -> int:
@@ -517,6 +602,16 @@ class List(_Parametrized, SSZType):
     def hash_tree_root(self, value: list) -> bytes:
         if len(value) > self.limit:
             raise ValueError(f"{self!r}: {len(value)} elements exceeds limit")
+        if isinstance(value, CachedRootList) and _cacheable_elem(self.elem):
+            hit = value._root_cache.get(self)
+            if hit is None:
+                hit = mix_in_length(
+                    _merkleize_homogeneous(self.elem, value, self.limit),
+                    len(value),
+                )
+                if _cacheable_values(self.elem, value):
+                    value._root_cache[self] = hit
+            return hit
         root = _merkleize_homogeneous(self.elem, value, self.limit)
         return mix_in_length(root, len(value))
 
@@ -713,15 +808,19 @@ class Container(metaclass=_ContainerMeta):
             if key not in fields:
                 raise TypeError(f"{type(self).__name__} has no field {key!r}")
         for key, typ in fields.items():
-            object.__setattr__(
-                self, key, kwargs[key] if key in kwargs else typ.default()
-            )
+            value = kwargs[key] if key in kwargs else typ.default()
+            if type(value) is list:
+                value = CachedRootList(value)
+            object.__setattr__(self, key, value)
 
     # -- python niceties ----------------------------------------------------
     def __setattr__(self, key, value):
         # any field write invalidates the cached root (scalar-leaf
-        # containers only pay a dict pop; others never populate it)
+        # containers only pay a dict pop; others never populate it);
+        # plain-list values wrap into the root-caching list
         self.__dict__.pop("_htr_cache", None)
+        if type(value) is list:
+            value = CachedRootList(value)
         object.__setattr__(self, key, value)
 
     def __eq__(self, other) -> bool:
@@ -743,11 +842,21 @@ class Container(metaclass=_ContainerMeta):
         return f"{type(self).__name__}({inner}{more})"
 
     def copy(self):
-        """Deep structural copy (lists copied, nested containers copied)."""
+        """Deep structural copy (lists copied, nested containers copied).
+
+        A cached hash_tree_root travels with the copy: field values are
+        identical so the root is identical, and any later field write on
+        either object invalidates its own cache (__setattr__). Without
+        this, copying a state forced a full registry rehash — ~0.9s of
+        the mainnet block benchmark."""
         out = {}
         for key, typ in type(self).__ssz_fields__.items():
             out[key] = _copy_value(typ, getattr(self, key))
-        return type(self)(**out)
+        new = type(self)(**out)
+        cached = self.__dict__.get("_htr_cache")
+        if cached is not None:
+            new.__dict__["_htr_cache"] = cached
+        return new
 
     # -- SSZType protocol (classmethods) ------------------------------------
     @classmethod
@@ -904,8 +1013,15 @@ def _copy_value(typ: SSZType, value: Any):
     if isinstance(value, list):
         elem = getattr(typ, "elem", None)
         if elem is not None and not _is_basic(elem):
-            return [_copy_value(elem, v) for v in value]
-        return list(value)
+            copied = CachedRootList(_copy_value(elem, v) for v in value)
+        else:
+            copied = CachedRootList(value)
+        # identical values ⇒ identical roots: the cache (only ever
+        # populated for immutable-element collections) travels with the
+        # copy; mutations on either side clear their own
+        if isinstance(value, CachedRootList):
+            copied._root_cache = dict(value._root_cache)
+        return copied
     return value
 
 

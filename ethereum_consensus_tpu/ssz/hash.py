@@ -74,12 +74,33 @@ def register_native_hasher(fn: Callable[[bytes], bytes]) -> None:
     _native_hasher = fn
 
 
+# The native path self-installs on the first level big enough to use it
+# (one attempt; the on-demand C++ build is disk-cached). Before round 4
+# it required an explicit native.install(), which no default path made —
+# so whole-state merkleization ran on hashlib (534k digests per mainnet
+# block, ~40% of block wall-clock).
+_native_attempted = False
+
+
 def hash_level(nodes: bytes) -> bytes:
     """Hash one merkle level, routing to the fastest registered backend:
     device for huge levels, native C++ for medium, hashlib otherwise."""
+    global _native_attempted
     n = len(nodes) // 64
     if _device_hasher is not None and n >= DEVICE_MIN_NODES:
         return _device_hasher(nodes)
+    if (
+        _native_hasher is None
+        and not _native_attempted
+        and n >= NATIVE_MIN_NODES
+    ):
+        _native_attempted = True
+        try:
+            from .. import native
+
+            native.install()
+        except Exception:  # noqa: BLE001 — no toolchain, keep hashlib
+            pass
     if _native_hasher is not None and n >= NATIVE_MIN_NODES:
         return _native_hasher(nodes)
     return hash_level_host(nodes)

@@ -1,0 +1,60 @@
+"""Pinned consensus-spec-tests vector fetcher (stdlib-only).
+
+The reference pins release v1.4.0 and downloads the three official
+tarballs with a justfile (spec-tests/justfile:3-15,
+spec-tests/spec-test-version:1). This is the same recipe as a script:
+
+    python -m spec_tests.download_vectors [dest_dir]
+
+then run the harness against the checkout:
+
+    SPEC_TEST_ROOT=<dest_dir> python -m spec_tests
+    SPEC_TEST_ROOT=<dest_dir> python -m pytest tests/test_spec_harness.py \
+        -k official -q
+
+This build environment has zero network egress, so the corpus cannot be
+vendored here — the script exists so that parity against the official
+vectors is one command wherever the network exists.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tarfile
+import urllib.request
+
+VERSION = "v1.4.0"  # spec-tests/spec-test-version:1
+TARBALLS = ("general", "minimal", "mainnet")
+URL = (
+    "https://github.com/ethereum/consensus-spec-tests/releases/download/"
+    "{version}/{name}.tar.gz"
+)
+
+
+def download(dest: str = "consensus-spec-tests", version: str = VERSION) -> str:
+    os.makedirs(dest, exist_ok=True)
+    for name in TARBALLS:
+        url = URL.format(version=version, name=name)
+        path = os.path.join(dest, f"{name}.tar.gz")
+        if not os.path.exists(path):
+            print(f"downloading {url}", file=sys.stderr)
+            urllib.request.urlretrieve(url, path)  # noqa: S310 — pinned https URL
+        print(f"extracting {path}", file=sys.stderr)
+        with tarfile.open(path) as tar:
+            tar.extractall(dest, filter="data")
+    tests_dir = os.path.join(dest, "tests")
+    if not os.path.isdir(tests_dir):
+        raise RuntimeError(f"extraction produced no {tests_dir}")
+    return dest
+
+
+def main() -> int:
+    dest = sys.argv[1] if len(sys.argv) > 1 else "consensus-spec-tests"
+    root = download(dest)
+    print(f"vectors ready: SPEC_TEST_ROOT={root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

