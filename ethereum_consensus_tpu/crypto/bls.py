@@ -190,10 +190,11 @@ class PublicKey:
 
     def raw_uncompressed(self) -> bytes:
         """Affine x||y (96 bytes, big-endian), decompressed once and
-        cached — on the instance AND in the process-wide LRU keyed by
-        compressed bytes, because the chain workload rebuilds PublicKey
-        objects from state bytes every block for the SAME validators.
-        Native backend only (callers gate on it)."""
+        cached — on the instance, consulting the process-wide
+        FIFO-evicted cache keyed by compressed bytes, because the chain
+        workload rebuilds PublicKey objects from state bytes every block
+        for the SAME validators. Native backend only (callers gate on
+        it)."""
         if self._raw is None:
             data = self.to_bytes()
             hit = _RAW_PK_CACHE.get(data)

@@ -524,6 +524,19 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         chunks = b"".join(values)
         return merkleize_chunks(chunks, limit=limit_elems)
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
+    if isinstance(values, CachedRootList):
+        # container-element lists (the validator registry) can't cache a
+        # root blindly — an element can mutate without touching the list
+        # — but the JOINED leaf roots reflect any such mutation (element
+        # roots are instance-cached with setattr invalidation), so a
+        # (chunks, root) memo keyed on the exact leaf bytes is sound: a
+        # 256KB memcmp replaces the ~16k-hash tree rebuild per state root
+        memo = values._root_cache.get(("tree", elem, limit_elems))
+        if memo is not None and memo[0] == chunks:
+            return memo[1]
+        root = merkleize_chunks(chunks, limit=limit_elems)
+        values._root_cache[("tree", elem, limit_elems)] = (chunks, root)
+        return root
     return merkleize_chunks(chunks, limit=limit_elems)
 
 

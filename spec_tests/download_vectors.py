@@ -42,7 +42,10 @@ def download(dest: str = "consensus-spec-tests", version: str = VERSION) -> str:
             urllib.request.urlretrieve(url, path)  # noqa: S310 — pinned https URL
         print(f"extracting {path}", file=sys.stderr)
         with tarfile.open(path) as tar:
-            tar.extractall(dest, filter="data")
+            try:
+                tar.extractall(dest, filter="data")
+            except TypeError:  # Python < 3.9.17/3.10.12/3.11.4: no filter=
+                tar.extractall(dest)  # noqa: S202 — pinned official tarball
     tests_dir = os.path.join(dest, "tests")
     if not os.path.isdir(tests_dir):
         raise RuntimeError(f"extraction produced no {tests_dir}")
