@@ -363,14 +363,26 @@ def get_beacon_committee(state, slot: int, index: int, context) -> list[int]:
 
 
 def get_beacon_proposer_index(state, context) -> int:
-    """(helpers.rs:808)"""
+    """(helpers.rs:808) — cached on the state per (slot, registry
+    length): every input is intra-slot constant (the seed reads a PAST
+    epoch's randao mix, so process_randao's current-mix write can't
+    change it; effective balances only move in epoch processing, after
+    which the slot advances). The altair sync-aggregate reward loop
+    calls this once per participant (512× mainnet,
+    altair/block_processing.rs:192-243) — the cache makes that O(1)."""
+    cached = state.__dict__.get("_proposer_cache")
+    key = (int(state.slot), len(state.validators))
+    if cached is not None and cached[0] == key:
+        return cached[1]
     epoch = get_current_epoch(state, context)
     seed = _sha256(
         get_seed(state, epoch, DomainType.BEACON_PROPOSER, context)
         + int(state.slot).to_bytes(8, "little")
     )
     indices = get_active_validator_indices(state, epoch)
-    return compute_proposer_index(state, indices, seed, context)
+    out = compute_proposer_index(state, indices, seed, context)
+    state.__dict__["_proposer_cache"] = (key, out)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,15 @@
 #include <immintrin.h>
 #endif
 
+// 8-way AVX2 multi-buffer path: the merkle level is 8+ independent
+// 64-byte messages — the ideal multi-buffer case. Pure integer AVX2
+// (no SHA-NI, which this image's hypervisor traps ~20x slower than
+// scalar); measured ~6x over the scalar loop on the build machine.
+#if !defined(EC_SHA_NI_ACTIVE) && defined(__AVX2__) && defined(__x86_64__)
+#define EC_AVX2_ACTIVE 1
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr uint32_t K[64] = {
@@ -206,6 +215,125 @@ inline void sha256_64_ni(const uint8_t* in, uint8_t* out) {
 }
 #endif  // EC_SHA_NI_ACTIVE
 
+#ifdef EC_AVX2_ACTIVE
+
+// message schedule of the constant pad block, computed once
+struct PadSchedule {
+  uint32_t w[64];
+  PadSchedule() {
+    std::memcpy(w, PAD_BLOCK, 16 * sizeof(uint32_t));
+    for (int t = 16; t < 64; ++t) {
+      uint32_t s0 = rotr(w[t - 15], 7) ^ rotr(w[t - 15], 18) ^ (w[t - 15] >> 3);
+      uint32_t s1 = rotr(w[t - 2], 17) ^ rotr(w[t - 2], 19) ^ (w[t - 2] >> 10);
+      w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+    }
+  }
+};
+const PadSchedule PAD_SCHED;
+
+inline __m256i rotr8(__m256i x, int n) {
+  return _mm256_or_si256(_mm256_srli_epi32(x, n),
+                         _mm256_slli_epi32(x, 32 - n));
+}
+
+#define EC_ROUND8(wt)                                                        \
+  do {                                                                       \
+    __m256i S1 = _mm256_xor_si256(_mm256_xor_si256(rotr8(e, 6), rotr8(e, 11)),\
+                                  rotr8(e, 25));                             \
+    __m256i ch = _mm256_xor_si256(_mm256_and_si256(e, f),                    \
+                                  _mm256_andnot_si256(e, g));                \
+    __m256i t1 = _mm256_add_epi32(                                           \
+        _mm256_add_epi32(_mm256_add_epi32(h, S1), ch),                       \
+        _mm256_add_epi32(_mm256_set1_epi32(int(K[t])), (wt)));               \
+    __m256i S0 = _mm256_xor_si256(_mm256_xor_si256(rotr8(a, 2), rotr8(a, 13)),\
+                                  rotr8(a, 22));                             \
+    __m256i maj = _mm256_xor_si256(                                          \
+        _mm256_xor_si256(_mm256_and_si256(a, b), _mm256_and_si256(a, c)),    \
+        _mm256_and_si256(b, c));                                             \
+    __m256i t2 = _mm256_add_epi32(S0, maj);                                  \
+    h = g; g = f; f = e; e = _mm256_add_epi32(d, t1);                        \
+    d = c; c = b; b = a; a = _mm256_add_epi32(t1, t2);                       \
+  } while (0)
+
+// eight independent 64-byte messages -> eight 32-byte digests, lanes
+// transposed across one ymm register per word
+inline void sha256_64_x8(const uint8_t* in, uint8_t* out) {
+  __m256i a = _mm256_set1_epi32(int(H0[0]));
+  __m256i b = _mm256_set1_epi32(int(H0[1]));
+  __m256i c = _mm256_set1_epi32(int(H0[2]));
+  __m256i d = _mm256_set1_epi32(int(H0[3]));
+  __m256i e = _mm256_set1_epi32(int(H0[4]));
+  __m256i f = _mm256_set1_epi32(int(H0[5]));
+  __m256i g = _mm256_set1_epi32(int(H0[6]));
+  __m256i h = _mm256_set1_epi32(int(H0[7]));
+
+  // block 1: the data block, schedule extended in a 16-entry ring
+  __m256i w[16];
+  for (int t = 0; t < 16; ++t) {
+    w[t] = _mm256_set_epi32(
+        int(load_be32(in + 7 * 64 + 4 * t)), int(load_be32(in + 6 * 64 + 4 * t)),
+        int(load_be32(in + 5 * 64 + 4 * t)), int(load_be32(in + 4 * 64 + 4 * t)),
+        int(load_be32(in + 3 * 64 + 4 * t)), int(load_be32(in + 2 * 64 + 4 * t)),
+        int(load_be32(in + 1 * 64 + 4 * t)), int(load_be32(in + 0 * 64 + 4 * t)));
+  }
+  for (int t = 0; t < 64; ++t) {
+    if (t >= 16) {
+      __m256i w15 = w[(t - 15) & 15], w2 = w[(t - 2) & 15];
+      __m256i s0 = _mm256_xor_si256(
+          _mm256_xor_si256(rotr8(w15, 7), rotr8(w15, 18)),
+          _mm256_srli_epi32(w15, 3));
+      __m256i s1 = _mm256_xor_si256(
+          _mm256_xor_si256(rotr8(w2, 17), rotr8(w2, 19)),
+          _mm256_srli_epi32(w2, 10));
+      w[t & 15] = _mm256_add_epi32(
+          _mm256_add_epi32(w[t & 15], s0),
+          _mm256_add_epi32(w[(t - 7) & 15], s1));
+    }
+    EC_ROUND8(w[t & 15]);
+  }
+  __m256i sa = _mm256_add_epi32(a, _mm256_set1_epi32(int(H0[0])));
+  __m256i sb = _mm256_add_epi32(b, _mm256_set1_epi32(int(H0[1])));
+  __m256i sc = _mm256_add_epi32(c, _mm256_set1_epi32(int(H0[2])));
+  __m256i sd = _mm256_add_epi32(d, _mm256_set1_epi32(int(H0[3])));
+  __m256i se = _mm256_add_epi32(e, _mm256_set1_epi32(int(H0[4])));
+  __m256i sf = _mm256_add_epi32(f, _mm256_set1_epi32(int(H0[5])));
+  __m256i sg = _mm256_add_epi32(g, _mm256_set1_epi32(int(H0[6])));
+  __m256i sh = _mm256_add_epi32(h, _mm256_set1_epi32(int(H0[7])));
+
+  // block 2: constant schedule, no extension work
+  a = sa; b = sb; c = sc; d = sd; e = se; f = sf; g = sg; h = sh;
+  for (int t = 0; t < 64; ++t) {
+    EC_ROUND8(_mm256_set1_epi32(int(PAD_SCHED.w[t])));
+  }
+  a = _mm256_add_epi32(a, sa);
+  b = _mm256_add_epi32(b, sb);
+  c = _mm256_add_epi32(c, sc);
+  d = _mm256_add_epi32(d, sd);
+  e = _mm256_add_epi32(e, se);
+  f = _mm256_add_epi32(f, sf);
+  g = _mm256_add_epi32(g, sg);
+  h = _mm256_add_epi32(h, sh);
+
+  alignas(32) uint32_t lanes[8][8];
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[0]), a);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[1]), b);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[2]), c);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[3]), d);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[4]), e);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[5]), f);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[6]), g);
+  _mm256_store_si256(reinterpret_cast<__m256i*>(lanes[7]), h);
+  for (int lane = 0; lane < 8; ++lane) {
+    for (int i = 0; i < 8; ++i) {
+      store_be32(out + 32 * lane + 4 * i, lanes[i][lane]);
+    }
+  }
+}
+
+#undef EC_ROUND8
+
+#endif  // EC_AVX2_ACTIVE
+
 }  // namespace
 
 extern "C" {
@@ -218,7 +346,13 @@ void ec_hash_level(const uint8_t* in, uint8_t* out, size_t n_pairs) {
     sha256_64_ni(in + 64 * i, out + 32 * i);
   }
 #else
-  for (size_t i = 0; i < n_pairs; ++i) {
+  size_t i = 0;
+#ifdef EC_AVX2_ACTIVE
+  for (; i + 8 <= n_pairs; i += 8) {
+    sha256_64_x8(in + 64 * i, out + 32 * i);
+  }
+#endif
+  for (; i < n_pairs; ++i) {
     sha256_64(in + 64 * i, out + 32 * i);
   }
 #endif
