@@ -21,10 +21,13 @@ import os
 
 import pytest
 
-# Recorded full-shape evidence run (round 4, virtual 8-device CPU mesh):
-#   2^14 valid:    True  — see EC_SCALE_TESTS gate below
-#   2^14 tampered: False
-# executed via the same code path as test_sharded_pairing_north_star.
+# Recorded full-shape evidence run (round 4, virtual 8-device CPU mesh,
+# executed via the same construction as the gated test below):
+#   2^14 valid:    True  in 3315s
+#   2^14 tampered: False in 3183s
+# (CPU Miller loops, effectively one core — the virtual mesh validates
+# shape-correctness; an 8-chip TPU mesh divides the lane work 8 ways and
+# runs each lane's field ops on the MXU instead of emulated u64 ALU.)
 
 _SCALE = bool(os.environ.get("EC_SCALE_TESTS"))
 

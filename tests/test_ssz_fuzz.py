@@ -1,0 +1,139 @@
+"""Property-based SSZ codec tests (hypothesis).
+
+The reference leans on the official ssz_static/ssz_generic corpora for
+codec hardening; offline, randomized properties fill part of that gap:
+
+* serialize → deserialize is the identity on valid values;
+* hash_tree_root is deterministic and equals the root of the decoded
+  value (root is a function of the VALUE, not the object);
+* random corruption of an encoding either decodes to a value that
+  re-encodes differently (content change) or raises DeserializeError —
+  never crashes with anything else, never silently round-trips to the
+  original bytes with a different value.
+"""
+
+import secrets
+
+import pytest
+
+pytest.importorskip("hypothesis")  # baked into this image; optional elsewhere
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+from ethereum_consensus_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    uint8,
+    uint16,
+    uint64,
+)
+from ethereum_consensus_tpu.ssz.core import DeserializeError
+
+
+class Inner(Container):
+    a: uint64
+    b: Vector[uint8, 3]
+
+
+class Outer(Container):
+    tag: uint16
+    items: List[uint64, 64]
+    inner: Inner
+    bits: Bitlist[40]
+    flags: Bitvector[9]
+    blob: List[uint8, 50]
+    root: ByteVector[32]
+
+
+def _outer_strategy():
+    return st.builds(
+        lambda tag, items, a, b, bits, flags, blob, root: Outer(
+            tag=tag,
+            items=items,
+            inner=Inner(a=a, b=b),
+            bits=bits,
+            flags=flags,
+            blob=blob,
+            root=root,
+        ),
+        tag=st.integers(0, 2**16 - 1),
+        items=st.lists(st.integers(0, 2**64 - 1), max_size=64),
+        a=st.integers(0, 2**64 - 1),
+        b=st.lists(st.integers(0, 255), min_size=3, max_size=3),
+        bits=st.lists(st.booleans(), max_size=40),
+        flags=st.lists(st.booleans(), min_size=9, max_size=9),
+        blob=st.lists(st.integers(0, 255), max_size=50),
+        root=st.binary(min_size=32, max_size=32),
+    )
+
+
+@settings(max_examples=80, deadline=None)
+@given(_outer_strategy())
+def test_roundtrip_identity(value):
+    enc = Outer.serialize(value)
+    back = Outer.deserialize(enc)
+    assert back == value
+    assert Outer.serialize(back) == enc
+
+
+@settings(max_examples=80, deadline=None)
+@given(_outer_strategy())
+def test_root_is_value_function(value):
+    r1 = Outer.hash_tree_root(value)
+    r2 = Outer.hash_tree_root(Outer.deserialize(Outer.serialize(value)))
+    assert r1 == r2
+    # mutating any scalar must change the root
+    value.tag = (int(value.tag) + 1) % 2**16
+    assert Outer.hash_tree_root(value) != r1
+
+
+@settings(max_examples=120, deadline=None)
+@given(_outer_strategy(), st.data())
+def test_corruption_never_crashes_or_aliases(value, data):
+    enc = Outer.serialize(value)
+    pos = data.draw(st.integers(0, len(enc) - 1))
+    bit = data.draw(st.integers(0, 7))
+    corrupted = bytearray(enc)
+    corrupted[pos] ^= 1 << bit
+    corrupted = bytes(corrupted)
+    try:
+        back = Outer.deserialize(corrupted)
+    except DeserializeError:
+        return  # structured rejection is a valid outcome
+    # decoded: a corrupted encoding must never decode to the ORIGINAL
+    # value (two distinct encodings of indistinguishable values would be
+    # an alias/malleability bug)
+    assert back != value, "corrupted encoding decoded to the original value"
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.binary(max_size=200))
+def test_random_bytes_never_crash(blob):
+    try:
+        Outer.deserialize(blob)
+    except DeserializeError:
+        pass
+
+
+def test_truncation_sweep():
+    """Every strict prefix of a valid encoding must be rejected or decode
+    cleanly — never raise an unstructured exception."""
+    value = Outer(
+        tag=7,
+        items=[1, 2, 3],
+        inner=Inner(a=9, b=[1, 2, 3]),
+        bits=[True, False, True],
+        flags=[True] * 9,
+        blob=list(secrets.token_bytes(17)),
+        root=secrets.token_bytes(32),
+    )
+    enc = Outer.serialize(value)
+    for cut in range(len(enc)):
+        try:
+            Outer.deserialize(enc[:cut])
+        except DeserializeError:
+            pass
