@@ -487,7 +487,7 @@ def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
         state_transition(s, signed, ctx)
         times.append(time.perf_counter() - t0)
     best = min(times)
-    return {
+    out = {
         "blocks_per_s": 1.0 / best,
         "block_s": best,
         "attestations_per_block": len(signed.message.body.attestations),
@@ -495,6 +495,36 @@ def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
         "fork": fork,
         "validators": validators,
     }
+
+    # device-routed variant on a real chip only (the CPU fallback would
+    # pay minutes of XLA compile for a number that isn't the workload)
+    if not _degraded():
+        try:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from ethereum_consensus_tpu import ops
+
+                ops.install(
+                    sweeps_min_n=1 << 12,
+                    shuffle_min_n=1 << 12,
+                    bls_agg_min_n=1 << 10,
+                )
+                try:
+                    s = state.copy()
+                    state_transition(s, signed, ctx)  # warm compiles
+                    dev_times = []
+                    for _ in range(3):
+                        s = state.copy()
+                        t0 = time.perf_counter()
+                        state_transition(s, signed, ctx)
+                        dev_times.append(time.perf_counter() - t0)
+                    out["device_routed_block_s"] = min(dev_times)
+                finally:
+                    ops.uninstall()
+        except Exception as exc:  # noqa: BLE001 — host numbers stand alone
+            out["device_routed_error"] = f"{type(exc).__name__}: {str(exc)[:120]}"
+    return out
 
 
 def bench_process_block_mainnet(validators: int = 1 << 13, atts: int = 16):

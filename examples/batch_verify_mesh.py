@@ -23,9 +23,20 @@ sys.path.insert(0, REPO)
 # JAX_PLATFORMS=cpu while the platform plugin rides PYTHONPATH. Re-exec
 # hermetically like tests/conftest.py before importing jax.
 if not os.environ.get("EC_EXAMPLE_HERMETIC"):
-    from ethereum_consensus_tpu.parallel.virtual_mesh import cpu_mesh_env
+    # load virtual_mesh by FILE PATH: importing it as a package submodule
+    # would execute ethereum_consensus_tpu.parallel.__init__, which
+    # imports jax — exactly what must not happen before the re-exec
+    import importlib.util
 
-    env = cpu_mesh_env(
+    _spec = importlib.util.spec_from_file_location(
+        "_vm",
+        os.path.join(
+            REPO, "ethereum_consensus_tpu", "parallel", "virtual_mesh.py"
+        ),
+    )
+    _vm = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_vm)
+    env = _vm.cpu_mesh_env(
         int(os.environ.get("EC_EXAMPLE_DEVICES", "8")), repo_root=REPO
     )
     env["EC_EXAMPLE_HERMETIC"] = "1"

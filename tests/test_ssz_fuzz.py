@@ -106,8 +106,13 @@ def test_corruption_never_crashes_or_aliases(value, data):
         return  # structured rejection is a valid outcome
     # decoded: a corrupted encoding must never decode to the ORIGINAL
     # value (two distinct encodings of indistinguishable values would be
-    # an alias/malleability bug)
+    # an alias/malleability bug), and any ACCEPTED encoding must be
+    # canonical — re-serializing the decoded value reproduces the exact
+    # accepted bytes
     assert back != value, "corrupted encoding decoded to the original value"
+    assert Outer.serialize(back) == corrupted, (
+        "accepted a non-canonical encoding"
+    )
 
 
 @settings(max_examples=60, deadline=None)
