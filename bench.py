@@ -431,6 +431,54 @@ def bench_pairing_device(n_sets: int = 64):
     return out
 
 
+def bench_kzg(n_blobs: int = 4):
+    """KZG/EIP-4844 suite timings (the reference's named perf artifact:
+    batch KZG proof verification, crypto/kzg.rs:139 — c-kzg's C role is
+    played by the native MSM + pairing backend here)."""
+    from ethereum_consensus_tpu.config import Context
+    from ethereum_consensus_tpu.crypto import kzg
+    from ethereum_consensus_tpu.native import bls as native_bls
+
+    if not native_bls.available():
+        return {"error": "native backend unavailable"}
+    settings = Context.for_mainnet().kzg_settings
+    rng = np.random.default_rng(77)
+    # field elements uniform mod r (like canonical blob data) — small
+    # scalars would flatter the MSM by emptying top Pippenger windows
+    R = kzg.R
+    blobs = [
+        b"".join(
+            (int.from_bytes(rng.bytes(32), "big") % R).to_bytes(32, "big")
+            for _ in range(4096)
+        )
+        for _ in range(n_blobs)
+    ]
+    t0 = time.perf_counter()
+    commitments = [bytes(kzg.blob_to_kzg_commitment(b, settings)) for b in blobs]
+    commit_s = (time.perf_counter() - t0) / n_blobs
+    t0 = time.perf_counter()
+    proofs = [
+        bytes(kzg.compute_blob_kzg_proof(b, c, settings))
+        for b, c in zip(blobs, commitments)
+    ]
+    proof_s = (time.perf_counter() - t0) / n_blobs
+    t0 = time.perf_counter()
+    ok1 = kzg.verify_blob_kzg_proof(blobs[0], commitments[0], proofs[0], settings)
+    verify_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    okb = kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs, settings)
+    batch_s = time.perf_counter() - t0
+    return {
+        "ok": bool(ok1) and bool(okb),
+        "blobs": n_blobs,
+        "commit_s_per_blob": commit_s,
+        "proof_s_per_blob": proof_s,
+        "verify_s": verify_s,
+        "batch_verify_s": batch_s,
+        "batch_verify_s_per_blob": batch_s / n_blobs,
+    }
+
+
 def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
     """Shared mainnet-preset block scaffold: real registry, signed
     attestations, all signature sets batched, full per-slot state HTR.
@@ -602,6 +650,7 @@ CONFIGS = [
     ("process_block_mainnet", bench_process_block_mainnet),
     ("process_block_deneb", bench_process_block_deneb),
     ("process_block", bench_process_block),
+    ("kzg", bench_kzg),
     ("large_agg", bench_large_agg),
     # last: pays two cold Miller-loop compiles on a fresh chip — must not
     # starve the BASELINE configs above at the deadline
