@@ -490,12 +490,12 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             isinstance(elem, _UintType)
             and elem.byte_length == 8
             and values
-            and all(type(v) is int for v in values)
+            and set(map(type, values)) == {int}  # C-speed scan; keeps
+            # serialize()'s bool/float rejections out of the numpy path
         ):
             # vectorized u64 packing (balances/inactivity lists dominate);
-            # the explicit little-endian dtype matches serialize(), the
-            # type pre-check keeps serialize()'s rejections (bool/float),
-            # and numpy's OverflowError fires exactly where serialize
+            # the explicit little-endian dtype matches serialize(), and
+            # numpy's OverflowError fires exactly where serialize
             # would raise for out-of-range ints
             try:
                 import numpy as _np
@@ -509,20 +509,34 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
         limit = (limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
         return merkleize_chunks(packed, limit=limit)
-    if (
-        isinstance(elem, ByteVector)
-        and elem.length == BYTES_PER_CHUNK
-        and all(
-            isinstance(v, (bytes, bytearray)) and len(v) == BYTES_PER_CHUNK
-            for v in values
-        )
-    ):
-        # a 32-byte vector's root IS its bytes — skip 2 Python calls per
-        # element (block_roots/state_roots/randao_mixes are tens of
-        # thousands of these on a mainnet state); anything not exactly
-        # 32 bytes falls through to the per-element path and its errors
-        chunks = b"".join(values)
-        return merkleize_chunks(chunks, limit=limit_elems)
+    if isinstance(elem, ByteVector) and elem.length == BYTES_PER_CHUNK:
+        # a 32-byte vector's root IS its bytes — and the validation runs
+        # at C speed (join rejects non-bytes with TypeError; the len-set
+        # check rejects any element that isn't exactly 32 bytes), because
+        # a per-element Python genexpr over block_roots/state_roots/
+        # randao_mixes (tens of thousands of elements on a mainnet
+        # state) was the single hottest line of block processing.
+        # Anything non-conforming falls to the per-element path and its
+        # structured errors.
+        # both scans run at C speed and are BOTH required: the len-set
+        # rejects any element that isn't exactly 32 long (a 31+33 pair
+        # would fool a total-length check alone), while the joined byte
+        # length rejects sized buffer objects whose len() isn't their
+        # byte size (array.array('I', …)/memoryview of wider items would
+        # fool the len-set alone)
+        try:
+            sizes_ok = not values or set(map(len, values)) == {BYTES_PER_CHUNK}
+        except TypeError:  # un-sized element (e.g. int)
+            sizes_ok = False
+        if sizes_ok:
+            try:
+                chunks = b"".join(values)
+            except TypeError:  # sized but not bytes-like (e.g. str)
+                chunks = None
+            if chunks is not None and len(chunks) == BYTES_PER_CHUNK * len(
+                values
+            ):
+                return merkleize_chunks(chunks, limit=limit_elems)
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
     if isinstance(values, CachedRootList):
         # container-element lists (the validator registry) can't cache a
