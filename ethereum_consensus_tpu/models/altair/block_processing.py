@@ -163,7 +163,9 @@ def process_attester_slashing(state, attester_slashing, context, slash_fn=None) 
         raise InvalidAttesterSlashing("no validator could be slashed")
 
 
-def process_deposit(state, deposit, context, pubkey_index=None) -> None:
+def process_deposit(
+    state, deposit, context, pubkey_index=None, signature_valid=None
+) -> None:
     """(phase0 block_processing.rs:405 with altair apply_deposit)"""
     leaf = DepositData.hash_tree_root(deposit.data)
     if not is_valid_merkle_branch(
@@ -175,7 +177,10 @@ def process_deposit(state, deposit, context, pubkey_index=None) -> None:
     ):
         raise InvalidDeposit("invalid deposit inclusion proof")
     state.eth1_deposit_index = checked_add(state.eth1_deposit_index, 1)
-    apply_deposit(state, deposit.data, context, pubkey_index=pubkey_index)
+    apply_deposit(
+        state, deposit.data, context, pubkey_index=pubkey_index,
+        signature_valid=signature_valid,
+    )
 
 
 def add_validator_to_registry(
@@ -194,9 +199,12 @@ def add_validator_to_registry(
     state.inactivity_scores.append(0)
 
 
-def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
+def apply_deposit(
+    state, deposit_data, context, pubkey_index=None, signature_valid=None
+) -> None:
     """altair apply_deposit: new validators also get participation flags and
-    inactivity-score entries. ``pubkey_index`` as in phase0 apply_deposit."""
+    inactivity-score entries. ``pubkey_index`` / ``signature_valid`` as in
+    phase0 apply_deposit."""
     public_key = deposit_data.public_key
     if pubkey_index is not None:
         existing = pubkey_index.get(bytes(public_key))
@@ -204,19 +212,24 @@ def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
         pubkeys = [v.public_key for v in state.validators]
         existing = pubkeys.index(public_key) if public_key in pubkeys else None
     if existing is None:
-        deposit_message = DepositMessage(
-            public_key=public_key,
-            withdrawal_credentials=deposit_data.withdrawal_credentials,
-            amount=deposit_data.amount,
-        )
-        domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
-        signing_root = compute_signing_root(DepositMessage, deposit_message, domain)
-        try:
-            pk = bls.PublicKey.from_bytes(public_key)
-            sig = bls.Signature.from_bytes(deposit_data.signature)
-            valid = bls.verify_signature(pk, signing_root, sig)
-        except Exception:
-            valid = False
+        if signature_valid is not None:
+            valid = bool(signature_valid)
+        else:
+            deposit_message = DepositMessage(
+                public_key=public_key,
+                withdrawal_credentials=deposit_data.withdrawal_credentials,
+                amount=deposit_data.amount,
+            )
+            domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
+            signing_root = compute_signing_root(
+                DepositMessage, deposit_message, domain
+            )
+            try:
+                pk = bls.PublicKey.from_bytes(public_key)
+                sig = bls.Signature.from_bytes(deposit_data.signature)
+                valid = bls.verify_signature(pk, signing_root, sig)
+            except Exception:
+                valid = False
         if not valid:
             return  # invalid deposit signatures are skipped, not errors
         add_validator_to_registry(

@@ -45,15 +45,9 @@ def initialize_beacon_state_from_eth1(
         randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
     )
 
-    from ...ssz import List as SSZList
+    from ..genesis_common import fold_genesis_deposits
 
-    deposit_data_list_type = SSZList[DepositData, 2**32]
-    leaves = [d.data for d in deposits]
-    for index, deposit in enumerate(deposits):
-        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
-            leaves[: index + 1]
-        )
-        process_deposit(state, deposit, context)
+    fold_genesis_deposits(state, deposits, context, process_deposit)
 
     for index, validator in enumerate(state.validators):
         balance = state.balances[index]

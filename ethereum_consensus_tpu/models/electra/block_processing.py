@@ -365,12 +365,21 @@ def add_validator_to_registry(
 
 def apply_deposit(
     state, public_key: bytes, withdrawal_credentials: bytes, amount: int,
-    signature: bytes, context,
+    signature: bytes, context, signature_valid=None,
 ) -> None:
     """(block_processing.rs electra apply_deposit) — EIP-7251 semantics:
     top-ups queue pending balance deposits; a valid-signature compounding
-    top-up upgrades eth1 credentials."""
+    top-up upgrades eth1 credentials. ``signature_valid`` supplies a
+    precomputed verdict (genesis batches every deposit signature into one
+    RLC multi-pairing; the deposit signing root is state-independent)."""
     from .containers import PendingBalanceDeposit
+
+    def _sig_ok() -> bool:
+        if signature_valid is not None:
+            return bool(signature_valid)
+        return is_valid_deposit_signature(
+            public_key, withdrawal_credentials, amount, signature, context
+        )
 
     pubkeys = [bytes(v.public_key) for v in state.validators]
     public_key = bytes(public_key)
@@ -379,23 +388,19 @@ def apply_deposit(
         state.pending_balance_deposits.append(
             PendingBalanceDeposit(index=index, amount=amount)
         )
-        if is_valid_deposit_signature(
-            public_key, withdrawal_credentials, amount, signature, context
-        ):
+        if _sig_ok():
             if h.is_compounding_withdrawal_credential(
                 withdrawal_credentials
             ) and h.has_eth1_withdrawal_credential(state.validators[index]):
                 h.switch_to_compounding_validator(state, index, context)
         return
 
-    if not is_valid_deposit_signature(
-        public_key, withdrawal_credentials, amount, signature, context
-    ):
+    if not _sig_ok():
         return  # invalid deposit signatures are skipped, not errors
     add_validator_to_registry(state, public_key, withdrawal_credentials, amount)
 
 
-def process_deposit(state, deposit, context) -> None:
+def process_deposit(state, deposit, context, signature_valid=None) -> None:
     """phase0 merkle proof + electra apply_deposit."""
     leaf = DepositData.hash_tree_root(deposit.data)
     if not is_valid_merkle_branch(
@@ -414,6 +419,7 @@ def process_deposit(state, deposit, context) -> None:
         deposit.data.amount,
         deposit.data.signature,
         context,
+        signature_valid=signature_valid,
     )
 
 

@@ -13,9 +13,72 @@ from __future__ import annotations
 from ..primitives import GENESIS_EPOCH
 from .phase0.containers import BeaconBlockHeader, DepositData, Eth1Data, Fork
 
-__all__ = ["initialize_state_generic"]
+__all__ = [
+    "initialize_state_generic",
+    "IncrementalDepositRoot",
+    "fold_genesis_deposits",
+]
 
-DEPOSIT_DATA_LIST_BOUND = 2**32
+
+class IncrementalDepositRoot:
+    """O(log n)-per-deposit ``List[DepositData, 2^32]`` prefix roots.
+
+    The growing prefix-list root IS the EIP deposit contract's
+    incremental tree (plus the SSZ length mix-in), so genesis never
+    re-merkleizes the i-prefix per deposit — that was O(n² log n)
+    hashing, the second-largest cost of large geneses."""
+
+    DEPTH = 32  # log2 of the List[DepositData, 2^32] bound
+
+    def __init__(self):
+        import hashlib
+
+        self._sha = hashlib.sha256
+        self.branch = [b"\x00" * 32] * self.DEPTH
+        self.count = 0
+
+    def push(self, leaf: bytes) -> bytes:
+        """Insert ``leaf``; returns the list root over all pushed leaves."""
+        from ..ssz.merkle import zero_hash
+
+        node = leaf
+        size = self.count + 1
+        for level in range(self.DEPTH):
+            if size & 1:
+                self.branch[level] = node
+                break
+            node = self._sha(self.branch[level] + node).digest()
+            size >>= 1
+        self.count += 1
+        node = b"\x00" * 32
+        size = self.count
+        for level in range(self.DEPTH):
+            if size & 1:
+                node = self._sha(self.branch[level] + node).digest()
+            else:
+                node = self._sha(node + zero_hash(level)).digest()
+            size >>= 1
+        return self._sha(
+            node + self.count.to_bytes(32, "little")
+        ).digest()
+
+
+def fold_genesis_deposits(state, deposits, context, process_deposit_fn) -> None:
+    """The genesis deposit fold shared by every fork: batched
+    deposit-signature verdicts (state-independent signing roots ⇒ one
+    RLC multi-pairing for all deposits) + incremental deposit roots;
+    per-deposit spec semantics unchanged."""
+    from .phase0.block_processing import deposit_signature_verdicts
+
+    verdicts = deposit_signature_verdicts(deposits, context)
+    inc_root = IncrementalDepositRoot()
+    for index, deposit in enumerate(deposits):
+        state.eth1_data.deposit_root = inc_root.push(
+            DepositData.hash_tree_root(deposit.data)
+        )
+        process_deposit_fn(
+            state, deposit, context, signature_valid=verdicts[index]
+        )
 
 
 def initialize_state_generic(
@@ -44,15 +107,7 @@ def initialize_state_generic(
         randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
     )
 
-    from ..ssz import List as SSZList
-
-    deposit_data_list_type = SSZList[DepositData, DEPOSIT_DATA_LIST_BOUND]
-    leaves = [d.data for d in deposits]
-    for index, deposit in enumerate(deposits):
-        state.eth1_data.deposit_root = deposit_data_list_type.hash_tree_root(
-            leaves[: index + 1]
-        )
-        process_deposit_fn(state, deposit, context)
+    fold_genesis_deposits(state, deposits, context, process_deposit_fn)
 
     for index, validator in enumerate(state.validators):
         balance = state.balances[index]

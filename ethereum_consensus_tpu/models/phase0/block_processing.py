@@ -256,11 +256,17 @@ def get_validator_from_deposit(deposit_data, context):
     )
 
 
-def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
+def apply_deposit(
+    state, deposit_data, context, pubkey_index=None, signature_valid=None
+) -> None:
     """(block_processing.rs:351)
 
     ``pubkey_index`` (pubkey bytes → validator index) lets batch callers
-    avoid the O(n) registry scan per deposit; semantics are unchanged."""
+    avoid the O(n) registry scan per deposit; ``signature_valid`` lets
+    them supply a precomputed verdict for the (state-independent)
+    deposit-signature check — genesis batches every deposit into one RLC
+    multi-pairing. Semantics are unchanged: top-ups never consult the
+    verdict, exactly as the inline path never verifies them."""
     public_key = deposit_data.public_key
     if pubkey_index is not None:
         existing = pubkey_index.get(bytes(public_key))
@@ -268,19 +274,24 @@ def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
         pubkeys = [v.public_key for v in state.validators]
         existing = pubkeys.index(public_key) if public_key in pubkeys else None
     if existing is None:
-        deposit_message = DepositMessage(
-            public_key=public_key,
-            withdrawal_credentials=deposit_data.withdrawal_credentials,
-            amount=deposit_data.amount,
-        )
-        domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
-        signing_root = compute_signing_root(DepositMessage, deposit_message, domain)
-        try:
-            pk = bls.PublicKey.from_bytes(public_key)
-            sig = bls.Signature.from_bytes(deposit_data.signature)
-            valid = bls.verify_signature(pk, signing_root, sig)
-        except Exception:
-            valid = False
+        if signature_valid is not None:
+            valid = bool(signature_valid)
+        else:
+            deposit_message = DepositMessage(
+                public_key=public_key,
+                withdrawal_credentials=deposit_data.withdrawal_credentials,
+                amount=deposit_data.amount,
+            )
+            domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
+            signing_root = compute_signing_root(
+                DepositMessage, deposit_message, domain
+            )
+            try:
+                pk = bls.PublicKey.from_bytes(public_key)
+                sig = bls.Signature.from_bytes(deposit_data.signature)
+                valid = bls.verify_signature(pk, signing_root, sig)
+            except Exception:
+                valid = False
         if not valid:
             return  # invalid deposit signatures are skipped, not errors
         state.validators.append(get_validator_from_deposit(deposit_data, context))
@@ -291,7 +302,39 @@ def apply_deposit(state, deposit_data, context, pubkey_index=None) -> None:
         h.increase_balance(state, existing, deposit_data.amount)
 
 
-def process_deposit(state, deposit, context, pubkey_index=None) -> None:
+def deposit_signature_verdicts(deposits, context) -> "list[bool]":
+    """Batched deposit-signature verdicts: the signing root depends only
+    on the deposit data (genesis-fork domain, no state), so every
+    deposit verifies in ONE RLC multi-pairing with per-set blame
+    (verify_signature_sets) instead of a pairing pair per deposit.
+    Unparseable keys/signatures get verdict False, like the inline
+    path's exception handling."""
+    verdicts = [False] * len(deposits)
+    sets, slots = [], []
+    domain = h.compute_domain(DomainType.DEPOSIT, None, None, context)
+    for i, deposit in enumerate(deposits):
+        data = deposit.data
+        message = DepositMessage(
+            public_key=data.public_key,
+            withdrawal_credentials=data.withdrawal_credentials,
+            amount=data.amount,
+        )
+        signing_root = compute_signing_root(DepositMessage, message, domain)
+        try:
+            pk = bls.PublicKey.from_bytes(data.public_key)
+            sig = bls.Signature.from_bytes(data.signature)
+        except Exception:  # noqa: BLE001 — unparseable ⇒ skipped deposit
+            continue
+        sets.append(bls.SignatureSet([pk], signing_root, sig))
+        slots.append(i)
+    for i, ok in zip(slots, bls.verify_signature_sets(sets)):
+        verdicts[i] = bool(ok)
+    return verdicts
+
+
+def process_deposit(
+    state, deposit, context, pubkey_index=None, signature_valid=None
+) -> None:
     """(block_processing.rs:405)"""
     leaf = DepositData.hash_tree_root(deposit.data)
     if not is_valid_merkle_branch(
@@ -303,7 +346,10 @@ def process_deposit(state, deposit, context, pubkey_index=None) -> None:
     ):
         raise InvalidDeposit("invalid deposit inclusion proof")
     state.eth1_deposit_index = checked_add(state.eth1_deposit_index, 1)
-    apply_deposit(state, deposit.data, context, pubkey_index=pubkey_index)
+    apply_deposit(
+        state, deposit.data, context, pubkey_index=pubkey_index,
+        signature_valid=signature_valid,
+    )
 
 
 def process_voluntary_exit(state, signed_voluntary_exit, context) -> None:

@@ -51,44 +51,23 @@ def initialize_beacon_state_from_eth1(
         randao_mixes=[eth1_block_hash] * context.EPOCHS_PER_HISTORICAL_VECTOR,
     )
 
-    # process deposits with an incrementally updated deposit root: a
-    # re-merkleization of the i-prefix per deposit is O(n² log n); the
-    # deposit-contract incremental branch computes each successive
-    # List[DepositData, 2^32] root in O(log n) (identical roots — the
-    # growing-list tree IS the incremental tree), and one shared pubkey
-    # index replaces the per-deposit O(n) registry scan
-    import hashlib as _hashlib
+    # the shared genesis fold (incremental deposit roots + one batched
+    # RLC multi-pairing for every deposit signature), with one shared
+    # pubkey index instead of a per-deposit O(n) registry scan
+    from ..genesis_common import fold_genesis_deposits
 
-    from ...ssz.merkle import zero_hash
-
-    depth = 32  # 2^32 list limit
-    branch = [b"\x00" * 32] * depth
     pubkey_index = {
         bytes(v.public_key): i for i, v in enumerate(state.validators)
     }
-    for index, deposit in enumerate(deposits):
-        # insert leaf index into the incremental branch
-        node = DepositData.hash_tree_root(deposit.data)
-        size = index + 1
-        for level in range(depth):
-            if size & 1:
-                branch[level] = node
-                break
-            node = _hashlib.sha256(branch[level] + node).digest()
-            size >>= 1
-        # root over the branch with zero-subtree siblings + length mix-in
-        node = b"\x00" * 32
-        size = index + 1
-        for level in range(depth):
-            if size & 1:
-                node = _hashlib.sha256(branch[level] + node).digest()
-            else:
-                node = _hashlib.sha256(node + zero_hash(level)).digest()
-            size >>= 1
-        state.eth1_data.deposit_root = _hashlib.sha256(
-            node + (index + 1).to_bytes(32, "little")
-        ).digest()
-        process_deposit(state, deposit, context, pubkey_index=pubkey_index)
+    fold_genesis_deposits(
+        state,
+        deposits,
+        context,
+        lambda st, dep, ctx, signature_valid=None: process_deposit(
+            st, dep, ctx, pubkey_index=pubkey_index,
+            signature_valid=signature_valid,
+        ),
+    )
 
     # activate bootstrap validators
     for index, validator in enumerate(state.validators):

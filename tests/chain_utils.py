@@ -61,18 +61,49 @@ def make_deposit_data(index: int, context, amount: int | None = None) -> Deposit
     )
 
 
-def make_deposits(count: int, context):
-    """Deposits with valid incremental-tree merkle proofs (deposit i proven
-    against the tree holding deposits 0..i, mixed with count i+1)."""
+def deposits_from_datas(datas, context):
+    """Deposits with valid incremental-tree merkle proofs (deposit i
+    proven against the tree holding deposits 0..i, mixed with count
+    i+1) for the given DepositData list.
+
+    Uses the EIP deposit contract's incremental-branch algorithm: the
+    proof of the newest leaf needs only the stored left-subtree roots
+    plus zero hashes — O(n log n) total, where rebuilding a full Tree
+    per deposit was O(n²) hashing (the dominant cost of big test
+    geneses)."""
+    from ethereum_consensus_tpu.ssz.hash import hash_pair
+    from ethereum_consensus_tpu.ssz.merkle import zero_hash
+
     ns = build(context.preset)
-    datas = [make_deposit_data(i, context) for i in range(count)]
-    leaves = [DepositData.hash_tree_root(d) for d in datas]
+    depth = DEPOSIT_CONTRACT_TREE_DEPTH
+    branch: list[bytes | None] = [None] * depth
     deposits = []
-    for i in range(count):
-        tree = Tree(leaves[: i + 1], limit=2**DEPOSIT_CONTRACT_TREE_DEPTH)
-        branch = tree.proof(i) + [(i + 1).to_bytes(32, "little")]
-        deposits.append(ns.Deposit(proof=branch, data=datas[i]))
+    for i, data in enumerate(datas):
+        leaf = DepositData.hash_tree_root(data)
+        # proof of leaf i against the (i+1)-leaf tree: set bits of i pick
+        # the stored left-subtree roots, clear bits an empty (zero) right
+        proof = [
+            branch[hgt] if (i >> hgt) & 1 else zero_hash(hgt)
+            for hgt in range(depth)
+        ]
+        proof.append((i + 1).to_bytes(32, "little"))
+        deposits.append(ns.Deposit(proof=proof, data=data))
+        # deposit-contract insert of leaf i
+        node = leaf
+        size = i + 1
+        hgt = 0
+        while size % 2 == 0:
+            node = hash_pair(branch[hgt], node)
+            size //= 2
+            hgt += 1
+        branch[hgt] = node
     return deposits
+
+
+def make_deposits(count: int, context):
+    return deposits_from_datas(
+        [make_deposit_data(i, context) for i in range(count)], context
+    )
 
 
 def make_genesis_state(validator_count: int, context):

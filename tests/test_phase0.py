@@ -242,3 +242,30 @@ def test_proposer_is_active(genesis16):
     state = state.copy()
     proposer = h.get_beacon_proposer_index(state, ctx)
     assert 0 <= proposer < 16
+
+
+def test_genesis_skips_invalid_deposit_signatures():
+    """The batched genesis deposit verification must preserve the spec's
+    per-deposit skip semantics: a deposit with a bad signature (or
+    unparseable pubkey) adds NO validator, while the rest still activate
+    — the RLC batch's per-set blame stands in for per-deposit verifies
+    (block_processing.rs:351 skip-not-error)."""
+    from chain_utils import Context, deposits_from_datas, make_deposit_data
+    from ethereum_consensus_tpu.models.phase0 import genesis
+
+    ctx = Context.for_minimal()
+    datas = [make_deposit_data(i, ctx) for i in range(6)]
+    # corrupt deposit 2's signature and deposit 4's pubkey (unparseable)
+    datas[2].signature = b"\xaa" * 96
+    datas[4].public_key = b"\x11" * 48
+    deposits = deposits_from_datas(datas, ctx)  # proofs over corrupted datas
+    state = genesis.initialize_beacon_state_from_eth1(
+        b"\x42" * 32, 1_600_000_000, deposits, ctx
+    )
+    assert len(state.validators) == 4  # 6 deposits - 2 invalid
+    from chain_utils import public_key_bytes
+
+    keys = [bytes(v.public_key) for v in state.validators]
+    assert public_key_bytes(2) not in keys
+    assert b"\x11" * 48 not in keys
+    assert all(v.activation_epoch == 0 for v in state.validators)
