@@ -431,6 +431,34 @@ def bench_pairing_device(n_sets: int = 64):
     return out
 
 
+def bench_epoch_mainnet(validators: int = 1 << 13):
+    """One full epoch of slot processing on a mainnet-preset registry —
+    amortized cost of the per-slot state roots plus the epoch-boundary
+    registry sweeps (phase0/epoch_processing.rs:1039, the HOT loops of
+    SURVEY §3.1)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from chain_utils import fresh_genesis
+
+    from ethereum_consensus_tpu.models.phase0.slot_processing import (
+        process_slots,
+    )
+
+    if _degraded():
+        validators = min(validators, 1 << 12)
+    state, ctx = fresh_genesis(validators, "mainnet")
+    slots = int(ctx.SLOTS_PER_EPOCH)
+    process_slots(state, 1, ctx)  # warm caches
+    t0 = time.perf_counter()
+    process_slots(state, 1 + slots, ctx)  # crosses one epoch boundary
+    epoch_s = time.perf_counter() - t0
+    return {
+        "validators": validators,
+        "slots": slots,
+        "epoch_s": epoch_s,
+        "ms_per_slot": 1e3 * epoch_s / slots,
+    }
+
+
 def bench_kzg(n_blobs: int = 4):
     """KZG/EIP-4844 suite timings (the reference's named perf artifact:
     batch KZG proof verification, crypto/kzg.rs:139 — c-kzg's C role is
@@ -650,6 +678,7 @@ CONFIGS = [
     ("process_block_mainnet", bench_process_block_mainnet),
     ("process_block_deneb", bench_process_block_deneb),
     ("process_block", bench_process_block),
+    ("epoch_mainnet", bench_epoch_mainnet),
     ("kzg", bench_kzg),
     ("large_agg", bench_large_agg),
     # last: pays two cold Miller-loop compiles on a fresh chip — must not

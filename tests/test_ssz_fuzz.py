@@ -142,3 +142,45 @@ def test_truncation_sweep():
             Outer.deserialize(enc[:cut])
         except DeserializeError:
             pass
+
+
+def test_polymorphic_deserialize_fuzz():
+    """Fuzz the fork-polymorphic codec (types.py, the analogue of the
+    generated newest→oldest deserializer, type_generator.rs:760): for
+    every fork, a serialized BeaconState must round-trip to the SAME
+    fork and value; random corruption must either raise the structured
+    DeserializationError or decode to a self-consistent value that
+    re-serializes canonically (possibly under an older fork — the
+    documented untagged-union semantics)."""
+    import random as _random
+
+    from ethereum_consensus_tpu.config import Context
+    from ethereum_consensus_tpu.error import DeserializationError
+    from ethereum_consensus_tpu.types import BeaconState
+
+    ctx = Context.for_minimal()
+    preset = ctx.preset
+    rng = _random.Random(0xEC)  # deterministic: failures are replayable
+    for fork in BeaconState.FORKS:
+        container = BeaconState.container_type(fork, preset)
+        value = container(genesis_time=1234)
+        wrapped = BeaconState.from_fork(fork, value)
+        enc = wrapped.serialize()
+        back = BeaconState.deserialize(enc, preset)
+        assert back.version() == fork, (fork, back.version())
+        assert back.serialize() == enc
+        for _ in range(40):
+            pos = rng.randrange(len(enc))
+            bit = rng.randrange(8)
+            corrupted = bytearray(enc)
+            corrupted[pos] ^= 1 << bit
+            try:
+                got = BeaconState.deserialize(bytes(corrupted), preset)
+            except DeserializationError:
+                continue
+            assert got.serialize() == bytes(corrupted), (
+                fork,
+                pos,
+                bit,
+                "accepted non-canonical polymorphic encoding",
+            )
