@@ -432,13 +432,20 @@ def bench_pairing_device(n_sets: int = 64):
 
 
 def bench_epoch_mainnet(validators: int = 1 << 13):
-    """One full epoch of slot processing on a mainnet-preset registry —
-    amortized cost of the per-slot state roots plus the epoch-boundary
-    registry sweeps (phase0/epoch_processing.rs:1039, the HOT loops of
-    SURVEY §3.1)."""
+    """One full epoch of slot processing on a mainnet-preset registry
+    WITH full pending-attestation coverage — the realistic shape of the
+    epoch-boundary rewards/penalties loops plus the per-slot state roots
+    (phase0/epoch_processing.rs:1039, the HOT loops of SURVEY §3.1)."""
     sys.path.insert(0, os.path.join(REPO, "tests"))
-    from chain_utils import fresh_genesis
+    from chain_utils import fresh_genesis, make_attestation
 
+    from ethereum_consensus_tpu.models.phase0.block_processing import (
+        process_attestation,
+    )
+    from ethereum_consensus_tpu.models.phase0.helpers import (
+        get_committee_count_per_slot,
+        get_current_epoch,
+    )
     from ethereum_consensus_tpu.models.phase0.slot_processing import (
         process_slots,
     )
@@ -447,13 +454,26 @@ def bench_epoch_mainnet(validators: int = 1 << 13):
         validators = min(validators, 1 << 12)
     state, ctx = fresh_genesis(validators, "mainnet")
     slots = int(ctx.SLOTS_PER_EPOCH)
-    process_slots(state, 1, ctx)  # warm caches
+    process_slots(state, slots, ctx)  # warm caches; land on a boundary
+    per_slot = get_committee_count_per_slot(
+        state, get_current_epoch(state, ctx), ctx
+    )
+    n_atts = 0
+    for slot in range(slots):
+        if slot + int(ctx.MIN_ATTESTATION_INCLUSION_DELAY) > state.slot:
+            continue
+        for index in range(per_slot):
+            process_attestation(
+                state, make_attestation(state, slot, index, ctx), ctx
+            )
+            n_atts += 1
     t0 = time.perf_counter()
-    process_slots(state, 1 + slots, ctx)  # crosses one epoch boundary
+    process_slots(state, 2 * slots, ctx)  # crosses one epoch boundary
     epoch_s = time.perf_counter() - t0
     return {
         "validators": validators,
         "slots": slots,
+        "pending_attestations": n_atts,
         "epoch_s": epoch_s,
         "ms_per_slot": 1e3 * epoch_s / slots,
     }

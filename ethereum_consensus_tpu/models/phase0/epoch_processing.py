@@ -277,14 +277,20 @@ def get_inclusion_delay_deltas(state, context):
         state, previous_epoch, context
     )
     base_reward = _base_reward_fn(state, context)
-    for index in get_unslashed_attesting_indices(state, source_attestations, context):
-        candidates = [
-            a
-            for a in source_attestations
-            if index
-            in h.get_attesting_indices(state, a.data, a.aggregation_bits, context)
-        ]
-        attestation = min(candidates, key=lambda a: a.inclusion_delay)
+    # one pass over attestations in (inclusion_delay, original-order)
+    # instead of re-scanning every attestation per validator: the stable
+    # sort makes the first assignment per index exactly the
+    # min(candidates, key=inclusion_delay) of the spec's O(n·a) loop
+    best: dict[int, object] = {}
+    for a in sorted(source_attestations, key=lambda a: a.inclusion_delay):
+        for index in h.get_attesting_indices(
+            state, a.data, a.aggregation_bits, context
+        ):
+            if index not in best:
+                best[index] = a
+    for index, attestation in best.items():
+        if state.validators[index].slashed:
+            continue  # get_unslashed_attesting_indices parity
         proposer_reward = base_reward(index) // context.PROPOSER_REWARD_QUOTIENT
         rewards[attestation.proposer_index] += proposer_reward
         max_attester_reward = base_reward(index) - proposer_reward

@@ -193,11 +193,12 @@ def compute_shuffled_indices(indices: list[int], seed: bytes, context) -> list[i
     return shuffled
 
 
-# full shuffle-result LRU — committee lookups hit the same seed for every
-# committee of an epoch, so one whole-list shuffle (device kernel or the
-# vectorized host map below) serves them all. Keyed by (seed, round count,
-# digest of the index list) so differing presets or active sets can never
-# alias.
+# full shuffle-result cache (FIFO eviction) — committee lookups hit the
+# same seed for every committee of an epoch, so one whole-list shuffle
+# (device kernel or the vectorized host map below) serves them all.
+# Keyed by (seed, round count, len); two active sets CAN alias a key, so
+# each entry stores its index list and hits are equality-guarded — an
+# alias costs a recompute, never a wrong committee.
 _SHUFFLE_CACHE: dict = {}
 _SHUFFLE_CACHE_MAX = 4
 
@@ -239,22 +240,25 @@ def compute_shuffled_indices_vectorized(
 
 
 def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
-    digest = hashlib.sha256(
-        b"".join(i.to_bytes(8, "little") for i in indices)
-    ).digest()
-    key = (seed, context.SHUFFLE_ROUND_COUNT, digest)
+    # key on (seed, rounds, len) with a stored-list equality guard: a
+    # C-speed list compare replaces the old per-lookup SHA-256 digest of
+    # the whole index list, which cost more than the cached shuffle it
+    # guarded (tens of thousands of committee lookups per epoch)
+    key = (seed, context.SHUFFLE_ROUND_COUNT, len(indices))
     hit = _SHUFFLE_CACHE.get(key)
-    if hit is None:
-        if _device_flags.shuffle_enabled(len(indices)):
-            from ...ops.shuffle import compute_shuffled_indices_device
+    if hit is not None and (hit[0] is indices or hit[0] == indices):
+        return hit[1]
+    if _device_flags.shuffle_enabled(len(indices)):
+        from ...ops.shuffle import compute_shuffled_indices_device
 
-            hit = compute_shuffled_indices_device(indices, seed, context)
-        else:
-            hit = compute_shuffled_indices_vectorized(indices, seed, context)
-        if len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
-            _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
-        _SHUFFLE_CACHE[key] = hit
-    return hit
+        shuffled = compute_shuffled_indices_device(indices, seed, context)
+    else:
+        shuffled = compute_shuffled_indices_vectorized(indices, seed, context)
+    # overwrite in place on key aliasing; evict only for genuinely new keys
+    if key not in _SHUFFLE_CACHE and len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
+        _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
+    _SHUFFLE_CACHE[key] = (list(indices), shuffled)
+    return shuffled
 
 
 def compute_committee(
