@@ -530,7 +530,7 @@ def bench_kzg(n_blobs: int = 4):
 def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
     """Shared mainnet-preset block scaffold: real registry, signed
     attestations, all signature sets batched, full per-slot state HTR.
-    Best-of-3 timing over fresh state copies for BOTH forks so the
+    Best-of-3 timing over fresh state copies for every fork so the
     numbers stay comparable."""
     sys.path.insert(0, os.path.join(REPO, "tests"))
     import chain_utils
@@ -566,6 +566,13 @@ def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
     attestations = []
     for slot in range(max(0, target - 2), target):
         if slot + ctx.MIN_ATTESTATION_INCLUSION_DELAY > target:
+            continue
+        if fork == "electra":
+            # EIP-7549: one committee-spanning attestation per slot
+            if len(attestations) < atts:
+                attestations.append(
+                    chain_utils.make_attestation_electra(scratch, slot, ctx)
+                )
             continue
         for index in range(per_slot):
             if len(attestations) >= atts:
@@ -646,6 +653,19 @@ def bench_process_block_deneb(validators: int = 1 << 12, atts: int = 8):
     return out
 
 
+def bench_process_block_electra(validators: int = 1 << 12):
+    """Electra full mainnet-preset ``process_block`` — committee-spanning
+    EIP-7549 attestations, 512-key sync aggregate, execution payload,
+    EIP-7251 machinery. The reference cannot execute electra at all
+    (executor.rs:155-172 has no electra arm); this config exists to show
+    the fork is first-class here. (Electra blocks carry one
+    committee-spanning attestation per eligible slot — two here — so no
+    attestation-count knob exists.)"""
+    if _degraded():
+        validators = min(validators, 1 << 11)
+    return _bench_mainnet_block("electra", validators, atts=2)
+
+
 def bench_process_block():
     """Full block application incl. batched signature verification and the
     per-slot state HTR (minimal preset — the Python orchestration floor;
@@ -697,6 +717,7 @@ CONFIGS = [
     ("sync_agg", bench_sync_agg),
     ("process_block_mainnet", bench_process_block_mainnet),
     ("process_block_deneb", bench_process_block_deneb),
+    ("process_block_electra", bench_process_block_electra),
     ("process_block", bench_process_block),
     ("epoch_mainnet", bench_epoch_mainnet),
     ("kzg", bench_kzg),
