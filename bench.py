@@ -22,15 +22,24 @@ jax backend touch HANG, and one crash used to lose every number):
 * rc is 0 whenever a JSON line is printed — partial results with
   per-config ``error``/``skipped`` fields beat an empty artifact.
 
-The ``detail.configs`` dict carries the BASELINE.md configs:
-  * ``state_htr``      — mainnet-preset BeaconState hash_tree_root (config 2)
-  * ``att_batch``      — 512 attestation signature-set batch verify vs
-                         sequential per-set verification (config 3)
-  * ``sync_agg``       — 512-key sync-aggregate fast_aggregate_verify
-                         (config 4)
-  * ``process_block``  — full phase0+ block application, blocks/sec
-                         (config 5 shape; all signature sets batched)
-  * ``sig_128k``       — the 128k-signature north star (config 1)
+The ``detail.configs`` dict carries the BASELINE.md configs and more:
+  * ``state_htr``       — mainnet BeaconState hash_tree_root (config 2)
+  * ``att_batch``       — 512 attestation signature-set batch verify vs
+                          sequential per-set verification (config 3)
+  * ``sync_agg``        — 512-key sync-aggregate fast_aggregate_verify
+                          (config 4)
+  * ``process_block_mainnet`` / ``process_block_deneb`` /
+    ``process_block_electra`` — full mainnet-preset block application
+                          per fork (config 5; electra exceeds the
+                          reference, which cannot execute it)
+  * ``process_block``   — minimal-preset orchestration floor
+  * ``sig_128k``        — the 128k-signature north star (config 1)
+  * ``epoch_mainnet``   — a full epoch incl. boundary sweeps with
+                          pending attestations
+  * ``kzg``             — EIP-4844 commit/proof/verify/batch-verify
+  * ``pairing_device``  — device RLC pairing under both product kernels
+                          (u64 vs int8-MXU), the routing-threshold probe
+  * ``large_agg``       — 2^16-point G1 aggregation, device vs native
 
 Prints ONE JSON line:
   {"metric": "hash_tree_root_leaves_per_sec", "value": ..., "unit":

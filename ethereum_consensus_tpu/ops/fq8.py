@@ -23,11 +23,14 @@ with exact int32 accumulation (55 terms × 127² < 2^20) — the MXU's
 native integer path. Hardware measurement decides routing. The Montgomery reduction that follows is the same
 column-serial sweep as fql.mont at byte granularity (52 rounds).
 
-STATUS: correctness-complete and cross-checked against fql.mont
-(tests/test_ops_pairing.py::test_fq8_matmul_product_matches_fql); NOT
-routed into the pairing yet — flipping ops/pairing.py onto this layer
-(and measuring it on real hardware) is the planned path to enabling
-`install(pairing_min_sets=...)` by default. See docs/DEVICE_PAIRING.md.
+STATUS: ROUTED (round 4). `mont7r` generalizes `mont7` to the lazy
+tower's redundant operands and is a drop-in for ``fql.mont``, selected
+by ``fql.set_multiplier("mxu")`` / ``EC_PAIRING_MULT=mxu``; correctness
+is pinned by tests/test_ops_pairing.py (column-exact vs fql.mont on
+redundant and canonical inputs, full batch-verdict parity under the mxu
+multiplier). ``bench.py bench_pairing_device`` measures both
+multipliers; the live-chip crossover decides the default
+(`install(pairing_min_sets=...)`). See docs/DEVICE_PAIRING.md.
 """
 
 from __future__ import annotations
