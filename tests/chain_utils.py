@@ -6,6 +6,7 @@ crafting — the scaffolding the sanity/finality-style tests drive.
 from __future__ import annotations
 
 import functools
+import os
 
 from ethereum_consensus_tpu.config import Context
 from ethereum_consensus_tpu.crypto import bls
@@ -21,6 +22,9 @@ from ethereum_consensus_tpu.models.phase0.containers import (
     DEPOSIT_CONTRACT_TREE_DEPTH,
 )
 from ethereum_consensus_tpu.signing import compute_signing_root
+from pathlib import Path
+
+from ethereum_consensus_tpu.ssz import List as SSZList
 from ethereum_consensus_tpu.ssz import uint64
 from ethereum_consensus_tpu.ssz.merkle import Tree
 
@@ -100,9 +104,72 @@ def deposits_from_datas(datas, context):
     return deposits
 
 
+_DEPOSIT_CACHE_DIR = Path(__file__).parent / ".deposit_cache"
+
+
+@functools.lru_cache(maxsize=1)
+def _cache_source_digest() -> str:
+    """Digest of every source file the cached artifacts depend on: any
+    edit to deposit construction, genesis logic, or the SSZ codec gets a
+    fresh cache key automatically — a stale cache can never mask a
+    regression in the code under test."""
+    import hashlib as _hashlib
+
+    repo = Path(__file__).parent.parent
+    files = sorted(
+        [Path(__file__)]
+        + list((repo / "ethereum_consensus_tpu" / "models").glob("*/genesis.py"))
+        + list(
+            (repo / "ethereum_consensus_tpu" / "models").glob(
+                "*/block_processing.py"
+            )
+        )
+        + [repo / "ethereum_consensus_tpu" / "models" / "genesis_common.py"]
+        + [repo / "ethereum_consensus_tpu" / "ssz" / "core.py"]
+    )
+    h = _hashlib.sha256()
+    for f in files:
+        h.update(f.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _disk_cached(name: str, serialize, deserialize, builder):
+    """Race-safe cross-process artifact cache under tests/.deposit_cache:
+    per-writer tmp names, missing_ok unlinks, and source-digest keys
+    (see _cache_source_digest)."""
+    path = _DEPOSIT_CACHE_DIR / f"{_cache_source_digest()}-{name}.ssz"
+    try:
+        return deserialize(path.read_bytes())
+    except FileNotFoundError:
+        pass
+    except Exception:  # corrupt/partial entry: rebuild
+        path.unlink(missing_ok=True)
+    value = builder()
+    _DEPOSIT_CACHE_DIR.mkdir(exist_ok=True)
+    tmp = path.with_suffix(f".{os.getpid()}.tmp")
+    tmp.write_bytes(serialize(value))
+    tmp.replace(path)  # atomic; concurrent writers race benignly
+    return value
+
+
 def make_deposits(count: int, context):
-    return deposits_from_datas(
-        [make_deposit_data(i, context) for i in range(count)], context
+    """Deterministic bootstrap deposits, disk-cached across processes:
+    the BLS signing + proof construction for large counts costs seconds
+    per fresh process (bench child, spec harness, every test session)
+    for bytes that never change."""
+    ns = build(context.preset)
+    deposit_list_type = SSZList[ns.Deposit, 2**32]
+    name = (
+        f"deposits-{bytes(context.genesis_fork_version).hex()}-"
+        f"{int(context.MAX_EFFECTIVE_BALANCE)}-{count}"
+    )
+    return _disk_cached(
+        name,
+        deposit_list_type.serialize,
+        deposit_list_type.deserialize,
+        lambda: deposits_from_datas(
+            [make_deposit_data(i, context) for i in range(count)], context
+        ),
     )
 
 
@@ -116,10 +183,19 @@ def make_genesis_state(validator_count: int, context):
 
 @functools.lru_cache(maxsize=4)
 def cached_genesis(validator_count: int, preset_name: str):
-    """Genesis construction is slow (BLS deposit signatures); cache per
-    (count, preset) and hand out deep copies."""
+    """Genesis construction is slow (BLS deposit signatures); cached per
+    (count, preset) in-process AND on disk (geneses are deterministic —
+    the frozen-root KATs pin them — so a fresh process deserializes
+    ~10ms of SSZ instead of seconds of deposit crypto)."""
     context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    return make_genesis_state(validator_count, context), context
+    ns = build(context.preset)
+    state = _disk_cached(
+        f"genesis-phase0-{preset_name}-{validator_count}",
+        ns.BeaconState.serialize,
+        ns.BeaconState.deserialize,
+        lambda: make_genesis_state(validator_count, context),
+    )
+    return state, context
 
 
 def fresh_genesis(validator_count: int = 64, preset_name: str = "minimal"):
@@ -254,14 +330,24 @@ def make_genesis_payload_header(context, fork_name: str = "bellatrix"):
 def _cached_genesis_fork(fork_name: str, validator_count: int, preset_name: str):
     mod = _fork_module(fork_name)
     context = Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
-    deposits = make_deposits(validator_count, context)
-    kwargs = {}
-    if fork_name in _PAYLOAD_FORKS:
-        kwargs["execution_payload_header"] = make_genesis_payload_header(
-            context, fork_name
+
+    def builder():
+        deposits = make_deposits(validator_count, context)
+        kwargs = {}
+        if fork_name in _PAYLOAD_FORKS:
+            kwargs["execution_payload_header"] = make_genesis_payload_header(
+                context, fork_name
+            )
+        return mod.genesis.initialize_beacon_state_from_eth1(
+            ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context, **kwargs
         )
-    state = mod.genesis.initialize_beacon_state_from_eth1(
-        ETH1_BLOCK_HASH, ETH1_TIMESTAMP, deposits, context, **kwargs
+
+    state_type = getattr(mod.build(context.preset), "BeaconState")
+    state = _disk_cached(
+        f"genesis-{fork_name}-{preset_name}-{validator_count}",
+        state_type.serialize,
+        state_type.deserialize,
+        builder,
     )
     return state, context
 
