@@ -121,6 +121,8 @@ def _declare(lib) -> None:
         "ec_g1_subgroup_check_raw": ([p8], i32),
         "ec_g2_subgroup_check_raw": ([p8], i32),
         "ec_pairing_product_is_one_raw": ([p8, p8, p8, p8, sz], i32),
+        "ec_fp8_active": ([], i32),
+        "ec_fp8_selftest": ([c.c_uint64, i32], i32),
     }
     for name, (argtypes, restype) in sigs.items():
         fn = getattr(lib, name)
@@ -414,3 +416,18 @@ def fp12_final_exp_is_one(f576: bytes) -> bool:
     if rc < 0:
         raise NativeBlsError(decode_error_message(rc))
     return rc == 1
+
+
+def fp8_active() -> bool:
+    """True when the eight-wide AVX-512 IFMA field engine passed its init
+    self-check and serves the batched sqrt chains (hash-to-G2 / G2
+    decompression inside batch verification); False = scalar fallback."""
+    return _lib().ec_fp8_active() == 1
+
+
+def fp8_selftest(seed: int = 0, rounds: int = 50) -> int:
+    """Randomized engine-vs-scalar cross-check (mul/add/sub/sqrt families).
+
+    Returns 0 when every family agrees (or the engine is inactive); a
+    nonzero code identifies the first failing family."""
+    return _lib().ec_fp8_selftest(seed, rounds)

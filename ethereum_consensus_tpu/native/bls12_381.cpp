@@ -12,6 +12,11 @@
 #include <cstring>
 #include <cstddef>
 
+#if defined(__x86_64__)
+#include <immintrin.h>
+#define EC_FP8_COMPILED 1
+#endif
+
 #include "bls12_381_constants.h"
 
 typedef uint64_t u64;
@@ -608,6 +613,559 @@ static void fp2_from_raw(Fp2& out, const Fp2Raw& r) {
   for (int i = 0; i < NL; i++) { c0s.l[i] = r.c0.l[i]; c1s.l[i] = r.c1.l[i]; }
   fp_to_mont(out.c0, c0s);
   fp_to_mont(out.c1, c1s);
+}
+
+// ---------------------------------------------------------------------------
+// Batched scalar inversion (Montgomery's trick): one fp_inv plus 3(n-1)
+// multiplies for n inverses. Zero inputs pass through as zero (matching
+// fp_inv). Used by the eight-wide batch paths below, where per-element
+// fp_inv calls would otherwise dominate the scalar epilogues.
+// ---------------------------------------------------------------------------
+static void fp_inv_batch(Fp* vals, int n) {
+  if (n <= 0) return;
+  Fp pre[64];
+  Fp acc = FP_ONE;
+  int nz[64];
+  int m = 0;
+  for (int i = 0; i < n; i++) {
+    if (fp_is_zero(vals[i])) continue;
+    pre[m] = acc;
+    fp_mul(acc, acc, vals[i]);
+    nz[m++] = i;
+  }
+  if (m == 0) return;
+  Fp inv;
+  fp_inv(inv, acc);
+  for (int k = m - 1; k >= 0; k--) {
+    Fp v;
+    fp_mul(v, inv, pre[k]);
+    fp_mul(inv, inv, vals[nz[k]]);
+    vals[nz[k]] = v;
+  }
+}
+
+// n Fp2 inverses via the same trick on the norms: inv(a+bi) =
+// (a-bi)/(a^2+b^2), so n Fp2 inversions cost one fp_inv + O(n) muls.
+static void fp2_inv_batch(Fp2* vals, int n) {
+  if (n <= 0) return;
+  Fp norms[64];
+  for (int i = 0; i < n; i++) {
+    Fp t0, t1;
+    fp_sqr(t0, vals[i].c0);
+    fp_sqr(t1, vals[i].c1);
+    fp_add(norms[i], t0, t1);
+  }
+  fp_inv_batch(norms, n);
+  for (int i = 0; i < n; i++) {
+    fp_mul(vals[i].c0, vals[i].c0, norms[i]);
+    fp_mul(vals[i].c1, vals[i].c1, norms[i]);
+    fp_neg(vals[i].c1, vals[i].c1);
+  }
+}
+
+// ===========================================================================
+// FP8: eight-way SoA Fp arithmetic on AVX-512 IFMA (radix-2^52 Montgomery).
+//
+// The RLC batch-verification hot path spends most of its per-set scalar
+// time in fixed-exponent Fp power chains — the norm-method Fp2 square
+// roots inside hash-to-G2's SSWU maps and G2 signature decompression.
+// Those chains are identical instruction sequences over independent
+// data, so they vectorize losslessly: each __m512i holds limb j of
+// EIGHT field elements and vpmadd52{lo,hi}uq performs eight 52x52-bit
+// multiply-accumulates per instruction. The Montgomery radix here is
+// 2^416 (8 limbs x 52 bits) — distinct from the scalar path's 2^384 —
+// and values cross between domains through canonical limbs at batch
+// boundaries only.
+//
+// Dispatch is at RUN time (__builtin_cpu_supports + a self-check), so a
+// build cached on one machine can never execute IFMA on a host without
+// it; every batch entry point falls back to the scalar routines.
+// ===========================================================================
+
+static bool FP8_READY = false;
+static u64 P52[8];        // p, radix-2^52 limbs
+static u64 P52_INV;       // -p^{-1} mod 2^52
+static u64 R52SQ_52[8];   // 2^832 mod p (canonical radix-52): to-Montgomery multiplier
+static u64 TWOINV_M52[8]; // 2^{-1} in R52-Montgomery form == 2^415 mod p
+static const u64 MASK52 = (1ULL << 52) - 1;
+
+// 384-bit value: 6x64 canonical limbs <-> 8x52 canonical limbs
+static void limbs6_to_52(u64 out[8], const u64 in[6]) {
+  out[0] = in[0] & MASK52;
+  out[1] = ((in[0] >> 52) | (in[1] << 12)) & MASK52;
+  out[2] = ((in[1] >> 40) | (in[2] << 24)) & MASK52;
+  out[3] = ((in[2] >> 28) | (in[3] << 36)) & MASK52;
+  out[4] = ((in[3] >> 16) | (in[4] << 48)) & MASK52;
+  out[5] = (in[4] >> 4) & MASK52;
+  out[6] = ((in[4] >> 56) | (in[5] << 8)) & MASK52;
+  out[7] = in[5] >> 44;
+}
+
+static void limbs52_to_6(u64 out[6], const u64 in[8]) {
+  out[0] = in[0] | (in[1] << 52);
+  out[1] = (in[1] >> 12) | (in[2] << 40);
+  out[2] = (in[2] >> 24) | (in[3] << 28);
+  out[3] = (in[3] >> 36) | (in[4] << 16);
+  out[4] = (in[4] >> 48) | (in[5] << 4) | (in[6] << 56);
+  out[5] = (in[6] >> 8) | (in[7] << 44);
+}
+
+#ifdef EC_FP8_COMPILED
+#define EC_FP8_TARGET \
+  __attribute__((target("avx512f,avx512ifma,avx512vl,avx512dq,avx512bw")))
+
+struct Fp8 { __m512i l[8]; };  // l[j] = limb j of lanes 0..7
+
+EC_FP8_TARGET static void fp8_bcast(Fp8& o, const u64 limbs[8]) {
+  for (int j = 0; j < 8; j++) o.l[j] = _mm512_set1_epi64((long long)limbs[j]);
+}
+
+// Montgomery product, CIOS over radix 2^52. Accumulator limbs live in
+// 64-bit lanes with 12 bits of headroom; each physical slot receives at
+// most four sub-2^52 addends per iteration across eight iterations
+// (< 2^57 total), so no intra-loop carries are needed. Inputs must be
+// canonical (< p, 52-bit limbs); output is canonical.
+EC_FP8_TARGET static void fp8_montmul(Fp8& o, const Fp8& a, const Fp8& b) {
+  const __m512i zero = _mm512_setzero_si512();
+  const __m512i pinv = _mm512_set1_epi64((long long)P52_INV);
+  __m512i pv[8];
+  for (int j = 0; j < 8; j++) pv[j] = _mm512_set1_epi64((long long)P52[j]);
+  __m512i acc[9];
+  for (int j = 0; j < 9; j++) acc[j] = zero;
+  for (int i = 0; i < 8; i++) {
+    const __m512i bi = b.l[i];
+    for (int j = 0; j < 8; j++)
+      acc[j] = _mm512_madd52lo_epu64(acc[j], a.l[j], bi);
+    const __m512i m = _mm512_madd52lo_epu64(zero, acc[0], pinv);
+    acc[0] = _mm512_madd52lo_epu64(acc[0], m, pv[0]);
+    const __m512i carry = _mm512_srli_epi64(acc[0], 52);
+    for (int j = 1; j < 8; j++)
+      acc[j] = _mm512_madd52lo_epu64(acc[j], m, pv[j]);
+    for (int j = 0; j < 8; j++)
+      acc[j + 1] = _mm512_madd52hi_epu64(acc[j + 1], a.l[j], bi);
+    for (int j = 0; j < 8; j++)
+      acc[j + 1] = _mm512_madd52hi_epu64(acc[j + 1], m, pv[j]);
+    acc[1] = _mm512_add_epi64(acc[1], carry);
+    for (int j = 0; j < 8; j++) acc[j] = acc[j + 1];
+    acc[8] = zero;
+  }
+  // carry-normalize to 52-bit limbs (result < 2p fits 416 bits)
+  const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+  __m512i cr = zero;
+  for (int j = 0; j < 8; j++) {
+    acc[j] = _mm512_add_epi64(acc[j], cr);
+    cr = _mm512_srli_epi64(acc[j], 52);
+    acc[j] = _mm512_and_si512(acc[j], mask);
+  }
+  // conditional subtract p, lanewise
+  __m512i d[8], bor = zero;
+  const __m512i two52 = _mm512_set1_epi64(1LL << 52);
+  for (int j = 0; j < 8; j++) {
+    __m512i t = _mm512_sub_epi64(
+        _mm512_add_epi64(acc[j], two52), _mm512_add_epi64(pv[j], bor));
+    d[j] = _mm512_and_si512(t, mask);
+    bor = _mm512_xor_si512(_mm512_srli_epi64(t, 52), _mm512_set1_epi64(1));
+  }
+  const __mmask8 ge_p = _mm512_cmpeq_epu64_mask(bor, zero);
+  for (int j = 0; j < 8; j++)
+    o.l[j] = _mm512_mask_blend_epi64(ge_p, acc[j], d[j]);
+}
+
+EC_FP8_TARGET static void fp8_sqr(Fp8& o, const Fp8& a) { fp8_montmul(o, a, a); }
+
+// lanewise a + b mod p
+EC_FP8_TARGET static void fp8_add(Fp8& o, const Fp8& a, const Fp8& b) {
+  const __m512i zero = _mm512_setzero_si512();
+  const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+  const __m512i two52 = _mm512_set1_epi64(1LL << 52);
+  __m512i acc[8], cr = zero;
+  for (int j = 0; j < 8; j++) {
+    acc[j] = _mm512_add_epi64(_mm512_add_epi64(a.l[j], b.l[j]), cr);
+    cr = _mm512_srli_epi64(acc[j], 52);
+    acc[j] = _mm512_and_si512(acc[j], mask);
+  }
+  __m512i pv[8];
+  for (int j = 0; j < 8; j++) pv[j] = _mm512_set1_epi64((long long)P52[j]);
+  __m512i d[8], bor = zero;
+  for (int j = 0; j < 8; j++) {
+    __m512i t = _mm512_sub_epi64(
+        _mm512_add_epi64(acc[j], two52), _mm512_add_epi64(pv[j], bor));
+    d[j] = _mm512_and_si512(t, mask);
+    bor = _mm512_xor_si512(_mm512_srli_epi64(t, 52), _mm512_set1_epi64(1));
+  }
+  // note: sum < 2p always (inputs canonical), so one subtract suffices
+  const __mmask8 ge_p = _mm512_cmpeq_epu64_mask(bor, zero);
+  for (int j = 0; j < 8; j++)
+    o.l[j] = _mm512_mask_blend_epi64(ge_p, acc[j], d[j]);
+}
+
+// lanewise a - b mod p
+EC_FP8_TARGET static void fp8_sub(Fp8& o, const Fp8& a, const Fp8& b) {
+  const __m512i zero = _mm512_setzero_si512();
+  const __m512i mask = _mm512_set1_epi64((long long)MASK52);
+  const __m512i two52 = _mm512_set1_epi64(1LL << 52);
+  __m512i acc[8], bor = zero;
+  for (int j = 0; j < 8; j++) {
+    __m512i t = _mm512_sub_epi64(
+        _mm512_add_epi64(a.l[j], two52), _mm512_add_epi64(b.l[j], bor));
+    acc[j] = _mm512_and_si512(t, mask);
+    bor = _mm512_xor_si512(_mm512_srli_epi64(t, 52), _mm512_set1_epi64(1));
+  }
+  // lanes that borrowed get +p
+  const __mmask8 neg = _mm512_cmpeq_epu64_mask(bor, _mm512_set1_epi64(1));
+  __m512i cr = zero;
+  for (int j = 0; j < 8; j++) {
+    __m512i addend = _mm512_maskz_set1_epi64(neg, (long long)P52[j]);
+    acc[j] = _mm512_add_epi64(_mm512_add_epi64(acc[j], addend), cr);
+    cr = _mm512_srli_epi64(acc[j], 52);
+    acc[j] = _mm512_and_si512(acc[j], mask);
+  }
+  for (int j = 0; j < 8; j++) o.l[j] = acc[j];
+}
+
+// per-lane equality of canonical values -> bitmask
+EC_FP8_TARGET static __mmask8 fp8_eq_mask(const Fp8& a, const Fp8& b) {
+  __m512i diff = _mm512_setzero_si512();
+  for (int j = 0; j < 8; j++)
+    diff = _mm512_or_si512(diff, _mm512_xor_si512(a.l[j], b.l[j]));
+  return _mm512_cmpeq_epu64_mask(diff, _mm512_setzero_si512());
+}
+
+EC_FP8_TARGET static __mmask8 fp8_is_zero_mask(const Fp8& a) {
+  __m512i acc = _mm512_setzero_si512();
+  for (int j = 0; j < 8; j++) acc = _mm512_or_si512(acc, a.l[j]);
+  return _mm512_cmpeq_epu64_mask(acc, _mm512_setzero_si512());
+}
+
+// scalar-Montgomery Fp lanes -> R52-Montgomery SoA vector (lanes >= n
+// replicate lane 0 so padding never contains surprise values)
+EC_FP8_TARGET static void fp8_load(Fp8& o, const Fp* in, int n) {
+  u64 t[8][8];
+  for (int k = 0; k < 8; k++) {
+    Fp std_form;
+    fp_from_mont(std_form, in[k < n ? k : 0]);
+    limbs6_to_52(t[k], std_form.l);
+  }
+  for (int j = 0; j < 8; j++)
+    o.l[j] = _mm512_setr_epi64(
+        (long long)t[0][j], (long long)t[1][j], (long long)t[2][j],
+        (long long)t[3][j], (long long)t[4][j], (long long)t[5][j],
+        (long long)t[6][j], (long long)t[7][j]);
+  Fp8 r2;
+  fp8_bcast(r2, R52SQ_52);
+  fp8_montmul(o, o, r2);  // x_std * 2^832 * 2^-416 = x * 2^416: to Montgomery
+}
+
+// R52-Montgomery SoA vector -> scalar-Montgomery Fp lanes
+EC_FP8_TARGET static void fp8_store(Fp* out, const Fp8& a, int n) {
+  static const u64 ONE52[8] = {1, 0, 0, 0, 0, 0, 0, 0};
+  Fp8 onev, red;
+  fp8_bcast(onev, ONE52);
+  fp8_montmul(red, a, onev);  // from Montgomery: x * 2^-416 = canonical
+  u64 t[8][8];
+  for (int j = 0; j < 8; j++) {
+    alignas(64) u64 lane[8];
+    _mm512_store_si512((__m512i*)lane, red.l[j]);
+    for (int k = 0; k < 8; k++) t[k][j] = lane[k];
+  }
+  for (int k = 0; k < n; k++) {
+    Fp std_form;
+    limbs52_to_6(std_form.l, t[k]);
+    fp_to_mont(out[k], std_form);
+  }
+}
+
+// shared-exponent windowed power (all lanes raise to the SAME public
+// exponent, so the 4-bit window digit schedule is lane-independent)
+EC_FP8_TARGET static void fp8_pow(Fp8& out, const Fp8& base, const u64* exp,
+                                  int exp_limbs) {
+  int bits = exp_limbs * 64;
+  while (bits > 0 && !((exp[(bits - 1) >> 6] >> ((bits - 1) & 63)) & 1)) bits--;
+  if (bits == 0) {
+    // x^0 = 1 in Montgomery form: montmul(2^832, 1) = 2^416 mod p
+    static const u64 ONEP[8] = {1, 0, 0, 0, 0, 0, 0, 0};
+    Fp8 r2, onep;
+    fp8_bcast(r2, R52SQ_52);
+    fp8_bcast(onep, ONEP);
+    fp8_montmul(out, r2, onep);
+    return;
+  }
+  Fp8 tbl[15];
+  tbl[0] = base;
+  for (int i = 1; i < 15; i++) fp8_montmul(tbl[i], tbl[i - 1], base);
+  Fp8 result;
+  bool started = false;
+  for (int w = ((bits - 1) / 4) * 4; w >= 0; w -= 4) {
+    if (started) {
+      fp8_sqr(result, result);
+      fp8_sqr(result, result);
+      fp8_sqr(result, result);
+      fp8_sqr(result, result);
+    }
+    int d = (int)((exp[w >> 6] >> (w & 63)) & 15);
+    if (d) {
+      if (started) fp8_montmul(result, result, tbl[d - 1]);
+      else { result = tbl[d - 1]; started = true; }
+    }
+  }
+  out = result;
+}
+
+// Eight candidate square roots x_i = a_i^((p+1)/4) with per-lane
+// verification (x^2 == a); returns the success bitmask.
+EC_FP8_TARGET static __mmask8 fp8_sqrt(Fp8& out, const Fp8& a) {
+  fp8_pow(out, a, EXP_P_PLUS_1_DIV_4, 6);
+  Fp8 chk;
+  fp8_sqr(chk, out);
+  return fp8_eq_mask(chk, a);
+}
+
+// Batched norm-method Fp2 sqrt (the vector twin of fp2_sqrt above):
+// three batched Fp power chains — norm, (a+s)/2, (a-s)/2 — cover eight
+// roots, where the scalar path pays 2-3 chains EACH. Lanes with
+// c1 == 0 (real inputs) take the scalar path; every produced root is
+// verified per-lane, with scalar recomputation as the safety net, so
+// verdict semantics cannot drift from the scalar routine.
+EC_FP8_TARGET static u32 fp2_sqrt_x8_ifma(Fp2* out, const Fp2* const* in,
+                                          int n) {
+  u32 okbits = 0;
+  Fp av[8], bv[8];
+  int idx[8];
+  int m = 0;
+  for (int k = 0; k < n; k++) {
+    if (fp_is_zero(in[k]->c1)) {
+      Fp2 r;
+      if (fp2_sqrt(r, *in[k])) { out[k] = r; okbits |= 1u << k; }
+      continue;
+    }
+    av[m] = in[k]->c0;
+    bv[m] = in[k]->c1;
+    idx[m] = k;
+    m++;
+  }
+  if (!m) return okbits;
+  Fp8 a8, b8, n8, t, s8;
+  fp8_load(a8, av, m);
+  fp8_load(b8, bv, m);
+  fp8_sqr(n8, a8);
+  fp8_sqr(t, b8);
+  fp8_add(n8, n8, t);
+  const __mmask8 sq_ok = fp8_sqrt(s8, n8);   // norm must be square in Fp
+  Fp8 half, t1, t2, x1, x2;
+  fp8_bcast(half, TWOINV_M52);
+  fp8_add(t1, a8, s8);
+  fp8_montmul(t1, t1, half);
+  fp8_sub(t2, a8, s8);
+  fp8_montmul(t2, t2, half);
+  const __mmask8 x1_ok = fp8_sqrt(x1, t1);
+  const __mmask8 x1_nz = ~fp8_is_zero_mask(x1);
+  fp8_sqrt(x2, t2);
+  const __mmask8 use1 = x1_ok & x1_nz;
+  Fp8 x;
+  for (int j = 0; j < 8; j++)
+    x.l[j] = _mm512_mask_blend_epi64(use1, x2.l[j], x1.l[j]);
+  Fp xs[8];
+  fp8_store(xs, x, m);
+  // y = b / (2x): batch the lane inversions through one fp_inv
+  Fp dens[8];
+  for (int k = 0; k < m; k++) fp_dbl(dens[k], xs[k]);
+  fp_inv_batch(dens, m);
+  for (int k = 0; k < m; k++) {
+    if (!((sq_ok >> k) & 1)) continue;  // non-square input: leave unset
+    Fp2 r;
+    r.c0 = xs[k];
+    fp_mul(r.c1, bv[k], dens[k]);
+    Fp2 chk;
+    fp2_sqr(chk, r);
+    if (fp2_eq(chk, *in[idx[k]])) {
+      out[idx[k]] = r;
+      okbits |= 1u << idx[k];
+    } else {
+      // engine disagreement: defer to the scalar routine (never expected;
+      // keeps verdicts exactly equal to the scalar path by construction)
+      Fp2 r2;
+      if (fp2_sqrt(r2, *in[idx[k]])) { out[idx[k]] = r2; okbits |= 1u << idx[k]; }
+    }
+  }
+  return okbits;
+}
+#endif  // EC_FP8_COMPILED
+
+// Dispatch wrapper: batched Fp2 sqrt over up to 8 independent inputs
+// (pointer array), scalar fallback when the IFMA engine is unavailable.
+static u32 fp2_sqrt_x8(Fp2* out, const Fp2* const* in, int n) {
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY) return fp2_sqrt_x8_ifma(out, in, n);
+#endif
+  u32 okbits = 0;
+  for (int k = 0; k < n; k++) {
+    Fp2 r;
+    if (fp2_sqrt(r, *in[k])) { out[k] = r; okbits |= 1u << k; }
+  }
+  return okbits;
+}
+
+#ifdef EC_FP8_COMPILED
+// init-time self-check: random-ish vectors must round-trip and agree
+// with the scalar field on mul/add/sub/pow before FP8_READY flips on
+EC_FP8_TARGET static bool fp8_selfcheck() {
+  u64 seed = 0x9e3779b97f4a7c15ULL;
+  Fp vals[16];
+  for (int i = 0; i < 16; i++) {
+    Fp s;
+    for (int j = 0; j < 6; j++) {
+      seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
+      s.l[j] = seed;
+    }
+    s.l[5] &= (1ULL << 61) - 1;  // < p after reduction headroom
+    // reduce below p: conditional subtract a few times
+    for (int r = 0; r < 4; r++) {
+      if (fp_cmp_raw(s.l, P_RAW.l) >= 0) {
+        u64 borrow = 0;
+        for (int j = 0; j < 6; j++) s.l[j] = sbb(s.l[j], P_RAW.l[j], borrow);
+      }
+    }
+    fp_to_mont(vals[i], s);
+  }
+  vals[14] = FP_ZERO;
+  vals[15] = FP_ONE;
+  Fp8 a8, b8, r8;
+  fp8_load(a8, vals, 8);
+  fp8_load(b8, vals + 8, 8);
+  // round-trip
+  Fp back[8];
+  fp8_store(back, a8, 8);
+  for (int i = 0; i < 8; i++)
+    if (!fp_eq(back[i], vals[i])) return false;
+  // mul / add / sub vs scalar
+  Fp want[8], got[8];
+  fp8_montmul(r8, a8, b8);
+  fp8_store(got, r8, 8);
+  for (int i = 0; i < 8; i++) {
+    fp_mul(want[i], vals[i], vals[8 + i]);
+    if (!fp_eq(got[i], want[i])) return false;
+  }
+  fp8_add(r8, a8, b8);
+  fp8_store(got, r8, 8);
+  for (int i = 0; i < 8; i++) {
+    fp_add(want[i], vals[i], vals[8 + i]);
+    if (!fp_eq(got[i], want[i])) return false;
+  }
+  fp8_sub(r8, a8, b8);
+  fp8_store(got, r8, 8);
+  for (int i = 0; i < 8; i++) {
+    fp_sub(want[i], vals[i], vals[8 + i]);
+    if (!fp_eq(got[i], want[i])) return false;
+  }
+  fp8_pow(r8, a8, EXP_P_PLUS_1_DIV_4, 6);
+  fp8_store(got, r8, 8);
+  for (int i = 0; i < 8; i++) {
+    fp_pow(want[i], vals[i], EXP_P_PLUS_1_DIV_4, 6);
+    if (!fp_eq(got[i], want[i])) return false;
+  }
+  return true;
+}
+#endif  // EC_FP8_COMPILED
+
+#ifdef EC_FP8_COMPILED
+// randomized engine-vs-scalar cross-check (driven by ec_fp8_selftest)
+EC_FP8_TARGET static int fp8_selftest_deep(u64 seed, int rounds) {
+  if (!seed) seed = 0x853c49e6748fea9bULL;
+  for (int r = 0; r < rounds; r++) {
+    Fp va[8], vb[8];
+    for (int i = 0; i < 8; i++) {
+      Fp s;
+      for (int j = 0; j < 6; j++) {
+        seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
+        s.l[j] = seed;
+      }
+      s.l[5] &= (1ULL << 60) - 1;
+      fp_to_mont(va[i], s);
+      for (int j = 0; j < 6; j++) {
+        seed ^= seed << 13; seed ^= seed >> 7; seed ^= seed << 17;
+        s.l[j] = seed;
+      }
+      s.l[5] &= (1ULL << 60) - 1;
+      fp_to_mont(vb[i], s);
+    }
+    if (r == 0) { va[0] = FP_ZERO; vb[1] = FP_ZERO; va[2] = FP_ONE; }
+    Fp8 a8, b8, r8;
+    fp8_load(a8, va, 8);
+    fp8_load(b8, vb, 8);
+    Fp got[8], want;
+    fp8_montmul(r8, a8, b8);
+    fp8_store(got, r8, 8);
+    for (int i = 0; i < 8; i++) {
+      fp_mul(want, va[i], vb[i]);
+      if (!fp_eq(got[i], want)) return 1;
+    }
+    fp8_add(r8, a8, b8);
+    fp8_store(got, r8, 8);
+    for (int i = 0; i < 8; i++) {
+      fp_add(want, va[i], vb[i]);
+      if (!fp_eq(got[i], want)) return 2;
+    }
+    fp8_sub(r8, a8, b8);
+    fp8_store(got, r8, 8);
+    for (int i = 0; i < 8; i++) {
+      fp_sub(want, va[i], vb[i]);
+      if (!fp_eq(got[i], want)) return 3;
+    }
+    // batched Fp2 sqrt agrees with the scalar routine, both on known
+    // squares and on raw random candidates (~half non-squares)
+    Fp2 roots[4], squares[4], outs[4];
+    const Fp2* ptrs[4];
+    for (int i = 0; i < 4; i++) {
+      roots[i].c0 = va[i];
+      roots[i].c1 = vb[i];
+      fp2_sqr(squares[i], roots[i]);
+      ptrs[i] = &squares[i];
+    }
+    u32 okb = fp2_sqrt_x8(outs, ptrs, 4);
+    if (okb != 0xF) return 4;
+    for (int i = 0; i < 4; i++) {
+      Fp2 chk;
+      fp2_sqr(chk, outs[i]);
+      if (!fp2_eq(chk, squares[i])) return 5;
+    }
+    Fp2 rawin[4], rawout[4];
+    const Fp2* rawptr[4];
+    for (int i = 0; i < 4; i++) {
+      rawin[i].c0 = va[4 + i];
+      rawin[i].c1 = vb[4 + i];
+      rawptr[i] = &rawin[i];
+    }
+    u32 gotmask = fp2_sqrt_x8(rawout, rawptr, 4);
+    for (int i = 0; i < 4; i++) {
+      Fp2 want2;
+      bool want_ok = fp2_sqrt(want2, rawin[i]);
+      if (((gotmask >> i) & 1) != (want_ok ? 1u : 0u)) return 6;
+    }
+  }
+  return 0;
+}
+#endif  // EC_FP8_COMPILED
+
+// called from ensure_init once the scalar Montgomery machinery is up
+static void fp8_engine_init() {
+  FP8_READY = false;
+#ifdef EC_FP8_COMPILED
+  if (!__builtin_cpu_supports("avx512ifma") ||
+      !__builtin_cpu_supports("avx512f") ||
+      !__builtin_cpu_supports("avx512dq") ||
+      !__builtin_cpu_supports("avx512bw") ||
+      !__builtin_cpu_supports("avx512vl"))
+    return;
+  limbs6_to_52(P52, P_RAW.l);
+  P52_INV = FP_INV & MASK52;  // inverse mod 2^64 truncates to mod 2^52
+  // 2^832 mod p and 2^415 mod p by doubling (canonical limbs)
+  Fp acc = {{1, 0, 0, 0, 0, 0}};
+  for (int i = 0; i < 415; i++) fp_add(acc, acc, acc);
+  limbs6_to_52(TWOINV_M52, acc.l);
+  for (int i = 415; i < 832; i++) fp_add(acc, acc, acc);
+  limbs6_to_52(R52SQ_52, acc.l);
+  FP8_READY = fp8_selfcheck();
+#endif
 }
 
 // ---------------------------------------------------------------------------
@@ -1611,6 +2169,9 @@ static void ensure_init() {
   // validate + enable the endomorphism fast paths (psi cofactor clearing,
   // psi/GLV subgroup criteria) before any caller can race on their state
   validate_endomorphism_fast_paths();
+  // eight-wide IFMA engine last: its self-check wants the exponent
+  // tables and scalar field fully set up
+  fp8_engine_init();
   INITIALIZED = true;
 }
 
@@ -2107,6 +2668,216 @@ static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
 }
 
 // ---------------------------------------------------------------------------
+// Batched hash-to-G2 / G2 decompression: the same algorithms as their
+// scalar twins above, with the Fp2 sqrt chains routed through the
+// eight-wide IFMA engine (fp2_sqrt_x8) and the scalar inversions through
+// Montgomery batch inversion. Outputs are bit-identical to the scalar
+// routines — SSWU canonicalizes the root's sign via sgn0 and
+// decompression via the lex-largest flag, so WHICH square root the
+// engine returns cannot matter — and fp2_sqrt_x8 verifies each root
+// per-lane with scalar recomputation as the net.
+// ---------------------------------------------------------------------------
+
+// SSWU over n independent u values (n <= 32): scalar prologue with one
+// batched inversion, batched gx1 sqrt chains, then a batched gx2 retry
+// for lanes whose gx1 was a non-square (mirrors map_to_curve_sswu)
+static void map_to_curve_sswu_batch(Fp2* xs, Fp2* ys, const Fp2* us, int n) {
+  Fp2 zu2[32], x1[32], gx1[32], tv[32], y1[32];
+  bool tv_zero[32];
+  for (int i = 0; i < n; i++) {
+    Fp2 u2;
+    fp2_sqr(u2, us[i]);
+    fp2_mul(zu2[i], SSWU_Z, u2);
+    fp2_sqr(tv[i], zu2[i]);
+    fp2_add(tv[i], tv[i], zu2[i]);
+    tv_zero[i] = fp2_is_zero(tv[i]);
+    if (tv_zero[i]) tv[i] = FP2_ONE;  // placeholder; lane uses B_OVER_ZA
+  }
+  fp2_inv_batch(tv, n);
+  for (int i = 0; i < n; i++) {
+    Fp2 t, x3, ax;
+    if (tv_zero[i]) {
+      x1[i] = SSWU_B_OVER_ZA;
+    } else {
+      fp2_add(t, FP2_ONE, tv[i]);
+      fp2_mul(x1[i], SSWU_NEG_B_OVER_A, t);
+    }
+    fp2_sqr(t, x1[i]);
+    fp2_mul(x3, t, x1[i]);
+    fp2_mul(ax, SSWU_A, x1[i]);
+    fp2_add(gx1[i], x3, ax);
+    fp2_add(gx1[i], gx1[i], SSWU_B);
+  }
+  u32 ok = 0;
+  for (int base = 0; base < n; base += 8) {
+    int c = n - base < 8 ? n - base : 8;
+    const Fp2* ptrs[8];
+    for (int k = 0; k < c; k++) ptrs[k] = &gx1[base + k];
+    ok |= fp2_sqrt_x8(y1 + base, ptrs, c) << base;
+  }
+  int fidx[32], nf = 0;
+  Fp2 gx2[32], y2o[32];
+  for (int i = 0; i < n; i++) {
+    if ((ok >> i) & 1) {
+      xs[i] = x1[i];
+      ys[i] = y1[i];
+      continue;
+    }
+    Fp2 x2, t, x3, ax;
+    fp2_mul(x2, zu2[i], x1[i]);
+    xs[i] = x2;
+    fp2_sqr(t, x2);
+    fp2_mul(x3, t, x2);
+    fp2_mul(ax, SSWU_A, x2);
+    fp2_add(gx2[nf], x3, ax);
+    fp2_add(gx2[nf], gx2[nf], SSWU_B);
+    fidx[nf++] = i;
+  }
+  for (int base = 0; base < nf; base += 8) {
+    int c = nf - base < 8 ? nf - base : 8;
+    const Fp2* ptrs[8];
+    for (int k = 0; k < c; k++) ptrs[k] = &gx2[base + k];
+    fp2_sqrt_x8(y2o + base, ptrs, c);  // must succeed when gx1 is not square
+  }
+  for (int k = 0; k < nf; k++) ys[fidx[k]] = y2o[k];
+  for (int i = 0; i < n; i++)
+    if (fp2_sgn0(ys[i]) != fp2_sgn0(us[i])) fp2_neg(ys[i], ys[i]);
+}
+
+// hash-to-G2 over n messages: expand_message_xmd stays scalar (SHA-256
+// bound), SSWU sqrts batch eight-wide, the isogeny denominators share
+// one inversion per chunk, cofactor clearing stays scalar point math
+static bool hash_to_g2_batch(G2* out, const u8* msgs, const u32* msg_lens,
+                             size_t n, const u8* dst, size_t dst_len) {
+  const int CH = 16;  // messages per chunk -> 32 SSWU jobs
+  size_t off = 0;
+  for (size_t base = 0; base < n; base += CH) {
+    int c = (int)(n - base < (size_t)CH ? n - base : CH);
+    Fp2 us[32], xs[32], ys[32];
+    for (int k = 0; k < c; k++) {
+      u8 uniform[256];
+      if (!expand_message_xmd(uniform, 256, msgs + off, msg_lens[base + k],
+                              dst, dst_len))
+        return false;
+      off += msg_lens[base + k];
+      fp_from_64_bytes(us[2 * k].c0, uniform);
+      fp_from_64_bytes(us[2 * k].c1, uniform + 64);
+      fp_from_64_bytes(us[2 * k + 1].c0, uniform + 128);
+      fp_from_64_bytes(us[2 * k + 1].c1, uniform + 192);
+    }
+    map_to_curve_sswu_batch(xs, ys, us, 2 * c);
+    // isogeny with batched denominator inversion (2 per SSWU output)
+    Fp2 xn[32], yn[32], den[64];
+    bool inf[32];
+    for (int j = 0; j < 2 * c; j++) {
+      Fp2 xd, yd;
+      horner_fp2(xn[j], ISO_XN, 4, xs[j]);
+      horner_fp2(xd, ISO_XD, 3, xs[j]);
+      horner_fp2(yn[j], ISO_YN, 4, xs[j]);
+      horner_fp2(yd, ISO_YD, 4, xs[j]);
+      inf[j] = fp2_is_zero(xd) || fp2_is_zero(yd);
+      den[2 * j] = inf[j] ? FP2_ONE : xd;
+      den[2 * j + 1] = inf[j] ? FP2_ONE : yd;
+    }
+    fp2_inv_batch(den, 2 * c * 2);
+    for (int k = 0; k < c; k++) {
+      G2 q[2];
+      for (int h = 0; h < 2; h++) {
+        int j = 2 * k + h;
+        if (inf[j]) {
+          q[h] = pt_infinity<Fp2Ops>();
+          continue;
+        }
+        Fp2 xo, yo, t;
+        fp2_mul(xo, xn[j], den[2 * j]);
+        fp2_mul(t, yn[j], den[2 * j + 1]);
+        fp2_mul(yo, ys[j], t);
+        q[h] = pt_from_affine<Fp2Ops>(xo, yo);
+      }
+      G2 sum;
+      pt_add(sum, q[0], q[1]);
+      g2_clear_cofactor(out[base + k], sum);
+    }
+  }
+  return true;
+}
+
+// n compressed G2 points with the sqrt chains batched; per-point rc
+// mirrors g2_decompress exactly (same codes, same order of checks)
+static void g2_decompress_batch(G2* out, int* rcs, const u8* sigs, size_t n,
+                                bool check_subgroup) {
+  Fp2* xs = new Fp2[n];
+  Fp2* y2s = new Fp2[n];
+  u8* sign_flags = new u8[n];
+  for (size_t i = 0; i < n; i++) {
+    const u8* in = sigs + 96 * i;
+    u8 flags = in[0];
+    sign_flags[i] = flags & FLAG_SIGN;
+    rcs[i] = DEC_OK;
+    if (!(flags & FLAG_COMPRESSED)) {
+      rcs[i] = DEC_NOT_COMPRESSED;
+      continue;
+    }
+    if (flags & FLAG_INFINITY) {
+      rcs[i] = DEC_BAD_INFINITY;
+      if (!(flags & ~(FLAG_COMPRESSED | FLAG_INFINITY))) {
+        bool zero = true;
+        for (int b = 1; b < 96; b++)
+          if (in[b]) { zero = false; break; }
+        if (zero) {
+          out[i] = pt_infinity<Fp2Ops>();
+          rcs[i] = DEC_OK;
+          continue;
+        }
+      }
+      continue;
+    }
+    u8 buf[48];
+    memcpy(buf, in, 48);
+    buf[0] = flags & 0x1F;
+    if (!fp_from_bytes(xs[i].c1, buf) || !fp_from_bytes(xs[i].c0, in + 48)) {
+      rcs[i] = DEC_NOT_IN_FIELD;
+      continue;
+    }
+    Fp2 t;
+    fp2_sqr(t, xs[i]);
+    fp2_mul(y2s[i], t, xs[i]);
+    fp2_add(y2s[i], y2s[i], G2_B);
+    rcs[i] = -1;  // marks "sqrt pending"
+  }
+  int pend[8];
+  const Fp2* ptrs[8];
+  Fp2 roots[8];
+  {
+    int m = 0;
+    for (size_t k = 0; k <= n; k++) {
+      if (k < n && rcs[k] == -1) pend[m++] = (int)k;
+      if ((m == 8 || k == n) && m > 0) {
+        for (int j = 0; j < m; j++) ptrs[j] = &y2s[pend[j]];
+        u32 ok = fp2_sqrt_x8(roots, ptrs, m);
+        for (int j = 0; j < m; j++) {
+          size_t idx = pend[j];
+          if (!((ok >> j) & 1)) {
+            rcs[idx] = DEC_NOT_ON_CURVE;
+            continue;
+          }
+          Fp2 y = roots[j];
+          if (fp2_is_lex_largest(y) != !!sign_flags[idx]) fp2_neg(y, y);
+          out[idx] = pt_from_affine<Fp2Ops>(xs[idx], y);
+          rcs[idx] = DEC_OK;
+          if (check_subgroup && !g2_in_subgroup(out[idx]))
+            rcs[idx] = DEC_NOT_IN_SUBGROUP;
+        }
+        m = 0;
+      }
+    }
+  }
+  delete[] xs;
+  delete[] y2s;
+  delete[] sign_flags;
+}
+
+// ---------------------------------------------------------------------------
 // Pippenger multi-scalar multiplication
 // ---------------------------------------------------------------------------
 
@@ -2358,7 +3129,27 @@ static bool g2_from_raw(G2& out, const u8 in[192], int is_inf) {
 
 extern "C" {
 
-u64 ec_bls_version() { return 3; }
+u64 ec_bls_version() { return 4; }
+
+// 1 when the eight-wide IFMA field engine passed its init self-check and
+// is serving the batched sqrt chains; 0 = scalar fallback in use
+int ec_fp8_active() {
+  ensure_init();
+  return FP8_READY ? 1 : 0;
+}
+
+// Deep self-test of the IFMA engine against the scalar field: random
+// mul/add/sub/sqrt cross-checks. 0 = ok (or engine inactive);
+// a nonzero code identifies the first failing family.
+int ec_fp8_selftest(u64 seed, int rounds) {
+  ensure_init();
+  if (!FP8_READY) return 0;
+#ifdef EC_FP8_COMPILED
+  return fp8_selftest_deep(seed, rounds);
+#else
+  return 0;
+#endif
+}
 
 int ec_g1_decompress(const u8* in, u8* out_raw, int* is_inf, int check_subgroup) {
   ensure_init();
@@ -2641,9 +3432,11 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
   G1* ps = new G1[n_sets + 1];
   G2* qs = new G2[n_sets + 1];
   G2* sig_pts = new G2[n_sets];
+  int* rcs = new int[n_sets];
   u64* sig_scalars = new u64[4 * n_sets];
-  size_t pk_off = 0, msg_off = 0;
+  size_t pk_off = 0;
   bool ok = true;
+  // phase 1 (scalar): per-set pubkey aggregation + blinder mults
   for (size_t i = 0; i < n_sets && ok; i++) {
     u32 cnt = pk_counts[i];
     if (cnt == 0) { ok = false; break; }
@@ -2658,28 +3451,24 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
     }
     pk_off += cnt;
     if (!ok) break;
-    G2 sig;
-    if (g2_decompress(sig, sigs + 96 * i, true) != DEC_OK || sig.is_inf() ||
-        agg.is_inf()) {
-      ok = false;
-      break;
-    }
+    if (agg.is_inf()) { ok = false; break; }
     u64 r[4] = {0, 0, 0, 0};
     for (int b = 0; b < 8; b++) r[1] = (r[1] << 8) | scalars16[16 * i + b];
     for (int b = 8; b < 16; b++) r[0] = (r[0] << 8) | scalars16[16 * i + b];
     if ((r[0] | r[1]) == 0) { ok = false; break; }
-    G1 rp;
-    pt_mul(rp, agg, r, 2);
-    ps[i] = rp;
-    sig_pts[i] = sig;
+    pt_mul(ps[i], agg, r, 2);
     sig_scalars[4 * i] = r[0]; sig_scalars[4 * i + 1] = r[1];
     sig_scalars[4 * i + 2] = 0; sig_scalars[4 * i + 3] = 0;
-    if (!hash_to_g2_point(qs[i], msgs + msg_off, msg_lens[i], dst, dst_len)) {
-      ok = false;
-      break;
-    }
-    msg_off += msg_lens[i];
   }
+  // phase 2: signature decompression, sqrt chains batched eight-wide
+  if (ok) {
+    g2_decompress_batch(sig_pts, rcs, sigs, n_sets, true);
+    for (size_t i = 0; i < n_sets; i++)
+      if (rcs[i] != DEC_OK || sig_pts[i].is_inf()) { ok = false; break; }
+  }
+  // phase 3: hash-to-G2, SSWU sqrt chains batched eight-wide
+  if (ok) ok = hash_to_g2_batch(qs, msgs, msg_lens, n_sets, dst, dst_len);
+  // phase 4: blinded-signature MSM + shared multi-pairing
   if (ok) {
     G2 sig_acc;
     pt_msm(sig_acc, sig_pts, sig_scalars, n_sets, 128);
@@ -2690,6 +3479,7 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
   delete[] ps;
   delete[] qs;
   delete[] sig_pts;
+  delete[] rcs;
   delete[] sig_scalars;
   return ok ? 1 : 0;
 }

@@ -291,3 +291,28 @@ def test_g1_fast_subgroup_check_rejects_off_subgroup_points():
         assert rc == 0 and not is_inf, "constructed curve point failed to decompress"
         rc2, _, _ = native_bls.g1_decompress(cand, check_subgroup=True)
         assert rc2 == -6, f"off-subgroup G1 point accepted (rc={rc2})"
+
+
+class TestFp8Engine:
+    """The eight-wide AVX-512 IFMA field engine (native fp8_*): active
+    only after an init self-check; its batched sqrt chains must agree
+    with the scalar field on every family (deep randomized cross-check
+    lives in C so it exercises the exact production kernels)."""
+
+    def test_selftest_clean(self):
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        # rc 0 = all families agree (also the required answer when the
+        # host has no IFMA and the engine reports inactive)
+        assert nb.fp8_selftest(seed=7, rounds=100) == 0
+
+    def test_active_implies_selfchecked(self):
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        # fp8_active is allowed to be False (non-IFMA host) but must be a
+        # clean bool either way
+        assert nb.fp8_active() in (True, False)
