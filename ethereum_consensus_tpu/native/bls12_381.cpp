@@ -2668,6 +2668,315 @@ static bool hash_to_g2_point(G2& out, const u8* msg, size_t msg_len,
 }
 
 // ---------------------------------------------------------------------------
+// Eight-lane G2 point arithmetic on the IFMA engine. Straight-line
+// Jacobian formulas (no per-lane branching): z == 0 IS the infinity
+// representation, doubling preserves it (z3 = 2yz) and addition handles
+// infinite operands by lane-blending, so the only genuinely exceptional
+// case left is adding two EQUAL finite points — those lanes are flagged
+// in an exception mask and recomputed scalar (cryptographically random
+// inputs never hit this; correctness never depends on that).
+// ---------------------------------------------------------------------------
+
+#ifdef EC_FP8_COMPILED
+
+EC_FP8_TARGET static void fp8_neg(Fp8& o, const Fp8& a) {
+  Fp8 z;
+  for (int j = 0; j < 8; j++) z.l[j] = _mm512_setzero_si512();
+  fp8_sub(o, z, a);
+}
+
+struct Fp2x8 { Fp8 c0, c1; };
+
+EC_FP8_TARGET static void fp2x8_add(Fp2x8& o, const Fp2x8& a, const Fp2x8& b) {
+  fp8_add(o.c0, a.c0, b.c0);
+  fp8_add(o.c1, a.c1, b.c1);
+}
+EC_FP8_TARGET static void fp2x8_sub(Fp2x8& o, const Fp2x8& a, const Fp2x8& b) {
+  fp8_sub(o.c0, a.c0, b.c0);
+  fp8_sub(o.c1, a.c1, b.c1);
+}
+EC_FP8_TARGET static void fp2x8_neg(Fp2x8& o, const Fp2x8& a) {
+  fp8_neg(o.c0, a.c0);
+  fp8_neg(o.c1, a.c1);
+}
+EC_FP8_TARGET static void fp2x8_conj(Fp2x8& o, const Fp2x8& a) {
+  o.c0 = a.c0;
+  fp8_neg(o.c1, a.c1);
+}
+// Karatsuba over i^2 = -1, the vector twin of fp2_mul
+EC_FP8_TARGET static void fp2x8_mul(Fp2x8& o, const Fp2x8& a, const Fp2x8& b) {
+  Fp8 t0, t1, sa, sb, m;
+  fp8_montmul(t0, a.c0, b.c0);
+  fp8_montmul(t1, a.c1, b.c1);
+  fp8_add(sa, a.c0, a.c1);
+  fp8_add(sb, b.c0, b.c1);
+  fp8_montmul(m, sa, sb);
+  fp8_sub(m, m, t0);
+  fp8_sub(o.c1, m, t1);
+  fp8_sub(o.c0, t0, t1);
+}
+EC_FP8_TARGET static void fp2x8_sqr(Fp2x8& o, const Fp2x8& a) {
+  Fp8 s, d, m, t;
+  fp8_add(s, a.c0, a.c1);
+  fp8_sub(d, a.c0, a.c1);
+  fp8_montmul(m, s, d);          // a0^2 - a1^2
+  fp8_montmul(t, a.c0, a.c1);
+  fp8_add(o.c1, t, t);
+  o.c0 = m;
+}
+EC_FP8_TARGET static __mmask8 fp2x8_is_zero_mask(const Fp2x8& a) {
+  return fp8_is_zero_mask(a.c0) & fp8_is_zero_mask(a.c1);
+}
+EC_FP8_TARGET static __mmask8 fp2x8_eq_mask(const Fp2x8& a, const Fp2x8& b) {
+  return fp8_eq_mask(a.c0, b.c0) & fp8_eq_mask(a.c1, b.c1);
+}
+EC_FP8_TARGET static void fp8_blend(Fp8& o, __mmask8 take_b, const Fp8& a,
+                                    const Fp8& b) {
+  for (int j = 0; j < 8; j++)
+    o.l[j] = _mm512_mask_blend_epi64(take_b, a.l[j], b.l[j]);
+}
+EC_FP8_TARGET static void fp2x8_blend(Fp2x8& o, __mmask8 take_b,
+                                      const Fp2x8& a, const Fp2x8& b) {
+  fp8_blend(o.c0, take_b, a.c0, b.c0);
+  fp8_blend(o.c1, take_b, a.c1, b.c1);
+}
+// broadcast one scalar Fp2 into all lanes
+EC_FP8_TARGET static void fp2x8_bcast_fp2(Fp2x8& o, const Fp2& v) {
+  fp8_load(o.c0, &v.c0, 1);
+  fp8_load(o.c1, &v.c1, 1);
+}
+
+struct G2x8 { Fp2x8 x, y, z; };
+
+EC_FP8_TARGET static void g2x8_load(G2x8& o, const G2* pts, int n) {
+  Fp xs0[8], xs1[8], ys0[8], ys1[8], zs0[8], zs1[8];
+  for (int k = 0; k < 8; k++) {
+    const G2& p = pts[k < n ? k : 0];
+    xs0[k] = p.x.c0; xs1[k] = p.x.c1;
+    ys0[k] = p.y.c0; ys1[k] = p.y.c1;
+    zs0[k] = p.z.c0; zs1[k] = p.z.c1;
+  }
+  fp8_load(o.x.c0, xs0, 8); fp8_load(o.x.c1, xs1, 8);
+  fp8_load(o.y.c0, ys0, 8); fp8_load(o.y.c1, ys1, 8);
+  fp8_load(o.z.c0, zs0, 8); fp8_load(o.z.c1, zs1, 8);
+}
+
+EC_FP8_TARGET static void g2x8_store(G2* out, const G2x8& a, int n) {
+  Fp xs0[8], xs1[8], ys0[8], ys1[8], zs0[8], zs1[8];
+  fp8_store(xs0, a.x.c0, 8); fp8_store(xs1, a.x.c1, 8);
+  fp8_store(ys0, a.y.c0, 8); fp8_store(ys1, a.y.c1, 8);
+  fp8_store(zs0, a.z.c0, 8); fp8_store(zs1, a.z.c1, 8);
+  for (int k = 0; k < n; k++) {
+    out[k].x.c0 = xs0[k]; out[k].x.c1 = xs1[k];
+    out[k].y.c0 = ys0[k]; out[k].y.c1 = ys1[k];
+    out[k].z.c0 = zs0[k]; out[k].z.c1 = zs1[k];
+  }
+}
+
+// dbl-2009-l, lane-complete: infinity (z=0) and y=0 both yield z3=0
+EC_FP8_TARGET static void g2x8_dbl(G2x8& o, const G2x8& p) {
+  Fp2x8 a, b, c, d, e, f, t, c8;
+  fp2x8_sqr(a, p.x);
+  fp2x8_sqr(b, p.y);
+  fp2x8_sqr(c, b);
+  fp2x8_add(t, p.x, b);
+  fp2x8_sqr(t, t);
+  fp2x8_sub(t, t, a);
+  fp2x8_sub(d, t, c);
+  fp2x8_add(d, d, d);
+  fp2x8_add(e, a, a);
+  fp2x8_add(e, e, a);
+  fp2x8_sqr(f, e);
+  Fp2x8 x3, y3, z3;
+  fp2x8_sub(x3, f, d);
+  fp2x8_sub(x3, x3, d);
+  fp2x8_add(c8, c, c);
+  fp2x8_add(c8, c8, c8);
+  fp2x8_add(c8, c8, c8);
+  fp2x8_sub(t, d, x3);
+  fp2x8_mul(y3, e, t);
+  fp2x8_sub(y3, y3, c8);
+  fp2x8_mul(z3, p.y, p.z);
+  fp2x8_add(z3, z3, z3);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+// add-2007-bl with infinity lane-blending; equal-finite-point lanes
+// (the doubling case) are accumulated into *exc for scalar recomputation
+EC_FP8_TARGET static void g2x8_add(G2x8& o, const G2x8& p, const G2x8& q,
+                                  __mmask8& exc) {
+  const __mmask8 pinf = fp2x8_is_zero_mask(p.z);
+  const __mmask8 qinf = fp2x8_is_zero_mask(q.z);
+  Fp2x8 z1z1, z2z2, u1, u2, s1, s2, t;
+  fp2x8_sqr(z1z1, p.z);
+  fp2x8_sqr(z2z2, q.z);
+  fp2x8_mul(u1, p.x, z2z2);
+  fp2x8_mul(u2, q.x, z1z1);
+  fp2x8_mul(t, p.y, q.z);
+  fp2x8_mul(s1, t, z2z2);
+  fp2x8_mul(t, q.y, p.z);
+  fp2x8_mul(s2, t, z1z1);
+  const __mmask8 equ = fp2x8_eq_mask(u1, u2);
+  const __mmask8 eqs = fp2x8_eq_mask(s1, s2);
+  exc |= (__mmask8)(~pinf & ~qinf & equ & eqs);
+  Fp2x8 h, i, j, r, v, x3, y3, z3;
+  fp2x8_sub(h, u2, u1);            // h == 0 with s1 != s2: P = -Q, z3 = 0 below
+  fp2x8_add(i, h, h);
+  fp2x8_sqr(i, i);
+  fp2x8_mul(j, h, i);
+  fp2x8_sub(r, s2, s1);
+  fp2x8_add(r, r, r);
+  fp2x8_mul(v, u1, i);
+  fp2x8_sqr(x3, r);
+  fp2x8_sub(x3, x3, j);
+  fp2x8_sub(x3, x3, v);
+  fp2x8_sub(x3, x3, v);
+  fp2x8_sub(t, v, x3);
+  fp2x8_mul(y3, r, t);
+  Fp2x8 sj;
+  fp2x8_mul(sj, s1, j);
+  fp2x8_sub(y3, y3, sj);
+  fp2x8_sub(y3, y3, sj);
+  fp2x8_mul(t, p.z, q.z);
+  fp2x8_add(t, t, t);
+  fp2x8_mul(z3, t, h);
+  // infinite-operand lanes take the other operand verbatim
+  fp2x8_blend(x3, pinf, x3, q.x);
+  fp2x8_blend(y3, pinf, y3, q.y);
+  fp2x8_blend(z3, pinf, z3, q.z);
+  fp2x8_blend(x3, qinf, x3, p.x);
+  fp2x8_blend(y3, qinf, y3, p.y);
+  fp2x8_blend(z3, qinf, z3, p.z);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+EC_FP8_TARGET static void g2x8_neg(G2x8& o, const G2x8& p) {
+  o.x = p.x;
+  fp2x8_neg(o.y, p.y);
+  o.z = p.z;
+}
+
+// vector twin of g2_psi: conjugate coordinates, scale x and y by the
+// untwist-Frobenius-twist constants
+EC_FP8_TARGET static void g2x8_psi(G2x8& o, const G2x8& p) {
+  Fp2x8 cx, cy, cz, kx, ky;
+  fp2x8_conj(cx, p.x);
+  fp2x8_conj(cy, p.y);
+  fp2x8_conj(cz, p.z);
+  fp2x8_bcast_fp2(kx, PSI_CX);
+  fp2x8_bcast_fp2(ky, PSI_CY);
+  fp2x8_mul(o.x, cx, kx);
+  fp2x8_mul(o.y, cy, ky);
+  o.z = cz;
+}
+
+// [x]P = -[|x|]P over the sparse 64-bit |x|, shared schedule per lane
+EC_FP8_TARGET static void g2x8_mul_bls_x_neg(G2x8& o, const G2x8& p,
+                                             __mmask8& exc) {
+  G2x8 acc = p;  // |x| has its top bit at 63
+  for (int b = 62; b >= 0; b--) {
+    g2x8_dbl(acc, acc);
+    if ((BLS_X_ABS >> b) & 1) g2x8_add(acc, acc, p, exc);
+  }
+  g2x8_neg(o, acc);
+}
+
+// vector twin of g2_clear_cofactor_fast (Budroni-Pintore)
+EC_FP8_TARGET static void g2x8_clear_cofactor(G2x8& o, const G2x8& p,
+                                              __mmask8& exc) {
+  G2x8 t1, t2, t3, t4, n;
+  g2x8_mul_bls_x_neg(t1, p, exc);   // [x]P
+  g2x8_psi(t2, p);                  // psi(P)
+  g2x8_dbl(t3, p);
+  g2x8_psi(t3, t3);
+  g2x8_psi(t3, t3);                 // psi^2([2]P)
+  g2x8_neg(n, t2);
+  g2x8_add(t3, t3, n, exc);         // psi^2(2P) - psi(P)
+  g2x8_add(t4, t1, t2, exc);        // [x]P + psi(P)
+  g2x8_mul_bls_x_neg(t4, t4, exc);  // [x^2]P + [x]psi(P)
+  g2x8_add(t3, t3, t4, exc);
+  g2x8_neg(n, t1);
+  g2x8_add(t3, t3, n, exc);         // - [x]P
+  g2x8_neg(n, p);
+  g2x8_add(t3, t3, n, exc);         // - P
+  o = t3;
+}
+
+// Scott criterion psi(P) == [x]P per lane; lanes where either side is
+// infinite (or the compare is otherwise degenerate) land in *exc
+EC_FP8_TARGET static __mmask8 g2x8_in_subgroup_mask(const G2x8& p,
+                                                    __mmask8& exc) {
+  G2x8 l, r;
+  g2x8_psi(l, p);
+  g2x8_mul_bls_x_neg(r, p, exc);
+  const __mmask8 linf = fp2x8_is_zero_mask(l.z);
+  const __mmask8 rinf = fp2x8_is_zero_mask(r.z);
+  exc |= (__mmask8)(linf | rinf);
+  Fp2x8 z1z1, z2z2, a, b, t, z1c, z2c;
+  fp2x8_sqr(z1z1, l.z);
+  fp2x8_sqr(z2z2, r.z);
+  fp2x8_mul(a, l.x, z2z2);
+  fp2x8_mul(b, r.x, z1z1);
+  const __mmask8 xeq = fp2x8_eq_mask(a, b);
+  fp2x8_mul(z1c, z1z1, l.z);
+  fp2x8_mul(z2c, z2z2, r.z);
+  fp2x8_mul(a, l.y, z2c);
+  fp2x8_mul(b, r.y, z1c);
+  const __mmask8 yeq = fp2x8_eq_mask(a, b);
+  return xeq & yeq;
+}
+
+// Batched cofactor clearing over n Jacobian sums (the hash-to-G2 tail):
+// exception lanes redo the scalar chain; result identical to
+// g2_clear_cofactor by construction
+static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
+  if (!FP8_READY || PSI_STATE != 1) {
+    for (size_t i = 0; i < n; i++) g2_clear_cofactor(out[i], in[i]);
+    return;
+  }
+  for (size_t base = 0; base < n; base += 8) {
+    int c = (int)(n - base < 8 ? n - base : 8);
+    G2x8 pv, ov;
+    g2x8_load(pv, in + base, c);
+    __mmask8 exc = 0;
+    g2x8_clear_cofactor(ov, pv, exc);
+    g2x8_store(out + base, ov, c);
+    for (int k = 0; k < c; k++)
+      if ((exc >> k) & 1) g2_clear_cofactor(out[base + k], in[base + k]);
+  }
+}
+
+// Batched subgroup membership for n points; mirrors g2_in_subgroup
+static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
+  if (!FP8_READY || G2_SUB_STATE != 1) {
+    for (size_t i = 0; i < n; i++) ok[i] = g2_in_subgroup(pts[i]);
+    return;
+  }
+  for (size_t base = 0; base < n; base += 8) {
+    int c = (int)(n - base < 8 ? n - base : 8);
+    G2x8 pv;
+    g2x8_load(pv, pts + base, c);
+    __mmask8 exc = 0;
+    const __mmask8 in_sub = g2x8_in_subgroup_mask(pv, exc);
+    for (int k = 0; k < c; k++) {
+      if ((exc >> k) & 1) ok[base + k] = g2_in_subgroup(pts[base + k]);
+      else ok[base + k] = (in_sub >> k) & 1;
+    }
+  }
+}
+
+#else  // !EC_FP8_COMPILED
+
+static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
+  for (size_t i = 0; i < n; i++) g2_clear_cofactor(out[i], in[i]);
+}
+static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
+  for (size_t i = 0; i < n; i++) ok[i] = g2_in_subgroup(pts[i]);
+}
+
+#endif  // EC_FP8_COMPILED
+
+// ---------------------------------------------------------------------------
 // Batched hash-to-G2 / G2 decompression: the same algorithms as their
 // scalar twins above, with the Fp2 sqrt chains routed through the
 // eight-wide IFMA engine (fp2_sqrt_x8) and the scalar inversions through
@@ -2780,6 +3089,7 @@ static bool hash_to_g2_batch(G2* out, const u8* msgs, const u32* msg_lens,
       den[2 * j + 1] = inf[j] ? FP2_ONE : yd;
     }
     fp2_inv_batch(den, 2 * c * 2);
+    G2 sums[16];
     for (int k = 0; k < c; k++) {
       G2 q[2];
       for (int h = 0; h < 2; h++) {
@@ -2794,10 +3104,9 @@ static bool hash_to_g2_batch(G2* out, const u8* msgs, const u32* msg_lens,
         fp2_mul(yo, ys[j], t);
         q[h] = pt_from_affine<Fp2Ops>(xo, yo);
       }
-      G2 sum;
-      pt_add(sum, q[0], q[1]);
-      g2_clear_cofactor(out[base + k], sum);
+      pt_add(sums[k], q[0], q[1]);
     }
+    g2_clear_cofactor_batch(out + base, sums, c);
   }
   return true;
 }
@@ -2865,10 +3174,27 @@ static void g2_decompress_batch(G2* out, int* rcs, const u8* sigs, size_t n,
           if (fp2_is_lex_largest(y) != !!sign_flags[idx]) fp2_neg(y, y);
           out[idx] = pt_from_affine<Fp2Ops>(xs[idx], y);
           rcs[idx] = DEC_OK;
-          if (check_subgroup && !g2_in_subgroup(out[idx]))
-            rcs[idx] = DEC_NOT_IN_SUBGROUP;
         }
         m = 0;
+      }
+    }
+  }
+  if (check_subgroup) {
+    // eight-wide psi criterion over the successfully decoded finite points
+    G2 good[8];
+    bool sub_ok[8];
+    size_t gidx[8];
+    int g = 0;
+    for (size_t k = 0; k <= n; k++) {
+      if (k < n && rcs[k] == DEC_OK && !out[k].is_inf()) {
+        good[g] = out[k];
+        gidx[g++] = k;
+      }
+      if ((g == 8 || k == n) && g > 0) {
+        g2_in_subgroup_batch(sub_ok, good, g);
+        for (int j = 0; j < g; j++)
+          if (!sub_ok[j]) rcs[gidx[j]] = DEC_NOT_IN_SUBGROUP;
+        g = 0;
       }
     }
   }
@@ -3145,7 +3471,48 @@ int ec_fp8_selftest(u64 seed, int rounds) {
   ensure_init();
   if (!FP8_READY) return 0;
 #ifdef EC_FP8_COMPILED
-  return fp8_selftest_deep(seed, rounds);
+  int rc = fp8_selftest_deep(seed, rounds);
+  if (rc) return rc;
+  // end-to-end: batched hash-to-G2 == scalar hash-to-G2, message by
+  // message (exercises SSWU batching, batched isogeny inversions, and
+  // the eight-lane cofactor chain incl. partial final chunks)
+  {
+    const u8 dst[] = "EC_FP8_SELFTEST_DST_";
+    u8 msgs[19 * 8];
+    u32 lens[19];
+    u64 s = seed ? seed : 0xa076bdf3u;
+    for (int i = 0; i < 19 * 8; i++) {
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17;
+      msgs[i] = (u8)s;
+    }
+    for (int i = 0; i < 19; i++) lens[i] = 8;
+    G2 got[19], want;
+    if (!hash_to_g2_batch(got, msgs, lens, 19, dst, sizeof(dst) - 1))
+      return 7;
+    for (int i = 0; i < 19; i++) {
+      if (!hash_to_g2_point(want, msgs + 8 * i, 8, dst, sizeof(dst) - 1))
+        return 7;
+      if (!pt_eq_jacobian(got[i], want)) return 8;
+    }
+    // batched decompression (+ subgroup) == scalar decompression,
+    // including corrupted encodings and the infinity encoding
+    u8 enc[19 * 96];
+    for (int i = 0; i < 19; i++) g2_compress(enc + 96 * i, got[i]);
+    enc[96 * 3 + 17] ^= 0x40;               // corrupt one coordinate
+    memset(enc + 96 * 5, 0, 96);            // infinity encoding
+    enc[96 * 5] = 0xC0;
+    enc[96 * 7] = (u8)(enc[96 * 7] ^ 0x20); // flip the sign flag (still valid)
+    G2 dec[19];
+    int rcs[19];
+    g2_decompress_batch(dec, rcs, enc, 19, true);
+    for (int i = 0; i < 19; i++) {
+      G2 one;
+      int want_rc = g2_decompress(one, enc + 96 * i, true);
+      if (rcs[i] != want_rc) return 9;
+      if (want_rc == DEC_OK && !pt_eq_jacobian(dec[i], one)) return 10;
+    }
+  }
+  return 0;
 #else
   return 0;
 #endif
