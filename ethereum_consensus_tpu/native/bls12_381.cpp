@@ -2026,6 +2026,10 @@ static void final_exp_for_verdict(Fp12& o, const Fp12& f) {
 }
 
 // Π e(Pi, Qi) == 1, skipping infinite points (mirrors pairing.py)
+// defined after the eight-lane tower below; false = engine unavailable,
+// caller runs the scalar loop (identical Fp12 result — selftest-pinned)
+static bool multi_miller_loop_x8_try(Fp12& f, MillerPair* pairs, size_t m);
+
 static bool pairing_product_is_one(const G1* ps, const G2* qs, size_t n) {
   MillerPair pairs[129];
   MillerPair* heap_pairs = nullptr;
@@ -2039,7 +2043,7 @@ static bool pairing_product_is_one(const G1* ps, const G2* qs, size_t n) {
     m++;
   }
   Fp12 f, fe;
-  multi_miller_loop(f, use, m);
+  if (!multi_miller_loop_x8_try(f, use, m)) multi_miller_loop(f, use, m);
   final_exp_for_verdict(fe, f);
   bool ok = fp12_is_one(fe);
   delete[] heap_pairs;
@@ -2926,6 +2930,509 @@ EC_FP8_TARGET static __mmask8 g2x8_in_subgroup_mask(const G2x8& p,
   return xeq & yeq;
 }
 
+// ---- G1x8: the same lane-complete Jacobian machinery over Fp ----
+
+struct G1x8 { Fp8 x, y, z; };
+
+EC_FP8_TARGET static void g1x8_load(G1x8& o, const G1* pts, int n) {
+  Fp xs[8], ys[8], zs[8];
+  for (int k = 0; k < 8; k++) {
+    const G1& p = pts[k < n ? k : 0];
+    xs[k] = p.x; ys[k] = p.y; zs[k] = p.z;
+  }
+  fp8_load(o.x, xs, 8);
+  fp8_load(o.y, ys, 8);
+  fp8_load(o.z, zs, 8);
+}
+
+EC_FP8_TARGET static void g1x8_store(G1* out, const G1x8& a, int n) {
+  Fp xs[8], ys[8], zs[8];
+  fp8_store(xs, a.x, 8);
+  fp8_store(ys, a.y, 8);
+  fp8_store(zs, a.z, 8);
+  for (int k = 0; k < n; k++) {
+    out[k].x = xs[k]; out[k].y = ys[k]; out[k].z = zs[k];
+  }
+}
+
+EC_FP8_TARGET static void g1x8_dbl(G1x8& o, const G1x8& p) {
+  Fp8 a, b, c, d, e, f, t, c8, x3, y3, z3;
+  fp8_sqr(a, p.x);
+  fp8_sqr(b, p.y);
+  fp8_sqr(c, b);
+  fp8_add(t, p.x, b);
+  fp8_sqr(t, t);
+  fp8_sub(t, t, a);
+  fp8_sub(d, t, c);
+  fp8_add(d, d, d);
+  fp8_add(e, a, a);
+  fp8_add(e, e, a);
+  fp8_sqr(f, e);
+  fp8_sub(x3, f, d);
+  fp8_sub(x3, x3, d);
+  fp8_add(c8, c, c);
+  fp8_add(c8, c8, c8);
+  fp8_add(c8, c8, c8);
+  fp8_sub(t, d, x3);
+  fp8_montmul(y3, e, t);
+  fp8_sub(y3, y3, c8);
+  fp8_montmul(z3, p.y, p.z);
+  fp8_add(z3, z3, z3);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+EC_FP8_TARGET static void g1x8_add(G1x8& o, const G1x8& p, const G1x8& q,
+                                  __mmask8& exc) {
+  const __mmask8 pinf = fp8_is_zero_mask(p.z);
+  const __mmask8 qinf = fp8_is_zero_mask(q.z);
+  Fp8 z1z1, z2z2, u1, u2, s1, s2, t;
+  fp8_sqr(z1z1, p.z);
+  fp8_sqr(z2z2, q.z);
+  fp8_montmul(u1, p.x, z2z2);
+  fp8_montmul(u2, q.x, z1z1);
+  fp8_montmul(t, p.y, q.z);
+  fp8_montmul(s1, t, z2z2);
+  fp8_montmul(t, q.y, p.z);
+  fp8_montmul(s2, t, z1z1);
+  const __mmask8 equ = fp8_eq_mask(u1, u2);
+  const __mmask8 eqs = fp8_eq_mask(s1, s2);
+  exc |= (__mmask8)(~pinf & ~qinf & equ & eqs);
+  Fp8 h, i, j, r, v, x3, y3, z3, sj;
+  fp8_sub(h, u2, u1);
+  fp8_add(i, h, h);
+  fp8_sqr(i, i);
+  fp8_montmul(j, h, i);
+  fp8_sub(r, s2, s1);
+  fp8_add(r, r, r);
+  fp8_montmul(v, u1, i);
+  fp8_sqr(x3, r);
+  fp8_sub(x3, x3, j);
+  fp8_sub(x3, x3, v);
+  fp8_sub(x3, x3, v);
+  fp8_sub(t, v, x3);
+  fp8_montmul(y3, r, t);
+  fp8_montmul(sj, s1, j);
+  fp8_sub(y3, y3, sj);
+  fp8_sub(y3, y3, sj);
+  fp8_montmul(t, p.z, q.z);
+  fp8_add(t, t, t);
+  fp8_montmul(z3, t, h);
+  fp8_blend(x3, pinf, x3, q.x);
+  fp8_blend(y3, pinf, y3, q.y);
+  fp8_blend(z3, pinf, z3, q.z);
+  fp8_blend(x3, qinf, x3, p.x);
+  fp8_blend(y3, qinf, y3, p.y);
+  fp8_blend(z3, qinf, z3, p.z);
+  o.x = x3; o.y = y3; o.z = z3;
+}
+
+EC_FP8_TARGET static void g1x8_blend(G1x8& o, __mmask8 take_b, const G1x8& a,
+                                     const G1x8& b) {
+  fp8_blend(o.x, take_b, a.x, b.x);
+  fp8_blend(o.y, take_b, a.y, b.y);
+  fp8_blend(o.z, take_b, a.z, b.z);
+}
+
+// Eight independent 128-bit scalar multiplications with one shared 4-bit
+// window schedule (the scalars differ per lane, so each window's table
+// pick is a 16-way masked select). Used for the RLC blinder products
+// r_i * aggpk_i in batch verification.
+EC_FP8_TARGET static void g1x8_mul128(G1x8& o, const G1x8& p,
+                                      const u64 (*r)[2], int n,
+                                      __mmask8& exc) {
+  G1x8 tbl[16];
+  {
+    Fp ones[8], zeros[8];
+    for (int k = 0; k < 8; k++) { ones[k] = FP_ONE; zeros[k] = FP_ZERO; }
+    fp8_load(tbl[0].x, ones, 8);
+    fp8_load(tbl[0].y, ones, 8);
+    fp8_load(tbl[0].z, zeros, 8);
+  }
+  tbl[1] = p;
+  for (int d = 2; d < 16; d++) {
+    if (d % 2 == 0) g1x8_dbl(tbl[d], tbl[d / 2]);
+    else g1x8_add(tbl[d], tbl[d - 1], p, exc);  // (d-1)P + P, d-1 >= 2
+  }
+  G1x8 acc;
+  bool started = false;
+  for (int w = 124; w >= 0; w -= 4) {
+    if (started) {
+      g1x8_dbl(acc, acc);
+      g1x8_dbl(acc, acc);
+      g1x8_dbl(acc, acc);
+      g1x8_dbl(acc, acc);
+    }
+    u8 digs[8];
+    u8 any = 0;
+    for (int k = 0; k < 8; k++) {
+      const u64* rk = r[k < n ? k : 0];
+      digs[k] = (u8)((rk[w >> 6] >> (w & 63)) & 15);
+      any |= digs[k];
+    }
+    if (!started && !any) continue;
+    G1x8 sel = tbl[0];
+    for (int d = 1; d < 16; d++) {
+      __mmask8 m = 0;
+      for (int k = 0; k < 8; k++)
+        if (digs[k] == d) m |= (__mmask8)(1u << k);
+      if (m) g1x8_blend(sel, m, sel, tbl[d]);
+    }
+    if (!started) { acc = sel; started = true; }
+    else g1x8_add(acc, acc, sel, exc);
+  }
+  if (!started) acc = tbl[0];
+  o = acc;
+}
+
+// Batched blinder products out[i] = r_i * pts[i] (r 128-bit, nonzero);
+// exception lanes redo the scalar ladder — mirrors pt_mul exactly
+static void g1_mul128_batch(G1* out, const G1* pts, const u64 (*r)[2],
+                            size_t n) {
+  size_t base = 0;
+  for (; FP8_READY && base < n; base += 8) {
+    int c = (int)(n - base < 8 ? n - base : 8);
+    G1x8 pv, ov;
+    g1x8_load(pv, pts + base, c);
+    __mmask8 exc = 0;
+    g1x8_mul128(ov, pv, r + base, c, exc);
+    g1x8_store(out + base, ov, c);
+    for (int k = 0; k < c; k++)
+      if ((exc >> k) & 1) {
+        u64 sc[2] = {r[base + k][0], r[base + k][1]};
+        pt_mul(out[base + k], pts[base + k], sc, 2);
+      }
+  }
+  for (; base < n; base++) {
+    u64 sc[2] = {r[base][0], r[base][1]};
+    pt_mul(out[base], pts[base], sc, 2);
+  }
+}
+
+// ---- Fp6x8 / Fp12x8: lane-parallel tower for the eight-wide Miller loop ----
+
+EC_FP8_TARGET static void fp2x8_mul_by_xi(Fp2x8& o, const Fp2x8& a) {
+  Fp8 t0, t1;
+  fp8_sub(t0, a.c0, a.c1);
+  fp8_add(t1, a.c0, a.c1);
+  o.c0 = t0; o.c1 = t1;
+}
+EC_FP8_TARGET static void fp2x8_scalar_mul(Fp2x8& o, const Fp2x8& a,
+                                           const Fp8& k) {
+  fp8_montmul(o.c0, a.c0, k);
+  fp8_montmul(o.c1, a.c1, k);
+}
+
+struct Fp6x8 { Fp2x8 a0, a1, a2; };
+struct Fp12x8 { Fp6x8 c0, c1; };
+
+EC_FP8_TARGET static void fp6x8_add(Fp6x8& o, const Fp6x8& a, const Fp6x8& b) {
+  fp2x8_add(o.a0, a.a0, b.a0);
+  fp2x8_add(o.a1, a.a1, b.a1);
+  fp2x8_add(o.a2, a.a2, b.a2);
+}
+EC_FP8_TARGET static void fp6x8_sub(Fp6x8& o, const Fp6x8& a, const Fp6x8& b) {
+  fp2x8_sub(o.a0, a.a0, b.a0);
+  fp2x8_sub(o.a1, a.a1, b.a1);
+  fp2x8_sub(o.a2, a.a2, b.a2);
+}
+EC_FP8_TARGET static void fp6x8_neg(Fp6x8& o, const Fp6x8& a) {
+  fp2x8_neg(o.a0, a.a0);
+  fp2x8_neg(o.a1, a.a1);
+  fp2x8_neg(o.a2, a.a2);
+}
+// vector twin of fp6_mul (Toom/Karatsuba layout kept identical)
+EC_FP8_TARGET static void fp6x8_mul(Fp6x8& o, const Fp6x8& a, const Fp6x8& b) {
+  Fp2x8 t0, t1, t2, s, u, x, y, c0, c1, c2;
+  fp2x8_mul(t0, a.a0, b.a0);
+  fp2x8_mul(t1, a.a1, b.a1);
+  fp2x8_mul(t2, a.a2, b.a2);
+  fp2x8_add(s, a.a1, a.a2);
+  fp2x8_add(u, b.a1, b.a2);
+  fp2x8_mul(x, s, u);
+  fp2x8_sub(x, x, t1);
+  fp2x8_sub(x, x, t2);
+  fp2x8_mul_by_xi(y, x);
+  fp2x8_add(c0, t0, y);
+  fp2x8_add(s, a.a0, a.a1);
+  fp2x8_add(u, b.a0, b.a1);
+  fp2x8_mul(x, s, u);
+  fp2x8_sub(x, x, t0);
+  fp2x8_sub(x, x, t1);
+  fp2x8_mul_by_xi(y, t2);
+  fp2x8_add(c1, x, y);
+  fp2x8_add(s, a.a0, a.a2);
+  fp2x8_add(u, b.a0, b.a2);
+  fp2x8_mul(x, s, u);
+  fp2x8_sub(x, x, t0);
+  fp2x8_sub(x, x, t2);
+  fp2x8_add(c2, x, t1);
+  o.a0 = c0; o.a1 = c1; o.a2 = c2;
+}
+EC_FP8_TARGET static void fp6x8_mul_by_v(Fp6x8& o, const Fp6x8& a) {
+  Fp2x8 t, old_a0, old_a1;
+  fp2x8_mul_by_xi(t, a.a2);
+  old_a0 = a.a0;
+  old_a1 = a.a1;
+  o.a0 = t; o.a1 = old_a0; o.a2 = old_a1;
+}
+EC_FP8_TARGET static void fp12x8_sqr(Fp12x8& o, const Fp12x8& a) {
+  Fp6x8 u, s, t, vt;
+  fp6x8_mul(u, a.c0, a.c1);
+  fp6x8_add(s, a.c0, a.c1);
+  fp6x8_mul_by_v(vt, a.c1);
+  fp6x8_add(t, a.c0, vt);
+  fp6x8_mul(t, s, t);
+  fp6x8_sub(t, t, u);
+  fp6x8_mul_by_v(vt, u);
+  fp6x8_sub(o.c0, t, vt);
+  fp6x8_add(o.c1, u, u);
+}
+EC_FP8_TARGET static void fp12x8_conj(Fp12x8& o, const Fp12x8& a) {
+  o.c0 = a.c0;
+  fp6x8_neg(o.c1, a.c1);
+}
+// vector twin of fp12_mul_by_line (same sparse Karatsuba split)
+EC_FP8_TARGET static void fp12x8_mul_by_line(Fp12x8& f, const Fp2x8& c00,
+                                             const Fp2x8& c11,
+                                             const Fp2x8& c12) {
+  Fp6x8 t0;
+  fp2x8_mul(t0.a0, f.c0.a0, c00);
+  fp2x8_mul(t0.a1, f.c0.a1, c00);
+  fp2x8_mul(t0.a2, f.c0.a2, c00);
+  Fp6x8 t1;
+  Fp2x8 u, w;
+  fp2x8_mul(u, f.c1.a1, c12);
+  fp2x8_mul(w, f.c1.a2, c11);
+  fp2x8_add(u, u, w);
+  fp2x8_mul_by_xi(t1.a0, u);
+  fp2x8_mul(u, f.c1.a0, c11);
+  fp2x8_mul(w, f.c1.a2, c12);
+  fp2x8_mul_by_xi(w, w);
+  fp2x8_add(t1.a1, u, w);
+  fp2x8_mul(u, f.c1.a0, c12);
+  fp2x8_mul(w, f.c1.a1, c11);
+  fp2x8_add(t1.a2, u, w);
+  Fp6x8 sum, ab, t2;
+  fp6x8_add(sum, f.c0, f.c1);
+  ab.a0 = c00; ab.a1 = c11; ab.a2 = c12;
+  fp6x8_mul(t2, sum, ab);
+  Fp6x8 vt;
+  fp6x8_mul_by_v(vt, t1);
+  fp6x8_add(f.c0, t0, vt);
+  fp6x8_sub(t2, t2, t0);
+  fp6x8_sub(f.c1, t2, t1);
+}
+EC_FP8_TARGET static void fp12x8_blend(Fp12x8& o, __mmask8 take_b,
+                                       const Fp12x8& a, const Fp12x8& b) {
+  fp2x8_blend(o.c0.a0, take_b, a.c0.a0, b.c0.a0);
+  fp2x8_blend(o.c0.a1, take_b, a.c0.a1, b.c0.a1);
+  fp2x8_blend(o.c0.a2, take_b, a.c0.a2, b.c0.a2);
+  fp2x8_blend(o.c1.a0, take_b, a.c1.a0, b.c1.a0);
+  fp2x8_blend(o.c1.a1, take_b, a.c1.a1, b.c1.a1);
+  fp2x8_blend(o.c1.a2, take_b, a.c1.a2, b.c1.a2);
+}
+EC_FP8_TARGET static void fp12x8_store_lanes(Fp12* out, const Fp12x8& a,
+                                             int n) {
+  const Fp8* comps[12] = {
+      &a.c0.a0.c0, &a.c0.a0.c1, &a.c0.a1.c0, &a.c0.a1.c1,
+      &a.c0.a2.c0, &a.c0.a2.c1, &a.c1.a0.c0, &a.c1.a0.c1,
+      &a.c1.a1.c0, &a.c1.a1.c1, &a.c1.a2.c0, &a.c1.a2.c1};
+  Fp lanes[12][8];
+  for (int c = 0; c < 12; c++) fp8_store(lanes[c], *comps[c], 8);
+  for (int k = 0; k < n; k++) {
+    out[k].c0.a0.c0 = lanes[0][k];  out[k].c0.a0.c1 = lanes[1][k];
+    out[k].c0.a1.c0 = lanes[2][k];  out[k].c0.a1.c1 = lanes[3][k];
+    out[k].c0.a2.c0 = lanes[4][k];  out[k].c0.a2.c1 = lanes[5][k];
+    out[k].c1.a0.c0 = lanes[6][k];  out[k].c1.a0.c1 = lanes[7][k];
+    out[k].c1.a1.c0 = lanes[8][k];  out[k].c1.a1.c1 = lanes[9][k];
+    out[k].c1.a2.c0 = lanes[10][k]; out[k].c1.a2.c1 = lanes[11][k];
+  }
+}
+
+// ---- eight-wide Miller loop: pairs round-robined over lanes ----
+//
+// The scalar multi_miller_loop shares ONE f-squaring chain across all
+// pairs; here the pairs split into eight groups (pair i -> slot i/8,
+// lane i%8), each lane accumulates its own group product through the
+// same shared-squaring chain, and the eight group products multiply
+// together scalar-side at the end — algebraically the identical Miller
+// product, bit-for-bit (selftest-pinned against the scalar loop).
+
+struct MillerPairX8 {
+  Fp8 xp, yp;     // G1 affine lanes
+  Fp2x8 xq, yq;   // G2 affine lanes (twist coords)
+  G2x8 t;         // per-lane accumulator
+};
+
+EC_FP8_TARGET static void miller_double_step_x8(Fp12x8& f, MillerPairX8& pr) {
+  const Fp2x8 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
+  Fp2x8 A, B, C, Z2, Z3c, L, X3c, E, c00, c11, c12, t, u;
+  fp2x8_sqr(A, X);
+  fp2x8_sqr(B, Y);
+  fp2x8_sqr(C, B);
+  fp2x8_sqr(Z2, Z);
+  fp2x8_mul(Z3c, Z2, Z);
+  fp2x8_mul(L, Y, Z3c);
+  fp2x8_add(L, L, L);
+  fp2x8_scalar_mul(t, L, pr.yp);
+  fp2x8_mul_by_xi(t, t);
+  fp2x8_neg(c00, t);
+  fp2x8_mul(X3c, A, X);
+  fp2x8_add(c11, B, B);
+  fp2x8_add(u, X3c, X3c);
+  fp2x8_add(u, u, X3c);
+  fp2x8_sub(c11, c11, u);
+  fp2x8_add(E, A, A);
+  fp2x8_add(E, E, A);
+  fp2x8_mul(t, E, Z2);
+  fp2x8_scalar_mul(c12, t, pr.xp);
+  fp12x8_mul_by_line(f, c00, c11, c12);
+  Fp2x8 D, F, x3, y3, z3, c8;
+  fp2x8_add(t, X, B);
+  fp2x8_sqr(t, t);
+  fp2x8_sub(t, t, A);
+  fp2x8_sub(D, t, C);
+  fp2x8_add(D, D, D);
+  fp2x8_sqr(F, E);
+  fp2x8_sub(x3, F, D);
+  fp2x8_sub(x3, x3, D);
+  fp2x8_add(c8, C, C);
+  fp2x8_add(c8, c8, c8);
+  fp2x8_add(c8, c8, c8);
+  fp2x8_sub(t, D, x3);
+  fp2x8_mul(y3, E, t);
+  fp2x8_sub(y3, y3, c8);
+  fp2x8_mul(z3, Y, Z);
+  fp2x8_add(z3, z3, z3);
+  pr.t.x = x3; pr.t.y = y3; pr.t.z = z3;
+}
+
+EC_FP8_TARGET static void miller_add_step_x8(Fp12x8& f, MillerPairX8& pr) {
+  const Fp2x8 X = pr.t.x, Y = pr.t.y, Z = pr.t.z;
+  Fp2x8 Z2, Z3c, U2, S2, lam_n, lam_d, t, u, c00, c11, c12;
+  fp2x8_sqr(Z2, Z);
+  fp2x8_mul(Z3c, Z2, Z);
+  fp2x8_mul(U2, pr.xq, Z2);
+  fp2x8_mul(S2, pr.yq, Z3c);
+  fp2x8_sub(lam_n, Y, S2);
+  fp2x8_sub(t, X, U2);
+  fp2x8_mul(lam_d, t, Z);
+  fp2x8_scalar_mul(u, lam_d, pr.yp);
+  fp2x8_mul_by_xi(u, u);
+  fp2x8_neg(c00, u);
+  fp2x8_mul(t, pr.yq, lam_d);
+  fp2x8_mul(u, lam_n, pr.xq);
+  fp2x8_sub(c11, t, u);
+  fp2x8_scalar_mul(c12, lam_n, pr.xp);
+  fp12x8_mul_by_line(f, c00, c11, c12);
+  Fp2x8 H, HH, I, J, rr, V, x3, y3, z3;
+  fp2x8_sub(H, U2, X);
+  fp2x8_sqr(HH, H);
+  fp2x8_add(I, HH, HH);
+  fp2x8_add(I, I, I);
+  fp2x8_mul(J, H, I);
+  fp2x8_sub(rr, S2, Y);
+  fp2x8_add(rr, rr, rr);
+  fp2x8_mul(V, X, I);
+  fp2x8_sqr(x3, rr);
+  fp2x8_sub(x3, x3, J);
+  fp2x8_sub(x3, x3, V);
+  fp2x8_sub(x3, x3, V);
+  fp2x8_sub(t, V, x3);
+  fp2x8_mul(y3, rr, t);
+  fp2x8_mul(u, Y, J);
+  fp2x8_add(u, u, u);
+  fp2x8_sub(y3, y3, u);
+  fp2x8_add(z3, Z, H);
+  fp2x8_sqr(z3, z3);
+  fp2x8_sub(z3, z3, Z2);
+  fp2x8_sub(z3, z3, HH);
+  pr.t.x = x3; pr.t.y = y3; pr.t.z = z3;
+}
+
+EC_FP8_TARGET static void multi_miller_loop_x8_impl(Fp12& f_out,
+                                                    MillerPair* pairs,
+                                                    size_t m) {
+  const size_t K = (m + 7) / 8;           // slots; pair i -> slot i/8, lane i%8
+  MillerPairX8* slots = new MillerPairX8[K];
+  int acts[64];  // K <= 64 enforced by caller? no — heap-size acts
+  int* act = (K > 64) ? new int[K] : acts;
+  for (size_t k = 0; k < K; k++) {
+    size_t lo = 8 * k;
+    int c = (int)(m - lo < 8 ? m - lo : 8);
+    act[k] = c;
+    Fp xp[8], yp[8], xq0[8], xq1[8], yq0[8], yq1[8];
+    for (int g = 0; g < 8; g++) {
+      const MillerPair& p = pairs[lo + (g < c ? g : 0)];
+      xp[g] = p.xp; yp[g] = p.yp;
+      xq0[g] = p.xq.c0; xq1[g] = p.xq.c1;
+      yq0[g] = p.yq.c0; yq1[g] = p.yq.c1;
+    }
+    fp8_load(slots[k].xp, xp, 8);
+    fp8_load(slots[k].yp, yp, 8);
+    fp8_load(slots[k].xq.c0, xq0, 8);
+    fp8_load(slots[k].xq.c1, xq1, 8);
+    fp8_load(slots[k].yq.c0, yq0, 8);
+    fp8_load(slots[k].yq.c1, yq1, 8);
+    slots[k].t.x = slots[k].xq;
+    slots[k].t.y = slots[k].yq;
+    // z = 1 in every lane
+    Fp ones[8], zeros[8];
+    for (int g = 0; g < 8; g++) { ones[g] = FP_ONE; zeros[g] = FP_ZERO; }
+    fp8_load(slots[k].t.z.c0, ones, 8);
+    fp8_load(slots[k].t.z.c1, zeros, 8);
+  }
+  // f = 1 in every lane
+  Fp12x8 f;
+  {
+    Fp ones[8], zeros[8];
+    for (int g = 0; g < 8; g++) { ones[g] = FP_ONE; zeros[g] = FP_ZERO; }
+    Fp8 one8, zero8;
+    fp8_load(one8, ones, 8);
+    fp8_load(zero8, zeros, 8);
+    f.c0.a0.c0 = one8;  f.c0.a0.c1 = zero8;
+    f.c0.a1.c0 = zero8; f.c0.a1.c1 = zero8;
+    f.c0.a2.c0 = zero8; f.c0.a2.c1 = zero8;
+    f.c1.a0.c0 = zero8; f.c1.a0.c1 = zero8;
+    f.c1.a1.c0 = zero8; f.c1.a1.c1 = zero8;
+    f.c1.a2.c0 = zero8; f.c1.a2.c1 = zero8;
+  }
+  int msb = 63;
+  while (!((BLS_X_ABS >> msb) & 1)) msb--;
+  for (int b = msb - 1; b >= 0; b--) {
+    fp12x8_sqr(f, f);
+    for (size_t k = 0; k < K; k++) {
+      if (act[k] == 8) {
+        miller_double_step_x8(f, slots[k]);
+      } else {
+        // ragged slot: inactive lanes keep their f untouched
+        Fp12x8 fsave = f;
+        miller_double_step_x8(f, slots[k]);
+        fp12x8_blend(f, (__mmask8)((1u << act[k]) - 1), fsave, f);
+      }
+    }
+    if ((BLS_X_ABS >> b) & 1) {
+      for (size_t k = 0; k < K; k++) {
+        if (act[k] == 8) {
+          miller_add_step_x8(f, slots[k]);
+        } else {
+          Fp12x8 fsave = f;
+          miller_add_step_x8(f, slots[k]);
+          fp12x8_blend(f, (__mmask8)((1u << act[k]) - 1), fsave, f);
+        }
+      }
+    }
+  }
+  fp12x8_conj(f, f);  // x negative
+  Fp12 lanes[8];
+  fp12x8_store_lanes(lanes, f, 8);
+  Fp12 total = lanes[0];
+  for (int g = 1; g < 8; g++) fp12_mul(total, total, lanes[g]);
+  f_out = total;
+  if (act != acts) delete[] act;
+  delete[] slots;
+}
+
 // Batched cofactor clearing over n Jacobian sums (the hash-to-G2 tail):
 // exception lanes redo the scalar chain; result identical to
 // g2_clear_cofactor by construction
@@ -2973,8 +3480,31 @@ static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
 static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
   for (size_t i = 0; i < n; i++) ok[i] = g2_in_subgroup(pts[i]);
 }
+static void g1_mul128_batch(G1* out, const G1* pts, const u64 (*r)[2],
+                            size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    u64 sc[2] = {r[i][0], r[i][1]};
+    pt_mul(out[i], pts[i], sc, 2);
+  }
+}
 
 #endif  // EC_FP8_COMPILED
+
+// Dispatch for the eight-wide Miller loop: worth the SoA conversion once
+// enough pairs share the squaring chain; small products (single verifies
+// are 2 pairs) stay on the scalar loop.
+static bool multi_miller_loop_x8_try(Fp12& f, MillerPair* pairs, size_t m) {
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY && m >= 16) {
+    multi_miller_loop_x8_impl(f, pairs, m);
+    return true;
+  }
+#endif
+  (void)f;
+  (void)pairs;
+  (void)m;
+  return false;
+}
 
 // ---------------------------------------------------------------------------
 // Batched hash-to-G2 / G2 decompression: the same algorithms as their
@@ -3511,6 +4041,45 @@ int ec_fp8_selftest(u64 seed, int rounds) {
       if (rcs[i] != want_rc) return 9;
       if (want_rc == DEC_OK && !pt_eq_jacobian(dec[i], one)) return 10;
     }
+    // batched 128-bit G1 scalar mults == scalar pt_mul (odd count, so
+    // the padded-lane path is exercised too)
+    G1 pts[11], got1[11], want1;
+    u64 rs[11][2];
+    for (int i = 0; i < 11; i++) {
+      u64 k[2] = {0, 0};
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[0] = s | 1;
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[1] = s;
+      pt_mul(pts[i], G1_GEN, k, 2);
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; rs[i][0] = s | 1;
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; rs[i][1] = s;
+    }
+    g1_mul128_batch(got1, pts, rs, 11);
+    for (int i = 0; i < 11; i++) {
+      u64 sc[2] = {rs[i][0], rs[i][1]};
+      pt_mul(want1, pts[i], sc, 2);
+      if (!pt_eq_jacobian(got1[i], want1)) return 11;
+    }
+    // eight-wide Miller loop == scalar Miller loop, bit for bit, on a
+    // ragged pair count (19 pairs -> 3 slots, last slot 3 lanes active)
+    MillerPair mp[19], mp2[19];
+    for (int i = 0; i < 19; i++) {
+      u64 k[2];
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[0] = s | 1;
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[1] = s >> 1;
+      G1 gp;
+      pt_mul(gp, G1_GEN, k, 2);
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[0] = s | 1;
+      s ^= s << 13; s ^= s >> 7; s ^= s << 17; k[1] = s >> 1;
+      G2 gq;
+      pt_mul(gq, G2_GEN, k, 2);
+      pt_to_affine<FpOps>(mp[i].xp, mp[i].yp, gp);
+      pt_to_affine<Fp2Ops>(mp[i].xq, mp[i].yq, gq);
+      mp2[i] = mp[i];
+    }
+    Fp12 fx8, fsc;
+    if (!multi_miller_loop_x8_try(fx8, mp, 19)) return 0;  // engine off: done
+    multi_miller_loop(fsc, mp2, 19);
+    if (!fp12_eq(fx8, fsc)) return 12;
   }
   return 0;
 #else
@@ -3803,7 +4372,10 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
   u64* sig_scalars = new u64[4 * n_sets];
   size_t pk_off = 0;
   bool ok = true;
-  // phase 1 (scalar): per-set pubkey aggregation + blinder mults
+  // phase 1: per-set pubkey aggregation (scalar adds), then all blinder
+  // products r_i * aggpk_i as eight-lane batched scalar mults
+  G1* aggs = new G1[n_sets];
+  u64 (*blinders)[2] = new u64[n_sets][2];
   for (size_t i = 0; i < n_sets && ok; i++) {
     u32 cnt = pk_counts[i];
     if (cnt == 0) { ok = false; break; }
@@ -3823,10 +4395,15 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
     for (int b = 0; b < 8; b++) r[1] = (r[1] << 8) | scalars16[16 * i + b];
     for (int b = 8; b < 16; b++) r[0] = (r[0] << 8) | scalars16[16 * i + b];
     if ((r[0] | r[1]) == 0) { ok = false; break; }
-    pt_mul(ps[i], agg, r, 2);
+    aggs[i] = agg;
+    blinders[i][0] = r[0];
+    blinders[i][1] = r[1];
     sig_scalars[4 * i] = r[0]; sig_scalars[4 * i + 1] = r[1];
     sig_scalars[4 * i + 2] = 0; sig_scalars[4 * i + 3] = 0;
   }
+  if (ok) g1_mul128_batch(ps, aggs, blinders, n_sets);
+  delete[] aggs;
+  delete[] blinders;
   // phase 2: signature decompression, sqrt chains batched eight-wide
   if (ok) {
     g2_decompress_batch(sig_pts, rcs, sigs, n_sets, true);
