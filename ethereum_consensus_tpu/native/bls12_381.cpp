@@ -3955,6 +3955,118 @@ static bool g1_from_raw(G1& out, const u8 in[96], int is_inf) {
   return true;
 }
 
+#ifdef EC_FP8_COMPILED
+// Parse eight raw affine G1 points straight into R52-Montgomery lanes
+// (skipping the scalar-Montgomery detour g1_from_raw would pay), with
+// the on-curve check run eight-wide. Out-of-field or off-curve lanes
+// (incl. the all-zero "infinity" encoding, which is not on the curve)
+// fail exactly like g1_from_raw.
+EC_FP8_TARGET static bool g1x8_load_from_raw(G1x8& o, const u8* pks_raw) {
+  u64 tx[8][8], ty[8][8];
+  for (int k = 0; k < 8; k++) {
+    const u8* in = pks_raw + 96 * k;
+    u64 xs[6], ys[6];
+    for (int i = 0; i < 6; i++) {
+      u64 w = 0, w2 = 0;
+      for (int j = 0; j < 8; j++) {
+        w = (w << 8) | in[i * 8 + j];
+        w2 = (w2 << 8) | in[48 + i * 8 + j];
+      }
+      xs[5 - i] = w;
+      ys[5 - i] = w2;
+    }
+    if (fp_cmp_raw(xs, P_RAW.l) >= 0 || fp_cmp_raw(ys, P_RAW.l) >= 0)
+      return false;
+    limbs6_to_52(tx[k], xs);
+    limbs6_to_52(ty[k], ys);
+  }
+  for (int j = 0; j < 8; j++) {
+    o.x.l[j] = _mm512_setr_epi64(
+        (long long)tx[0][j], (long long)tx[1][j], (long long)tx[2][j],
+        (long long)tx[3][j], (long long)tx[4][j], (long long)tx[5][j],
+        (long long)tx[6][j], (long long)tx[7][j]);
+    o.y.l[j] = _mm512_setr_epi64(
+        (long long)ty[0][j], (long long)ty[1][j], (long long)ty[2][j],
+        (long long)ty[3][j], (long long)ty[4][j], (long long)ty[5][j],
+        (long long)ty[6][j], (long long)ty[7][j]);
+  }
+  Fp8 r2;
+  fp8_bcast(r2, R52SQ_52);
+  fp8_montmul(o.x, o.x, r2);
+  fp8_montmul(o.y, o.y, r2);
+  static const u64 ONEP[8] = {1, 0, 0, 0, 0, 0, 0, 0};
+  Fp8 onep;
+  fp8_bcast(onep, ONEP);
+  fp8_montmul(o.z, r2, onep);  // z = 1 in R52-Montgomery form
+  Fp8 y2, x2, x3, b4;
+  fp8_sqr(y2, o.y);
+  fp8_sqr(x2, o.x);
+  fp8_montmul(x3, x2, o.x);
+  fp8_load(b4, &G1_B, 1);
+  fp8_add(x3, x3, b4);
+  return fp8_eq_mask(y2, x3) == 0xFF;
+}
+
+// Eight running partial pubkey sums + scalar combine — the
+// fast_aggregate_verify aggregation loop (role of blst's pk aggregation
+// in crypto/bls.rs:114,135) at SoA throughput. The rare add exception
+// (a lane's partial sum equal to its incoming point) is patched with a
+// scalar doubling-capable add, so the result always matches the serial
+// pt_add chain; bad/infinity keys fail identically.
+EC_FP8_TARGET static int g1_sum_raw_x8_impl(G1& out, const u8* pks_raw,
+                                            size_t n) {
+  G1x8 acc;
+  if (!g1x8_load_from_raw(acc, pks_raw)) return 0;
+  size_t i = 8;
+  for (; i + 8 <= n; i += 8) {
+    G1x8 inc;
+    if (!g1x8_load_from_raw(inc, pks_raw + 96 * i)) return 0;
+    const G1x8 saved = acc;
+    __mmask8 exc = 0;
+    g1x8_add(acc, acc, inc, exc);
+    if (exc) {
+      G1 sv[8], nw[8], pk;
+      g1x8_store(sv, saved, 8);
+      g1x8_store(nw, acc, 8);
+      for (int g = 0; g < 8; g++)
+        if ((exc >> g) & 1) {
+          if (!g1_from_raw(pk, pks_raw + 96 * (i + g), 0) || pk.is_inf())
+            return 0;
+          pt_add(nw[g], sv[g], pk);
+        }
+      g1x8_load(acc, nw, 8);
+    }
+  }
+  G1 fin[8];
+  g1x8_store(fin, acc, 8);
+  G1 total = pt_infinity<FpOps>();
+  for (int g = 0; g < 8; g++) pt_add(total, total, fin[g]);
+  for (; i < n; i++) {
+    G1 pk;
+    if (!g1_from_raw(pk, pks_raw + 96 * i, 0) || pk.is_inf()) return 0;
+    pt_add(total, total, pk);
+  }
+  out = total;
+  return 1;
+}
+#endif  // EC_FP8_COMPILED
+
+// Sum n raw affine G1 points; false on any malformed/infinity key
+// (mirrors the serial g1_from_raw + pt_add loop bit for bit)
+static bool g1_sum_raw(G1& out, const u8* pks_raw, size_t n) {
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY && n >= 32) return g1_sum_raw_x8_impl(out, pks_raw, n) != 0;
+#endif
+  G1 acc = pt_infinity<FpOps>();
+  for (size_t i = 0; i < n; i++) {
+    G1 pk;
+    if (!g1_from_raw(pk, pks_raw + 96 * i, 0) || pk.is_inf()) return false;
+    pt_add(acc, acc, pk);
+  }
+  out = acc;
+  return true;
+}
+
 static void g2_to_raw(u8 out[192], const G2& p) {
   if (p.is_inf()) { memset(out, 0, 192); return; }
   Fp2 ax, ay;
@@ -4080,6 +4192,25 @@ int ec_fp8_selftest(u64 seed, int rounds) {
     if (!multi_miller_loop_x8_try(fx8, mp, 19)) return 0;  // engine off: done
     multi_miller_loop(fsc, mp2, 19);
     if (!fp12_eq(fx8, fsc)) return 12;
+    // eight-lane pubkey aggregation == serial chain, on a duplicate-heavy
+    // ragged list (41 points from 5 distinct values forces repeated adds)
+    u8 raws[41 * 96];
+    for (int i = 0; i < 41; i++) {
+      u64 k[2];
+      k[0] = (u64)(i % 5) + 2;
+      k[1] = 0;
+      G1 gp;
+      pt_mul(gp, G1_GEN, k, 2);
+      g1_to_raw(raws + 96 * i, gp);
+    }
+    G1 batch_sum, serial_sum = pt_infinity<FpOps>();
+    if (!g1_sum_raw(batch_sum, raws, 41)) return 13;
+    for (int i = 0; i < 41; i++) {
+      G1 pk;
+      if (!g1_from_raw(pk, raws + 96 * i, 0)) return 13;
+      pt_add(serial_sum, serial_sum, pk);
+    }
+    if (!pt_eq_jacobian(batch_sum, serial_sum)) return 14;
   }
   return 0;
 #else
@@ -4212,12 +4343,8 @@ int ec_bls_fast_aggregate_verify_raw(const u8* pks_raw, size_t n,
                                      const u8* sig96, int assume_valid) {
   ensure_init();
   if (n == 0) return 0;
-  G1 acc = pt_infinity<FpOps>();
-  for (size_t i = 0; i < n; i++) {
-    G1 pk;
-    if (!g1_from_raw(pk, pks_raw + 96 * i, 0) || pk.is_inf()) return -5;
-    pt_add(acc, acc, pk);
-  }
+  G1 acc;
+  if (!g1_sum_raw(acc, pks_raw, n)) return -5;
   G2 sig;
   int rc = g2_decompress(sig, sig96, assume_valid == 0);
   if (rc != DEC_OK) return -rc;
@@ -4242,18 +4369,18 @@ int ec_bls_aggregate_verify(const u8* pks, size_t n, const u8* msgs,
   if (sig.is_inf()) return 0;
   G1* ps = new G1[n + 1];
   G2* qs = new G2[n + 1];
-  size_t off = 0;
   for (size_t i = 0; i < n; i++) {
     G1 pk;
     rc = g1_decompress(pk, pks + 48 * i, assume_valid == 0);
     if (rc != DEC_OK) { delete[] ps; delete[] qs; return -rc; }
     if (pk.is_inf()) { delete[] ps; delete[] qs; return 0; }
     ps[i] = pk;
-    if (!hash_to_g2_point(qs[i], msgs + off, msg_lens[i], dst, dst_len)) {
-      delete[] ps; delete[] qs;
-      return -1;
-    }
-    off += msg_lens[i];
+  }
+  // distinct-message hashes batch eight-wide on the IFMA engine
+  if (!hash_to_g2_batch(qs, msgs, msg_lens, n, dst, dst_len)) {
+    delete[] ps;
+    delete[] qs;
+    return -1;
   }
   pt_neg(ps[n], G1_GEN);
   qs[n] = sig;
@@ -4379,17 +4506,9 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
   for (size_t i = 0; i < n_sets && ok; i++) {
     u32 cnt = pk_counts[i];
     if (cnt == 0) { ok = false; break; }
-    G1 agg = pt_infinity<FpOps>();
-    for (u32 j = 0; j < cnt; j++) {
-      G1 pk;
-      if (!g1_from_raw(pk, pks_raw + 96 * (pk_off + j), 0) || pk.is_inf()) {
-        ok = false;
-        break;
-      }
-      pt_add(agg, agg, pk);
-    }
+    G1 agg;
+    if (!g1_sum_raw(agg, pks_raw + 96 * pk_off, cnt)) { ok = false; break; }
     pk_off += cnt;
-    if (!ok) break;
     if (agg.is_inf()) { ok = false; break; }
     u64 r[4] = {0, 0, 0, 0};
     for (int b = 0; b < 8; b++) r[1] = (r[1] << 8) | scalars16[16 * i + b];
