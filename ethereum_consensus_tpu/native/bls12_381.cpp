@@ -3108,6 +3108,36 @@ static void g1_mul128_batch(G1* out, const G1* pts, const u64 (*r)[2],
   }
 }
 
+// Eight-lane sum of n (>= 8) decompressed G2 points: running partial
+// sums per lane, scalar combine; infinity operands blend through, the
+// duplicate-point doubling corner patches scalar (result == serial chain)
+EC_FP8_TARGET static void g2_sum_pts_x8(G2& out, const G2* pts, size_t n) {
+  G2x8 accv;
+  g2x8_load(accv, pts, 8);
+  size_t i = 8;
+  for (; i + 8 <= n; i += 8) {
+    G2x8 inc;
+    g2x8_load(inc, pts + i, 8);
+    const G2x8 saved = accv;
+    __mmask8 exc = 0;
+    g2x8_add(accv, accv, inc, exc);
+    if (exc) {
+      G2 sv[8], nw[8];
+      g2x8_store(sv, saved, 8);
+      g2x8_store(nw, accv, 8);
+      for (int g = 0; g < 8; g++)
+        if ((exc >> g) & 1) pt_add(nw[g], sv[g], pts[i + g]);
+      g2x8_load(accv, nw, 8);
+    }
+  }
+  G2 fin[8];
+  g2x8_store(fin, accv, 8);
+  G2 acc = pt_infinity<Fp2Ops>();
+  for (int g = 0; g < 8; g++) pt_add(acc, acc, fin[g]);
+  for (; i < n; i++) pt_add(acc, acc, pts[i]);
+  out = acc;
+}
+
 // ---- Fp6x8 / Fp12x8: lane-parallel tower for the eight-wide Miller loop ----
 
 EC_FP8_TARGET static void fp2x8_mul_by_xi(Fp2x8& o, const Fp2x8& a) {
@@ -4393,6 +4423,29 @@ int ec_bls_aggregate_verify(const u8* pks, size_t n, const u8* msgs,
 int ec_bls_aggregate_sigs(const u8* sigs, size_t n, u8* out96) {
   ensure_init();
   if (n == 0) return -1;
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY && n >= 32) {
+    // batched decompression (eight-wide sqrt chains + subgroup checks),
+    // then eight running partial sums; duplicate-signature collisions
+    // (the doubling corner) patch scalar — identical to the serial chain
+    G2* pts = new G2[n];
+    int* rcs = new int[n];
+    g2_decompress_batch(pts, rcs, sigs, n, true);
+    for (size_t i = 0; i < n; i++)
+      if (rcs[i] != DEC_OK) {
+        int rc = rcs[i];
+        delete[] pts;
+        delete[] rcs;
+        return -rc;
+      }
+    G2 acc2;
+    g2_sum_pts_x8(acc2, pts, n);
+    delete[] pts;
+    delete[] rcs;
+    g2_compress(out96, acc2);
+    return 0;
+  }
+#endif
   G2 acc = pt_infinity<Fp2Ops>();
   for (size_t i = 0; i < n; i++) {
     G2 s;
