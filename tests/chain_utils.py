@@ -195,6 +195,14 @@ def cached_genesis(validator_count: int, preset_name: str):
         ns.BeaconState.deserialize,
         lambda: make_genesis_state(validator_count, context),
     )
+    # A disk-cache hit deserializes with COLD hash-tree-root memos, while
+    # an in-process build leaves them warm — downstream users (and the
+    # block benches especially) would measure disk-cache luck instead of
+    # steady-state processing. One throwaway root warms the memo; every
+    # fresh_genesis copy carries it, matching a live client mid-chain.
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)
     return state, context
 
 
@@ -349,6 +357,11 @@ def _cached_genesis_fork(fork_name: str, validator_count: int, preset_name: str)
         state_type.deserialize,
         builder,
     )
+    # warm the root memo (see cached_genesis): disk-cache hits must not
+    # make downstream benches re-merkleize a cold state every iteration
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)
     return state, context
 
 
