@@ -29,6 +29,12 @@
 // scalar); measured ~6x over the scalar loop on the build machine.
 #if !defined(EC_SHA_NI_ACTIVE) && defined(__AVX2__) && defined(__x86_64__)
 #define EC_AVX2_ACTIVE 1
+#endif
+
+// the AVX-512 kernel below is compiled with target attributes on any
+// x86-64 build (runtime-dispatched), so the intrinsics header is needed
+// even when the baseline ISA has no AVX2
+#if defined(__x86_64__)
 #include <immintrin.h>
 #endif
 
@@ -215,9 +221,8 @@ inline void sha256_64_ni(const uint8_t* in, uint8_t* out) {
 }
 #endif  // EC_SHA_NI_ACTIVE
 
-#ifdef EC_AVX2_ACTIVE
-
-// message schedule of the constant pad block, computed once
+// message schedule of the constant pad block, computed once (shared by
+// the AVX2 and AVX-512 multi-buffer kernels)
 struct PadSchedule {
   uint32_t w[64];
   PadSchedule() {
@@ -230,6 +235,8 @@ struct PadSchedule {
   }
 };
 const PadSchedule PAD_SCHED;
+
+#ifdef EC_AVX2_ACTIVE
 
 inline __m256i rotr8(__m256i x, int n) {
   return _mm256_or_si256(_mm256_srli_epi32(x, n),
@@ -334,6 +341,125 @@ inline void sha256_64_x8(const uint8_t* in, uint8_t* out) {
 
 #endif  // EC_AVX2_ACTIVE
 
+#if defined(__x86_64__)
+#define EC_AVX512_COMPILED 1
+#define EC_SHA512_TARGET \
+  __attribute__((target("avx512f,avx512bw,avx512dq,avx512vl")))
+
+// 16-way AVX-512 multi-buffer path: same transposed-lane scheme as the
+// AVX2 kernel, but with the ISA doing real work per instruction — native
+// 32-bit rotates (vprord) replace the shift/shift/or triple, and
+// vpternlogd fuses ch, maj, and each three-way xor into single ops.
+// Runtime-dispatched (the .so is built per machine, but the check stays
+// dynamic so a cached binary can never fault on a non-AVX-512 host).
+
+#define EC_ROUND16(wt)                                                       \
+  do {                                                                       \
+    __m512i S1 = _mm512_ternarylogic_epi32(                                  \
+        _mm512_ror_epi32(e, 6), _mm512_ror_epi32(e, 11),                     \
+        _mm512_ror_epi32(e, 25), 0x96);                                      \
+    __m512i ch = _mm512_ternarylogic_epi32(e, f, g, 0xCA);                   \
+    __m512i t1 = _mm512_add_epi32(                                           \
+        _mm512_add_epi32(_mm512_add_epi32(h, S1), ch),                       \
+        _mm512_add_epi32(_mm512_set1_epi32(int(K[t])), (wt)));               \
+    __m512i S0 = _mm512_ternarylogic_epi32(                                  \
+        _mm512_ror_epi32(a, 2), _mm512_ror_epi32(a, 13),                     \
+        _mm512_ror_epi32(a, 22), 0x96);                                      \
+    __m512i maj = _mm512_ternarylogic_epi32(a, b, c, 0xE8);                  \
+    __m512i t2 = _mm512_add_epi32(S0, maj);                                  \
+    h = g; g = f; f = e; e = _mm512_add_epi32(d, t1);                        \
+    d = c; c = b; b = a; a = _mm512_add_epi32(t1, t2);                       \
+  } while (0)
+
+// sixteen independent 64-byte messages -> sixteen 32-byte digests
+EC_SHA512_TARGET inline void sha256_64_x16(const uint8_t* in, uint8_t* out) {
+  __m512i a = _mm512_set1_epi32(int(H0[0]));
+  __m512i b = _mm512_set1_epi32(int(H0[1]));
+  __m512i c = _mm512_set1_epi32(int(H0[2]));
+  __m512i d = _mm512_set1_epi32(int(H0[3]));
+  __m512i e = _mm512_set1_epi32(int(H0[4]));
+  __m512i f = _mm512_set1_epi32(int(H0[5]));
+  __m512i g = _mm512_set1_epi32(int(H0[6]));
+  __m512i h = _mm512_set1_epi32(int(H0[7]));
+
+  __m512i w[16];
+  for (int t = 0; t < 16; ++t) {
+    alignas(64) uint32_t lanes[16];
+    for (int lane = 0; lane < 16; ++lane)
+      lanes[lane] = load_be32(in + lane * 64 + 4 * t);
+    w[t] = _mm512_load_si512(reinterpret_cast<const __m512i*>(lanes));
+  }
+  for (int t = 0; t < 64; ++t) {
+    if (t >= 16) {
+      __m512i w15 = w[(t - 15) & 15], w2 = w[(t - 2) & 15];
+      __m512i s0 = _mm512_ternarylogic_epi32(
+          _mm512_ror_epi32(w15, 7), _mm512_ror_epi32(w15, 18),
+          _mm512_srli_epi32(w15, 3), 0x96);
+      __m512i s1 = _mm512_ternarylogic_epi32(
+          _mm512_ror_epi32(w2, 17), _mm512_ror_epi32(w2, 19),
+          _mm512_srli_epi32(w2, 10), 0x96);
+      w[t & 15] = _mm512_add_epi32(
+          _mm512_add_epi32(w[t & 15], s0),
+          _mm512_add_epi32(w[(t - 7) & 15], s1));
+    }
+    EC_ROUND16(w[t & 15]);
+  }
+  __m512i sa = _mm512_add_epi32(a, _mm512_set1_epi32(int(H0[0])));
+  __m512i sb = _mm512_add_epi32(b, _mm512_set1_epi32(int(H0[1])));
+  __m512i sc = _mm512_add_epi32(c, _mm512_set1_epi32(int(H0[2])));
+  __m512i sd = _mm512_add_epi32(d, _mm512_set1_epi32(int(H0[3])));
+  __m512i se = _mm512_add_epi32(e, _mm512_set1_epi32(int(H0[4])));
+  __m512i sf = _mm512_add_epi32(f, _mm512_set1_epi32(int(H0[5])));
+  __m512i sg = _mm512_add_epi32(g, _mm512_set1_epi32(int(H0[6])));
+  __m512i sh = _mm512_add_epi32(h, _mm512_set1_epi32(int(H0[7])));
+
+  a = sa; b = sb; c = sc; d = sd; e = se; f = sf; g = sg; h = sh;
+  for (int t = 0; t < 64; ++t) {
+    EC_ROUND16(_mm512_set1_epi32(int(PAD_SCHED.w[t])));
+  }
+  a = _mm512_add_epi32(a, sa);
+  b = _mm512_add_epi32(b, sb);
+  c = _mm512_add_epi32(c, sc);
+  d = _mm512_add_epi32(d, sd);
+  e = _mm512_add_epi32(e, se);
+  f = _mm512_add_epi32(f, sf);
+  g = _mm512_add_epi32(g, sg);
+  h = _mm512_add_epi32(h, sh);
+
+  alignas(64) uint32_t lanes[8][16];
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[0]), a);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[1]), b);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[2]), c);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[3]), d);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[4]), e);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[5]), f);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[6]), g);
+  _mm512_store_si512(reinterpret_cast<__m512i*>(lanes[7]), h);
+  for (int lane = 0; lane < 16; ++lane) {
+    for (int i = 0; i < 8; ++i) {
+      store_be32(out + 32 * lane + 4 * i, lanes[i][lane]);
+    }
+  }
+}
+
+#undef EC_ROUND16
+
+EC_SHA512_TARGET inline void hash_level_x16(const uint8_t* in, uint8_t* out,
+                                            size_t n16) {
+  for (size_t i = 0; i < n16; ++i) {
+    sha256_64_x16(in + 64 * 16 * i, out + 32 * 16 * i);
+  }
+}
+
+inline bool avx512_available() {
+  static const bool ok = __builtin_cpu_supports("avx512f") &&
+                         __builtin_cpu_supports("avx512bw") &&
+                         __builtin_cpu_supports("avx512dq") &&
+                         __builtin_cpu_supports("avx512vl");
+  return ok;
+}
+#endif  // __x86_64__
+
 }  // namespace
 
 extern "C" {
@@ -347,6 +473,13 @@ void ec_hash_level(const uint8_t* in, uint8_t* out, size_t n_pairs) {
   }
 #else
   size_t i = 0;
+#ifdef EC_AVX512_COMPILED
+  if (avx512_available() && n_pairs >= 16) {
+    size_t n16 = n_pairs / 16;
+    hash_level_x16(in, out, n16);
+    i = 16 * n16;
+  }
+#endif
 #ifdef EC_AVX2_ACTIVE
   for (; i + 8 <= n_pairs; i += 8) {
     sha256_64_x8(in + 64 * i, out + 32 * i);
