@@ -2038,9 +2038,39 @@ static bool pairing_product_is_one(const G1* ps, const G2* qs, size_t n) {
   size_t m = 0;
   for (size_t i = 0; i < n; i++) {
     if (ps[i].is_inf() || qs[i].is_inf()) continue;
-    pt_to_affine<FpOps>(use[m].xp, use[m].yp, ps[i]);
-    pt_to_affine<Fp2Ops>(use[m].xq, use[m].yq, qs[i]);
+    // stash the Jacobian coords; the z inversions batch below (chunks of
+    // 64 through one fp_inv each — Montgomery's trick)
+    use[m].xp = ps[i].x;
+    use[m].yp = ps[i].y;
+    use[m].xq = qs[i].x;
+    use[m].yq = qs[i].y;
+    use[m].t.x = qs[i].z;  // temporary: G2 z parked in the accumulator slot
+    use[m].t.z.c0 = ps[i].z;
     m++;
+  }
+  for (size_t base = 0; base < m; base += 64) {
+    int c = (int)(m - base < 64 ? m - base : 64);
+    Fp z1[64];
+    Fp2 z2[64];
+    for (int k = 0; k < c; k++) {
+      z1[k] = use[base + k].t.z.c0;
+      z2[k] = use[base + k].t.x;
+    }
+    fp_inv_batch(z1, c);
+    fp2_inv_batch(z2, c);
+    for (int k = 0; k < c; k++) {
+      MillerPair& pr = use[base + k];
+      Fp i2, i3;
+      fp_sqr(i2, z1[k]);
+      fp_mul(i3, i2, z1[k]);
+      fp_mul(pr.xp, pr.xp, i2);
+      fp_mul(pr.yp, pr.yp, i3);
+      Fp2 j2, j3;
+      fp2_sqr(j2, z2[k]);
+      fp2_mul(j3, j2, z2[k]);
+      fp2_mul(pr.xq, pr.xq, j2);
+      fp2_mul(pr.yq, pr.yq, j3);
+    }
   }
   Fp12 f, fe;
   if (!multi_miller_loop_x8_try(f, use, m)) multi_miller_loop(f, use, m);
