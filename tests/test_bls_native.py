@@ -316,3 +316,56 @@ class TestFp8Engine:
         # fp8_active is allowed to be False (non-IFMA host) but must be a
         # clean bool either way
         assert nb.fp8_active() in (True, False)
+
+
+class TestBatchPhasesSoundness:
+    """The phased RLC batch (eight-wide decompression, hash-to-G2,
+    blinder mults, Miller lanes) must agree with per-set verification on
+    randomized valid/invalid mixes — a batch may never accept a mix
+    containing a bad set, and must accept any all-valid mix."""
+
+    def test_random_mixes_agree_with_per_set_verdicts(self):
+        import random
+
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        rng = random.Random(0xEC)
+        dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+        sks = [int.to_bytes(40_000 + i, 32, "big") for i in range(24)]
+        pks = [nb.sk_to_pk(sk) for sk in sks]
+        raws = [nb.g1_decompress(pk, check_subgroup=False)[1] for pk in pks]
+        for trial in range(6):
+            n_sets = rng.choice([3, 17, 24])  # below/above the x8 cutovers
+            sets = []
+            per_set_ok = []
+            for i in range(n_sets):
+                k = rng.randrange(1, 4)
+                idxs = [rng.randrange(len(sks)) for _ in range(k)]
+                msg = bytes([trial, i]) * 16
+                sigs = [nb.sign(sks[j], msg, dst) for j in idxs]
+                rc, agg = nb.aggregate_signatures(sigs)
+                assert rc == 0
+                valid = rng.random() < 0.8
+                if not valid:
+                    corrupt = rng.choice(["msg", "sig"])
+                    if corrupt == "msg":
+                        msg = bytes(32)
+                    else:
+                        # a different set's aggregate: wrong but well-formed
+                        other = nb.sign(sks[0], b"other" * 6, dst)
+                        agg = other
+                sets.append(([raws[j] for j in idxs], msg, agg))
+                ok = all(
+                    nb.fast_aggregate_verify_raw(
+                        [raws[j] for j in idxs], msg, agg, dst,
+                        assume_valid=False,
+                    ) == 1
+                    for _ in range(1)
+                )
+                per_set_ok.append(ok)
+            scalars = [int.to_bytes(rng.getrandbits(128) | 1, 16, "big")
+                       for _ in range(n_sets)]
+            got = nb.batch_verify_raw(sets, dst, scalars)
+            assert got == all(per_set_ok), (trial, per_set_ok, got)
