@@ -3870,7 +3870,30 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
   typedef typename Ops::F F;
   if (n == 0) { out = pt_infinity<Ops>(); return; }
   int c = msm_window_bits(n);
-  int nbuckets = (1 << c) - 1;
+  // SIGNED digits d in (-2^(c-1), 2^(c-1)]: negating an affine point is
+  // free (flip y), so half the buckets cover the same window — the
+  // bucket reduction (the other half of Pippenger's cost) halves with
+  // it. One spill window absorbs the final carry.
+  const int half = 1 << (c - 1);
+  int nbuckets = half;
+  int windows = (scalar_bits + c - 1) / c + 1;
+  int16_t* digs = new int16_t[n * (size_t)windows];
+  for (size_t k = 0; k < n; k++) {
+    int carry = 0;
+    for (int win = 0; win < windows; win++) {
+      int v = scalar_window(scalars + 4 * k, 4, win * c, c) + carry;
+      if (v > half) {
+        digs[k * windows + win] = (int16_t)(v - (1 << c));
+        carry = 1;
+      } else {
+        digs[k * windows + win] = (int16_t)v;
+        carry = 0;
+      }
+    }
+  }
+  // negated y per point, picked by digit sign at zero per-use cost
+  F* nys = new F[n];
+  for (size_t k = 0; k < n; k++) Ops::neg(nys[k], ys[k]);
   // below this many pending adds, one shared EEA inversion no longer
   // beats plain Jacobian mixed adds
   const size_t BATCH_MIN = 16;
@@ -3882,28 +3905,32 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
   char* jstate = new char[nbuckets];
   size_t* pend_b = new size_t[n];
   size_t* pend_k = new size_t[n];
+  char* pend_s = new char[n];
   size_t* nxt_b = new size_t[n];
   size_t* nxt_k = new size_t[n];
+  char* nxt_s = new char[n];
   size_t* sel_b = new size_t[n];
   size_t* sel_k = new size_t[n];
+  char* sel_s = new char[n];
   char* sel_dbl = new char[n];
   F* denom = new F[n];
   F* prefix = new F[n + 1];
 
   Point<Ops> result = pt_infinity<Ops>();
-  int windows = (scalar_bits + c - 1) / c;
   for (int win = windows - 1; win >= 0; win--) {
     for (int i = 0; i < c; i++) pt_double(result, result);
     for (int b = 0; b < nbuckets; b++) { bstate[b] = 0; jstate[b] = 0; }
     size_t pending = 0;
     for (size_t k = 0; k < n; k++) {
-      int d = scalar_window(scalars + 4 * k, 4, win * c, c);
+      int d = digs[k * windows + win];
       if (!d) continue;
-      size_t b = size_t(d - 1);
+      char s = d < 0;
+      size_t b = size_t((s ? -d : d) - 1);
       if (!bstate[b]) {
-        bx[b] = xs[k]; by[b] = ys[k]; bstate[b] = 1;
+        bx[b] = xs[k]; by[b] = (s ? nys : ys)[k]; bstate[b] = 1;
       } else {
-        pend_b[pending] = b; pend_k[pending] = k; pending++;
+        pend_b[pending] = b; pend_k[pending] = k; pend_s[pending] = s;
+        pending++;
       }
     }
     while (pending >= BATCH_MIN) {
@@ -3911,18 +3938,20 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
       size_t m = 0, rest = 0;
       for (size_t t = 0; t < pending; t++) {
         size_t b = pend_b[t], k = pend_k[t];
+        char s = pend_s[t];
+        const F& yk = (s ? nys : ys)[k];
         if (!bstate[b]) {  // bucket annihilated earlier this window
-          bx[b] = xs[k]; by[b] = ys[k]; bstate[b] = 1;
+          bx[b] = xs[k]; by[b] = yk; bstate[b] = 1;
           continue;
         }
         if (busy[b]) {
-          nxt_b[rest] = b; nxt_k[rest] = k; rest++;
+          nxt_b[rest] = b; nxt_k[rest] = k; nxt_s[rest] = s; rest++;
           continue;
         }
         busy[b] = 1;
         // classify: general add, doubling, or annihilation
         if (Ops::eq(bx[b], xs[k])) {
-          if (Ops::eq(by[b], ys[k])) {
+          if (Ops::eq(by[b], yk)) {
             if (Ops::is_zero(by[b])) { bstate[b] = 0; continue; }  // 2P = ∞
             sel_dbl[m] = 1;
             Ops::add(denom[m], by[b], by[b]);            // 2y
@@ -3934,7 +3963,7 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
           sel_dbl[m] = 0;
           Ops::sub(denom[m], xs[k], bx[b]);              // x2 − x1
         }
-        sel_b[m] = b; sel_k[m] = k; m++;
+        sel_b[m] = b; sel_k[m] = k; sel_s[m] = s; m++;
       }
       // one shared inversion for every selected add
       if (m) {
@@ -3948,6 +3977,7 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
           Ops::mul(dinv, prefix[t], invall);             // 1/denom[t]
           Ops::mul(invall, invall, denom[t]);
           size_t b = sel_b[t], k = sel_k[t];
+          const F& yk = (sel_s[t] ? nys : ys)[k];
           if (sel_dbl[t]) {
             Ops::sqr(t1, bx[b]);                         // 3x²
             F t2;
@@ -3955,7 +3985,7 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
             Ops::add(t1, t2, t1);
             Ops::mul(lam, t1, dinv);
           } else {
-            Ops::sub(t1, ys[k], by[b]);                  // y2 − y1
+            Ops::sub(t1, yk, by[b]);                     // y2 − y1
             Ops::mul(lam, t1, dinv);
           }
           Ops::sqr(x3, lam);
@@ -3969,13 +3999,15 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
       }
       std::memcpy(pend_b, nxt_b, rest * sizeof(size_t));
       std::memcpy(pend_k, nxt_k, rest * sizeof(size_t));
+      std::memcpy(pend_s, nxt_s, rest * sizeof(char));
       pending = rest;
     }
     // stragglers: cheap Jacobian mixed adds into per-bucket shadows
     for (size_t t = 0; t < pending; t++) {
       size_t b = pend_b[t], k = pend_k[t];
       if (!jstate[b]) { jshadow[b] = pt_infinity<Ops>(); jstate[b] = 1; }
-      pt_add_affine(jshadow[b], jshadow[b], xs[k], ys[k]);
+      pt_add_affine(jshadow[b], jshadow[b], xs[k],
+                    (pend_s[t] ? nys : ys)[k]);
     }
     Point<Ops> running = pt_infinity<Ops>(), acc = pt_infinity<Ops>();
     for (int b = nbuckets - 1; b >= 0; b--) {
@@ -3985,10 +4017,12 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
     }
     pt_add(result, result, acc);
   }
+  delete[] digs; delete[] nys;
   delete[] bx; delete[] by; delete[] bstate; delete[] busy;
   delete[] jshadow; delete[] jstate;
-  delete[] pend_b; delete[] pend_k; delete[] nxt_b; delete[] nxt_k;
-  delete[] sel_b; delete[] sel_k; delete[] sel_dbl;
+  delete[] pend_b; delete[] pend_k; delete[] pend_s;
+  delete[] nxt_b; delete[] nxt_k; delete[] nxt_s;
+  delete[] sel_b; delete[] sel_k; delete[] sel_s; delete[] sel_dbl;
   delete[] denom; delete[] prefix;
   out = result;
 }
