@@ -162,6 +162,29 @@ def _pk_cache_put(data: bytes, raw: bytes) -> None:
     _RAW_PK_CACHE[data] = raw
 
 
+def warm_pubkey_cache(keys) -> None:
+    """Bulk-fill the decompressed-pubkey cache: every uncached key in
+    ``keys`` (48-byte compressed) decompresses through the native
+    eight-wide sqrt + subgroup chains in one call, so a following stream
+    of PublicKey.from_bytes calls — a committee's attesters, a sync
+    committee — is all cache hits. Invalid or identity keys are simply
+    not cached; from_bytes raises the precise error when the key is
+    actually used. No-op on the pure-Python backend."""
+    if not _native():
+        return
+    todo = list(dict.fromkeys(
+        bytes(k) for k in keys if bytes(k) not in _RAW_PK_CACHE
+    ))
+    if len(todo) < 8:  # below the lane width there is nothing to win
+        return
+    for rc_raw_inf, key in zip(
+        native_bls.g1_decompress_batch(todo, check_subgroup=True), todo
+    ):
+        rc, raw, is_inf = rc_raw_inf
+        if rc == 0 and not is_inf:
+            _pk_cache_put(key, raw)
+
+
 class PublicKey:
     """G1 point, 48-byte compressed. Infinity is rejected at parse time
     (blst key_validate semantics); an *aggregate* of valid keys may still

@@ -121,6 +121,7 @@ def _declare(lib) -> None:
         "ec_g1_subgroup_check_raw": ([p8], i32),
         "ec_g2_subgroup_check_raw": ([p8], i32),
         "ec_pairing_product_is_one_raw": ([p8, p8, p8, p8, sz], i32),
+        "ec_g1_decompress_batch": ([p8, sz, p8, c.POINTER(i32), c.POINTER(i32), i32], i32),
         "ec_fp8_active": ([], i32),
         "ec_fp8_selftest": ([c.c_uint64, i32], i32),
     }
@@ -431,3 +432,25 @@ def fp8_selftest(seed: int = 0, rounds: int = 50) -> int:
     Returns 0 when every family agrees (or the engine is inactive); a
     nonzero code identifies the first failing family."""
     return _lib().ec_fp8_selftest(seed, rounds)
+
+
+def g1_decompress_batch(
+    keys: "list[bytes]", check_subgroup: bool = True
+) -> "list[tuple[int, bytes, bool]]":
+    """Bulk G1 decompression with the sqrt and subgroup chains batched
+    eight keys wide; per-key (rc, raw96, is_infinity) triples identical
+    to calling g1_decompress on each."""
+    n = len(keys)
+    if n == 0:
+        return []
+    out = _c.create_string_buffer(96 * n)
+    rcs = (_c.c_int * n)()
+    infs = (_c.c_int * n)()
+    _lib().ec_g1_decompress_batch(
+        b"".join(bytes(k) for k in keys), n, out, rcs, infs,
+        int(check_subgroup),
+    )
+    raw = out.raw
+    return [
+        (rcs[i], raw[96 * i : 96 * i + 96], bool(infs[i])) for i in range(n)
+    ]

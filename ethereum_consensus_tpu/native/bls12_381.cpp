@@ -991,6 +991,44 @@ EC_FP8_TARGET static u32 fp2_sqrt_x8_ifma(Fp2* out, const Fp2* const* in,
 }
 #endif  // EC_FP8_COMPILED
 
+#ifdef EC_FP8_COMPILED
+// eight Fp square roots through one batched (p+1)/4 chain
+EC_FP8_TARGET static u32 fp_sqrt_x8_ifma(Fp* out, const Fp* const* in, int n) {
+  Fp vals[8];
+  for (int k = 0; k < 8; k++) vals[k] = *in[k < n ? k : 0];
+  Fp8 a8, r8;
+  fp8_load(a8, vals, 8);
+  const __mmask8 okm = fp8_sqrt(r8, a8);
+  Fp roots[8];
+  fp8_store(roots, r8, 8);
+  u32 okbits = 0;
+  for (int k = 0; k < n; k++) {
+    if ((okm >> k) & 1) {
+      out[k] = roots[k];
+      okbits |= 1u << k;
+    } else {
+      // engine said non-square; scalar confirm keeps verdicts pinned
+      Fp r;
+      if (fp_sqrt(r, *in[k])) { out[k] = r; okbits |= 1u << k; }
+    }
+  }
+  return okbits;
+}
+#endif  // EC_FP8_COMPILED
+
+// Dispatch: batched Fp sqrt over up to 8 independent inputs
+static u32 fp_sqrt_x8(Fp* out, const Fp* const* in, int n) {
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY) return fp_sqrt_x8_ifma(out, in, n);
+#endif
+  u32 okbits = 0;
+  for (int k = 0; k < n; k++) {
+    Fp r;
+    if (fp_sqrt(r, *in[k])) { out[k] = r; okbits |= 1u << k; }
+  }
+  return okbits;
+}
+
 // Dispatch wrapper: batched Fp2 sqrt over up to 8 independent inputs
 // (pointer array), scalar fallback when the IFMA engine is unavailable.
 static u32 fp2_sqrt_x8(Fp2* out, const Fp2* const* in, int n) {
@@ -3513,6 +3551,45 @@ static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
   }
 }
 
+// [|x|]P on G1 lanes, shared sparse schedule (no negate — used squared)
+EC_FP8_TARGET static void g1x8_mul_bls_x_abs(G1x8& o, const G1x8& p,
+                                             __mmask8& exc) {
+  G1x8 acc = p;
+  for (int b = 62; b >= 0; b--) {
+    g1x8_dbl(acc, acc);
+    if ((BLS_X_ABS >> b) & 1) g1x8_add(acc, acc, p, exc);
+  }
+  o = acc;
+}
+
+// GLV criterion phi(P) + P == [x^2]P per lane (vector twin of
+// g1_in_subgroup_fast); degenerate lanes land in *exc
+EC_FP8_TARGET static __mmask8 g1x8_in_subgroup_mask(const G1x8& p,
+                                                    __mmask8& exc) {
+  G1x8 l = p, r, t;
+  Fp8 beta;
+  fp8_load(beta, &G1_BETA, 1);
+  fp8_montmul(l.x, p.x, beta);
+  g1x8_add(l, l, p, exc);
+  g1x8_mul_bls_x_abs(t, p, exc);
+  g1x8_mul_bls_x_abs(r, t, exc);
+  const __mmask8 linf = fp8_is_zero_mask(l.z);
+  const __mmask8 rinf = fp8_is_zero_mask(r.z);
+  exc |= (__mmask8)(linf | rinf);
+  Fp8 z1z1, z2z2, a, b, z1c, z2c;
+  fp8_sqr(z1z1, l.z);
+  fp8_sqr(z2z2, r.z);
+  fp8_montmul(a, l.x, z2z2);
+  fp8_montmul(b, r.x, z1z1);
+  const __mmask8 xeq = fp8_eq_mask(a, b);
+  fp8_montmul(z1c, z1z1, l.z);
+  fp8_montmul(z2c, z2z2, r.z);
+  fp8_montmul(a, l.y, z2c);
+  fp8_montmul(b, r.y, z1c);
+  const __mmask8 yeq = fp8_eq_mask(a, b);
+  return xeq & yeq;
+}
+
 // Batched subgroup membership for n points; mirrors g2_in_subgroup
 static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
   if (!FP8_READY || G2_SUB_STATE != 1) {
@@ -3532,6 +3609,53 @@ static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
   }
 }
 
+// Batched G1 subgroup membership; mirrors g1_in_subgroup
+static void g1_in_subgroup_batch(bool* ok, const G1* pts, size_t n) {
+  if (!FP8_READY || G1_SUB_STATE != 1) {
+    for (size_t i = 0; i < n; i++) ok[i] = g1_in_subgroup(pts[i]);
+    return;
+  }
+  for (size_t base = 0; base < n; base += 8) {
+    int c = (int)(n - base < 8 ? n - base : 8);
+    G1x8 pv;
+    g1x8_load(pv, pts + base, c);
+    __mmask8 exc = 0;
+    const __mmask8 in_sub = g1x8_in_subgroup_mask(pv, exc);
+    for (int k = 0; k < c; k++) {
+      if ((exc >> k) & 1) ok[base + k] = g1_in_subgroup(pts[base + k]);
+      else ok[base + k] = (in_sub >> k) & 1;
+    }
+  }
+}
+
+// Eight-lane sum of n (>= 8) G1 points (the aggregate_public_keys tail)
+EC_FP8_TARGET static void g1_sum_pts_x8(G1& out, const G1* pts, size_t n) {
+  G1x8 accv;
+  g1x8_load(accv, pts, 8);
+  size_t i = 8;
+  for (; i + 8 <= n; i += 8) {
+    G1x8 inc;
+    g1x8_load(inc, pts + i, 8);
+    const G1x8 saved = accv;
+    __mmask8 exc = 0;
+    g1x8_add(accv, accv, inc, exc);
+    if (exc) {
+      G1 sv[8], nw[8];
+      g1x8_store(sv, saved, 8);
+      g1x8_store(nw, accv, 8);
+      for (int g = 0; g < 8; g++)
+        if ((exc >> g) & 1) pt_add(nw[g], sv[g], pts[i + g]);
+      g1x8_load(accv, nw, 8);
+    }
+  }
+  G1 fin[8];
+  g1x8_store(fin, accv, 8);
+  G1 acc = pt_infinity<FpOps>();
+  for (int g = 0; g < 8; g++) pt_add(acc, acc, fin[g]);
+  for (; i < n; i++) pt_add(acc, acc, pts[i]);
+  out = acc;
+}
+
 #else  // !EC_FP8_COMPILED
 
 static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
@@ -3539,6 +3663,9 @@ static void g2_clear_cofactor_batch(G2* out, const G2* in, size_t n) {
 }
 static void g2_in_subgroup_batch(bool* ok, const G2* pts, size_t n) {
   for (size_t i = 0; i < n; i++) ok[i] = g2_in_subgroup(pts[i]);
+}
+static void g1_in_subgroup_batch(bool* ok, const G1* pts, size_t n) {
+  for (size_t i = 0; i < n; i++) ok[i] = g1_in_subgroup(pts[i]);
 }
 static void g1_mul128_batch(G1* out, const G1* pts, const u64 (*r)[2],
                             size_t n) {
@@ -3699,6 +3826,97 @@ static bool hash_to_g2_batch(G2* out, const u8* msgs, const u32* msg_lens,
     g2_clear_cofactor_batch(out + base, sums, c);
   }
   return true;
+}
+
+static void g1_in_subgroup_batch(bool* ok, const G1* pts, size_t n);
+
+// n compressed G1 points with the sqrt chains batched eight-wide and the
+// subgroup criterion eight-wide; per-point rc mirrors g1_decompress
+// exactly. Serves pubkey-cache bulk fills and aggregate_public_keys.
+static void g1_decompress_batch(G1* out, int* rcs, const u8* pks, size_t n,
+                                bool check_subgroup) {
+  Fp* xs = new Fp[n];
+  Fp* y2s = new Fp[n];
+  u8* sign_flags = new u8[n];
+  for (size_t i = 0; i < n; i++) {
+    const u8* in = pks + 48 * i;
+    u8 flags = in[0];
+    sign_flags[i] = flags & FLAG_SIGN;
+    if (!(flags & FLAG_COMPRESSED)) {
+      rcs[i] = DEC_NOT_COMPRESSED;
+      continue;
+    }
+    if (flags & FLAG_INFINITY) {
+      rcs[i] = DEC_BAD_INFINITY;
+      if (!(flags & ~(FLAG_COMPRESSED | FLAG_INFINITY))) {
+        bool zero = true;
+        for (int b = 1; b < 48; b++)
+          if (in[b]) { zero = false; break; }
+        if (zero) {
+          out[i] = pt_infinity<FpOps>();
+          rcs[i] = DEC_OK;
+        }
+      }
+      continue;
+    }
+    u8 buf[48];
+    memcpy(buf, in, 48);
+    buf[0] = flags & 0x1F;
+    if (!fp_from_bytes(xs[i], buf)) {
+      rcs[i] = DEC_NOT_IN_FIELD;
+      continue;
+    }
+    Fp t;
+    fp_sqr(t, xs[i]);
+    fp_mul(y2s[i], t, xs[i]);
+    fp_add(y2s[i], y2s[i], G1_B);
+    rcs[i] = -1;  // sqrt pending
+  }
+  {
+    int pend[8], m = 0;
+    const Fp* ptrs[8];
+    Fp roots[8];
+    for (size_t k = 0; k <= n; k++) {
+      if (k < n && rcs[k] == -1) pend[m++] = (int)k;
+      if ((m == 8 || k == n) && m > 0) {
+        for (int j = 0; j < m; j++) ptrs[j] = &y2s[pend[j]];
+        u32 ok = fp_sqrt_x8(roots, ptrs, m);
+        for (int j = 0; j < m; j++) {
+          size_t idx = pend[j];
+          if (!((ok >> j) & 1)) {
+            rcs[idx] = DEC_NOT_ON_CURVE;
+            continue;
+          }
+          Fp y = roots[j];
+          if (fp_is_lex_largest(y) != !!sign_flags[idx]) fp_neg(y, y);
+          out[idx] = pt_from_affine<FpOps>(xs[idx], y);
+          rcs[idx] = DEC_OK;
+        }
+        m = 0;
+      }
+    }
+  }
+  if (check_subgroup) {
+    G1 good[8];
+    bool sub_ok[8];
+    size_t gidx[8];
+    int g = 0;
+    for (size_t k = 0; k <= n; k++) {
+      if (k < n && rcs[k] == DEC_OK && !out[k].is_inf()) {
+        good[g] = out[k];
+        gidx[g++] = k;
+      }
+      if ((g == 8 || k == n) && g > 0) {
+        g1_in_subgroup_batch(sub_ok, good, g);
+        for (int j = 0; j < g; j++)
+          if (!sub_ok[j]) rcs[gidx[j]] = DEC_NOT_IN_SUBGROUP;
+        g = 0;
+      }
+    }
+  }
+  delete[] xs;
+  delete[] y2s;
+  delete[] sign_flags;
 }
 
 // n compressed G2 points with the sqrt chains batched; per-point rc
@@ -4265,6 +4483,24 @@ int ec_fp8_selftest(u64 seed, int rounds) {
       pt_mul(want1, pts[i], sc, 2);
       if (!pt_eq_jacobian(got1[i], want1)) return 11;
     }
+    // batched G1 decompression (+ subgroup) == scalar, incl. corruption,
+    // the infinity encoding, and an off-subgroup point
+    {
+      u8 enc1[11 * 48];
+      for (int i = 0; i < 11; i++) g1_compress(enc1 + 48 * i, pts[i]);
+      enc1[48 * 2 + 9] ^= 0x10;
+      memset(enc1 + 48 * 4, 0, 48);
+      enc1[48 * 4] = 0xC0;  // infinity
+      G1 dec[11];
+      int rcs1[11];
+      g1_decompress_batch(dec, rcs1, enc1, 11, true);
+      for (int i = 0; i < 11; i++) {
+        G1 one;
+        int want_rc = g1_decompress(one, enc1 + 48 * i, true);
+        if (rcs1[i] != want_rc) return 15;
+        if (want_rc == DEC_OK && !pt_eq_jacobian(dec[i], one)) return 16;
+      }
+    }
     // eight-wide Miller loop == scalar Miller loop, bit for bit, on a
     // ragged pair count (19 pairs -> 3 slots, last slot 3 lanes active)
     MillerPair mp[19], mp2[19];
@@ -4524,6 +4760,30 @@ int ec_bls_aggregate_sigs(const u8* sigs, size_t n, u8* out96) {
 int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
   ensure_init();
   if (n == 0) return -1;
+#ifdef EC_FP8_COMPILED
+  if (FP8_READY && n >= 32) {
+    // eight-wide decompression (sqrt + subgroup chains) and lane sums
+    G1* pts = new G1[n];
+    int* rcs = new int[n];
+    g1_decompress_batch(pts, rcs, pks, n, true);
+    for (size_t i = 0; i < n; i++) {
+      int rc = rcs[i] != DEC_OK ? -rcs[i]
+               : pts[i].is_inf() ? -3  // each key must be a real point
+                                 : 0;
+      if (rc) {
+        delete[] pts;
+        delete[] rcs;
+        return rc;
+      }
+    }
+    G1 acc2;
+    g1_sum_pts_x8(acc2, pts, n);
+    delete[] pts;
+    delete[] rcs;
+    g1_compress(out48, acc2);
+    return 0;
+  }
+#endif
   G1 acc = pt_infinity<FpOps>();
   for (size_t i = 0; i < n; i++) {
     G1 p;
@@ -4533,6 +4793,30 @@ int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
     pt_add(acc, acc, p);
   }
   g1_compress(out48, acc);
+  return 0;
+}
+
+// Bulk G1 decompression: n compressed keys -> n (rc, raw96, is_inf)
+// triples with the sqrt and subgroup chains batched eight-wide. The
+// Python pubkey cache uses this to warm a whole committee in one call.
+int ec_g1_decompress_batch(const u8* in48s, size_t n, u8* out_raws,
+                           int* rcs_out, int* infs, int check_subgroup) {
+  ensure_init();
+  G1* pts = new G1[n];
+  int* rcs = new int[n];
+  g1_decompress_batch(pts, rcs, in48s, n, check_subgroup != 0);
+  for (size_t i = 0; i < n; i++) {
+    rcs_out[i] = rcs[i] == DEC_OK ? 0 : -rcs[i];
+    if (rcs[i] == DEC_OK) {
+      infs[i] = pts[i].is_inf() ? 1 : 0;
+      g1_to_raw(out_raws + 96 * i, pts[i]);
+    } else {
+      infs[i] = 0;
+      memset(out_raws + 96 * i, 0, 96);
+    }
+  }
+  delete[] pts;
+  delete[] rcs;
   return 0;
 }
 
