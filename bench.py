@@ -522,6 +522,14 @@ def bench_kzg(n_blobs: int = 4):
         )
         for _ in range(n_blobs)
     ]
+    # one throwaway commit first: the fixed-base MSM tables for the (one,
+    # process-lifetime) trusted setup precompute on first use — a live
+    # node commits blobs against the same setup forever, so steady state
+    # is the honest per-blob number; msm_prepare_s records the one-time
+    # cost for transparency
+    t0 = time.perf_counter()
+    kzg.blob_to_kzg_commitment(blobs[0], settings)
+    prepare_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     commitments = [bytes(kzg.blob_to_kzg_commitment(b, settings)) for b in blobs]
     commit_s = (time.perf_counter() - t0) / n_blobs
@@ -541,6 +549,7 @@ def bench_kzg(n_blobs: int = 4):
         "ok": bool(ok1) and bool(okb),
         "blobs": n_blobs,
         "commit_s_per_blob": commit_s,
+        "msm_prepare_s": prepare_s,
         "proof_s_per_blob": proof_s,
         "verify_s": verify_s,
         "batch_verify_s": batch_s,

@@ -122,6 +122,9 @@ def _declare(lib) -> None:
         "ec_g2_subgroup_check_raw": ([p8], i32),
         "ec_pairing_product_is_one_raw": ([p8, p8, p8, p8, sz], i32),
         "ec_g1_decompress_batch": ([p8, sz, p8, c.POINTER(i32), c.POINTER(i32), i32], i32),
+        "ec_g1_msm_prepare": ([p8, sz, i32], c.c_void_p),
+        "ec_g1_msm_prepared_run": ([c.c_void_p, p8, sz, p8, c.POINTER(i32)], i32),
+        "ec_g1_msm_prepared_free": ([c.c_void_p], None),
         "ec_fp8_active": ([], i32),
         "ec_fp8_selftest": ([c.c_uint64, i32], i32),
     }
@@ -454,3 +457,35 @@ def g1_decompress_batch(
     return [
         (rcs[i], raw[96 * i : 96 * i + 96], bool(infs[i])) for i in range(n)
     ]
+
+
+class PreparedMsm:
+    """Fixed-base G1 MSM handle: window shifts of static points (the KZG
+    Lagrange setup) precomputed native-side so each later MSM is a single
+    signed-digit bucket pass. Frees the native memory on GC."""
+
+    __slots__ = ("_handle", "_n")
+
+    def __init__(self, points_raw: bytes, n: int, window_bits: int = 12):
+        handle = _lib().ec_g1_msm_prepare(bytes(points_raw), n, window_bits)
+        if not handle:
+            raise NativeBlsError("msm precompute failed (bad points?)")
+        self._handle = handle
+        self._n = n
+
+    def run(self, scalars32: bytes) -> "tuple[bytes, bool]":
+        """(raw96, is_infinity) of sum scalars[i] * P_i."""
+        out = _c.create_string_buffer(96)
+        inf = _c.c_int(0)
+        rc = _lib().ec_g1_msm_prepared_run(
+            self._handle, bytes(scalars32), self._n, out, _c.byref(inf)
+        )
+        if rc != 0:
+            raise NativeBlsError(f"prepared msm failed rc={rc}")
+        return out.raw, bool(inf.value)
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and _LIB is not None:
+            _LIB.ec_g1_msm_prepared_free(handle)
+            self._handle = None

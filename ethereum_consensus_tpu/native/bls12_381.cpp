@@ -4075,11 +4075,210 @@ static void pt_msm(Point<Ops>& out, const Point<Ops>* pts, const u64* scalars,
 // trick), so an accumulation add costs ~6M instead of the Jacobian
 // mixed add's 11M+5S. Collisions (two adds into the same bucket in one
 // round) defer to the next round; once a round's batch gets too small
-// to amortize the inversion (adversarial repeated-scalar inputs
-// collapse every point into one bucket), the stragglers fall back to
-// Jacobian mixed adds into per-bucket shadow accumulators. Inputs are
-// affine coordinate arrays — the raw-bytes MSM entry points reject
-// infinity encodings before calling.
+// Scratch for the signed-digit batch-affine bucket pass; sized once per
+// MSM (nbuckets buckets, up to `cap` entries).
+template <class Ops>
+struct MsmScratch {
+  typedef typename Ops::F F;
+  int nbuckets;
+  u32 *cnt, *off, *pos, *sz;
+  char* jstate;
+  Point<Ops>* jshadow;
+  F *ix, *iy;          // item values, grouped by bucket
+  u32 *sel_p, *sel_q, *sel_tgt;
+  char* sel_dbl;
+  F *denom, *prefix, *rx, *ry;
+  MsmScratch(int nb, size_t cap) : nbuckets(nb) {
+    cnt = new u32[nb + 1]; off = new u32[nb + 1];
+    pos = new u32[nb]; sz = new u32[nb];
+    jstate = new char[nb];
+    jshadow = new Point<Ops>[nb];
+    ix = new F[cap]; iy = new F[cap];
+    sel_p = new u32[cap / 2 + 1]; sel_q = new u32[cap / 2 + 1];
+    sel_tgt = new u32[cap / 2 + 1];
+    sel_dbl = new char[cap / 2 + 1];
+    denom = new F[cap / 2 + 1]; prefix = new F[cap / 2 + 2];
+    rx = new F[cap / 2 + 1]; ry = new F[cap / 2 + 1];
+  }
+  ~MsmScratch() {
+    delete[] cnt; delete[] off; delete[] pos; delete[] sz;
+    delete[] jstate; delete[] jshadow;
+    delete[] ix; delete[] iy;
+    delete[] sel_p; delete[] sel_q; delete[] sel_tgt; delete[] sel_dbl;
+    delete[] denom; delete[] prefix; delete[] rx; delete[] ry;
+  }
+};
+
+// One signed-digit bucket pass over `ne` entries: entry t contributes
+// point e_k[t] (negated when e_d[t] < 0) to bucket |e_d[t]|-1. Items
+// group by bucket (counting sort), then a PAIRING TREE folds each
+// bucket: every round pairs its items two by two — all pairs are
+// independent affine additions sharing ONE inversion (Montgomery's
+// trick) — so a bucket of depth m collapses in log2(m) rounds
+// regardless of multiplicity (the fix for fixed-base passes where every
+// bucket holds dozens of entries). Doubling and annihilation pairs are
+// classified exactly; once a round is too small to amortize the shared
+// inversion, the leftovers fold through Jacobian shadows. Returns
+// acc = sum_b (b+1) * bucket_b.
+template <class Ops>
+static void msm_bucket_pass(Point<Ops>& acc_out, const typename Ops::F* xs,
+                            const typename Ops::F* ys,
+                            const typename Ops::F* nys, const u32* e_k,
+                            const int16_t* e_d, size_t ne,
+                            MsmScratch<Ops>& S) {
+  typedef typename Ops::F F;
+  const size_t BATCH_MIN = 16;
+  const int nbuckets = S.nbuckets;
+  // group items by bucket
+  std::memset(S.cnt, 0, sizeof(u32) * (nbuckets + 1));
+  for (size_t t = 0; t < ne; t++) {
+    int d = e_d[t];
+    S.cnt[(d < 0 ? -d : d) - 1 + 1]++;
+  }
+  S.off[0] = 0;
+  for (int b = 0; b < nbuckets; b++) S.off[b + 1] = S.off[b] + S.cnt[b + 1];
+  std::memcpy(S.pos, S.off, sizeof(u32) * nbuckets);
+  for (size_t t = 0; t < ne; t++) {
+    int d = e_d[t];
+    char s = d < 0;
+    int b = (s ? -d : d) - 1;
+    u32 slot = S.pos[b]++;
+    S.ix[slot] = xs[e_k[t]];
+    S.iy[slot] = (s ? nys : ys)[e_k[t]];
+  }
+  for (int b = 0; b < nbuckets; b++) {
+    S.sz[b] = S.off[b + 1] - S.off[b];
+    S.jstate[b] = 0;
+  }
+  // pairing-tree rounds
+  for (;;) {
+    // phase 1 — selection only (no item mutation, so a too-small round
+    // can abort cleanly): pairs, per-bucket survivor moves, new sizes
+    size_t m = 0;
+    size_t total_multi = 0;
+    for (int b = 0; b < nbuckets; b++) {
+      u32 s = S.sz[b];
+      if (s < 2) continue;
+      total_multi++;
+      u32 base = S.off[b];
+      u32 w = 0;
+      u32 i = 0;
+      for (; i + 1 < s; i += 2) {
+        u32 p = base + i, q = base + i + 1;
+        if (Ops::eq(S.ix[p], S.ix[q])) {
+          if (Ops::eq(S.iy[p], S.iy[q])) {
+            if (Ops::is_zero(S.iy[p])) continue;         // 2-torsion: 2P = ∞
+            S.sel_dbl[m] = 1;
+            Ops::add(S.denom[m], S.iy[p], S.iy[p]);      // 2y
+          } else {
+            continue;                                    // P + (−P) = ∞
+          }
+        } else {
+          S.sel_dbl[m] = 0;
+          Ops::sub(S.denom[m], S.ix[q], S.ix[p]);        // x2 − x1
+        }
+        S.sel_p[m] = p; S.sel_q[m] = q; S.sel_tgt[m] = base + w;
+        w++; m++;
+      }
+      // odd survivor's pending move rides in cnt (srv target = base + w)
+      S.cnt[b] = (i < s) ? (w + 1) : w;  // new size if the round commits
+      S.pos[b] = (i < s) ? 1 : 0;        // survivor flag
+    }
+    if (m == 0) break;
+    if (m < BATCH_MIN) {
+      // too few pairs to amortize the shared inversion: fold every
+      // multi-item bucket's UNTOUCHED items through a Jacobian shadow
+      for (int b = 0; b < nbuckets; b++) {
+        u32 s = S.sz[b];
+        if (s < 2) continue;
+        u32 base = S.off[b];
+        S.jshadow[b] = pt_infinity<Ops>();
+        S.jstate[b] = 1;
+        for (u32 i = 0; i < s; i++)
+          pt_add_affine(S.jshadow[b], S.jshadow[b], S.ix[base + i],
+                        S.iy[base + i]);
+        S.sz[b] = 0;
+      }
+      break;
+    }
+    (void)total_multi;
+    // one shared inversion for the whole round
+    S.prefix[0] = Ops::one();
+    for (size_t t = 0; t < m; t++)
+      Ops::mul(S.prefix[t + 1], S.prefix[t], S.denom[t]);
+    F invall;
+    Ops::inv(invall, S.prefix[m]);
+    for (size_t t = m; t-- > 0;) {
+      F dinv, lam, t1, x3, y3;
+      Ops::mul(dinv, S.prefix[t], invall);
+      Ops::mul(invall, invall, S.denom[t]);
+      u32 p = S.sel_p[t], q = S.sel_q[t];
+      if (S.sel_dbl[t]) {
+        Ops::sqr(t1, S.ix[p]);
+        F t2;
+        Ops::add(t2, t1, t1);
+        Ops::add(t1, t2, t1);                            // 3x²
+        Ops::mul(lam, t1, dinv);
+      } else {
+        Ops::sub(t1, S.iy[q], S.iy[p]);                  // y2 − y1
+        Ops::mul(lam, t1, dinv);
+      }
+      Ops::sqr(x3, lam);
+      Ops::sub(x3, x3, S.ix[p]);
+      Ops::sub(x3, x3, S.ix[q]);
+      Ops::sub(t1, S.ix[p], x3);
+      Ops::mul(y3, lam, t1);
+      Ops::sub(y3, y3, S.iy[p]);
+      S.rx[t] = x3;
+      S.ry[t] = y3;
+    }
+    // commit: scatter results, apply survivor moves, update sizes
+    // (targets never collide with unread sources: tgt <= p < q within a
+    // bucket, and every source was read into rx/ry above)
+    for (size_t t = 0; t < m; t++) {
+      S.ix[S.sel_tgt[t]] = S.rx[t];
+      S.iy[S.sel_tgt[t]] = S.ry[t];
+    }
+    for (int b = 0; b < nbuckets; b++) {
+      u32 s = S.sz[b];
+      if (s < 2) continue;
+      u32 base = S.off[b];
+      u32 w = S.cnt[b];
+      if (S.pos[b]) {  // odd survivor: slot s-1 -> compacted tail slot
+        S.ix[base + w - 1] = S.ix[base + s - 1];
+        S.iy[base + w - 1] = S.iy[base + s - 1];
+      }
+      S.sz[b] = w;
+    }
+  }
+  // bucket reduction
+  Point<Ops> running = pt_infinity<Ops>(), acc = pt_infinity<Ops>();
+  for (int b = nbuckets - 1; b >= 0; b--) {
+    if (S.sz[b]) pt_add_affine(running, running, S.ix[S.off[b]], S.iy[S.off[b]]);
+    if (S.jstate[b]) pt_add(running, running, S.jshadow[b]);
+    pt_add(acc, acc, running);
+  }
+  acc_out = acc;
+}
+
+// signed window digits for one scalar: d in (-2^(c-1), 2^(c-1)], one
+// spill window absorbing the final carry
+static void msm_signed_digits(int16_t* out, const u64* scalar, int c,
+                              int windows) {
+  const int half = 1 << (c - 1);
+  int carry = 0;
+  for (int win = 0; win < windows; win++) {
+    int v = scalar_window(scalar, 4, win * c, c) + carry;
+    if (v > half) {
+      out[win] = (int16_t)(v - (1 << c));
+      carry = 1;
+    } else {
+      out[win] = (int16_t)v;
+      carry = 0;
+    }
+  }
+}
+
 template <class Ops>
 static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
                                 const typename Ops::F* ys,
@@ -4088,161 +4287,136 @@ static void pt_msm_batch_affine(Point<Ops>& out, const typename Ops::F* xs,
   typedef typename Ops::F F;
   if (n == 0) { out = pt_infinity<Ops>(); return; }
   int c = msm_window_bits(n);
-  // SIGNED digits d in (-2^(c-1), 2^(c-1)]: negating an affine point is
-  // free (flip y), so half the buckets cover the same window — the
-  // bucket reduction (the other half of Pippenger's cost) halves with
-  // it. One spill window absorbs the final carry.
-  const int half = 1 << (c - 1);
-  int nbuckets = half;
+  // SIGNED digits: negating an affine point is free (flip y), so half
+  // the buckets cover the same window — the bucket reduction (the other
+  // half of Pippenger's cost) halves with it.
   int windows = (scalar_bits + c - 1) / c + 1;
   int16_t* digs = new int16_t[n * (size_t)windows];
-  for (size_t k = 0; k < n; k++) {
-    int carry = 0;
-    for (int win = 0; win < windows; win++) {
-      int v = scalar_window(scalars + 4 * k, 4, win * c, c) + carry;
-      if (v > half) {
-        digs[k * windows + win] = (int16_t)(v - (1 << c));
-        carry = 1;
-      } else {
-        digs[k * windows + win] = (int16_t)v;
-        carry = 0;
-      }
-    }
-  }
+  for (size_t k = 0; k < n; k++)
+    msm_signed_digits(digs + k * windows, scalars + 4 * k, c, windows);
   // negated y per point, picked by digit sign at zero per-use cost
   F* nys = new F[n];
   for (size_t k = 0; k < n; k++) Ops::neg(nys[k], ys[k]);
-  // below this many pending adds, one shared EEA inversion no longer
-  // beats plain Jacobian mixed adds
-  const size_t BATCH_MIN = 16;
-  F* bx = new F[nbuckets];
-  F* by = new F[nbuckets];
-  char* bstate = new char[nbuckets];   // 0 = empty, 1 = live
-  char* busy = new char[nbuckets];
-  Point<Ops>* jshadow = new Point<Ops>[nbuckets];  // straggler overflow
-  char* jstate = new char[nbuckets];
-  size_t* pend_b = new size_t[n];
-  size_t* pend_k = new size_t[n];
-  char* pend_s = new char[n];
-  size_t* nxt_b = new size_t[n];
-  size_t* nxt_k = new size_t[n];
-  char* nxt_s = new char[n];
-  size_t* sel_b = new size_t[n];
-  size_t* sel_k = new size_t[n];
-  char* sel_s = new char[n];
-  char* sel_dbl = new char[n];
-  F* denom = new F[n];
-  F* prefix = new F[n + 1];
-
+  u32* e_k = new u32[n];
+  int16_t* e_d = new int16_t[n];
+  MsmScratch<Ops> S(1 << (c - 1), n);
   Point<Ops> result = pt_infinity<Ops>();
   for (int win = windows - 1; win >= 0; win--) {
     for (int i = 0; i < c; i++) pt_double(result, result);
-    for (int b = 0; b < nbuckets; b++) { bstate[b] = 0; jstate[b] = 0; }
-    size_t pending = 0;
+    size_t ne = 0;
     for (size_t k = 0; k < n; k++) {
-      int d = digs[k * windows + win];
-      if (!d) continue;
-      char s = d < 0;
-      size_t b = size_t((s ? -d : d) - 1);
-      if (!bstate[b]) {
-        bx[b] = xs[k]; by[b] = (s ? nys : ys)[k]; bstate[b] = 1;
-      } else {
-        pend_b[pending] = b; pend_k[pending] = k; pend_s[pending] = s;
-        pending++;
-      }
+      int16_t d = digs[k * windows + win];
+      if (d) { e_k[ne] = (u32)k; e_d[ne] = d; ne++; }
     }
-    while (pending >= BATCH_MIN) {
-      for (int b = 0; b < nbuckets; b++) busy[b] = 0;
-      size_t m = 0, rest = 0;
-      for (size_t t = 0; t < pending; t++) {
-        size_t b = pend_b[t], k = pend_k[t];
-        char s = pend_s[t];
-        const F& yk = (s ? nys : ys)[k];
-        if (!bstate[b]) {  // bucket annihilated earlier this window
-          bx[b] = xs[k]; by[b] = yk; bstate[b] = 1;
-          continue;
-        }
-        if (busy[b]) {
-          nxt_b[rest] = b; nxt_k[rest] = k; nxt_s[rest] = s; rest++;
-          continue;
-        }
-        busy[b] = 1;
-        // classify: general add, doubling, or annihilation
-        if (Ops::eq(bx[b], xs[k])) {
-          if (Ops::eq(by[b], yk)) {
-            if (Ops::is_zero(by[b])) { bstate[b] = 0; continue; }  // 2P = ∞
-            sel_dbl[m] = 1;
-            Ops::add(denom[m], by[b], by[b]);            // 2y
-          } else {
-            bstate[b] = 0;                               // P + (−P) = ∞
-            continue;
-          }
-        } else {
-          sel_dbl[m] = 0;
-          Ops::sub(denom[m], xs[k], bx[b]);              // x2 − x1
-        }
-        sel_b[m] = b; sel_k[m] = k; sel_s[m] = s; m++;
-      }
-      // one shared inversion for every selected add
-      if (m) {
-        prefix[0] = Ops::one();
-        for (size_t t = 0; t < m; t++)
-          Ops::mul(prefix[t + 1], prefix[t], denom[t]);
-        F invall;
-        Ops::inv(invall, prefix[m]);
-        for (size_t t = m; t-- > 0;) {
-          F dinv, lam, t1, x3, y3;
-          Ops::mul(dinv, prefix[t], invall);             // 1/denom[t]
-          Ops::mul(invall, invall, denom[t]);
-          size_t b = sel_b[t], k = sel_k[t];
-          const F& yk = (sel_s[t] ? nys : ys)[k];
-          if (sel_dbl[t]) {
-            Ops::sqr(t1, bx[b]);                         // 3x²
-            F t2;
-            Ops::add(t2, t1, t1);
-            Ops::add(t1, t2, t1);
-            Ops::mul(lam, t1, dinv);
-          } else {
-            Ops::sub(t1, yk, by[b]);                     // y2 − y1
-            Ops::mul(lam, t1, dinv);
-          }
-          Ops::sqr(x3, lam);
-          Ops::sub(x3, x3, bx[b]);
-          Ops::sub(x3, x3, xs[k]);
-          Ops::sub(t1, bx[b], x3);
-          Ops::mul(y3, lam, t1);
-          Ops::sub(y3, y3, by[b]);
-          bx[b] = x3; by[b] = y3;
-        }
-      }
-      std::memcpy(pend_b, nxt_b, rest * sizeof(size_t));
-      std::memcpy(pend_k, nxt_k, rest * sizeof(size_t));
-      std::memcpy(pend_s, nxt_s, rest * sizeof(char));
-      pending = rest;
-    }
-    // stragglers: cheap Jacobian mixed adds into per-bucket shadows
-    for (size_t t = 0; t < pending; t++) {
-      size_t b = pend_b[t], k = pend_k[t];
-      if (!jstate[b]) { jshadow[b] = pt_infinity<Ops>(); jstate[b] = 1; }
-      pt_add_affine(jshadow[b], jshadow[b], xs[k],
-                    (pend_s[t] ? nys : ys)[k]);
-    }
-    Point<Ops> running = pt_infinity<Ops>(), acc = pt_infinity<Ops>();
-    for (int b = nbuckets - 1; b >= 0; b--) {
-      if (bstate[b]) pt_add_affine(running, running, bx[b], by[b]);
-      if (jstate[b]) pt_add(running, running, jshadow[b]);
-      pt_add(acc, acc, running);
-    }
+    Point<Ops> acc;
+    msm_bucket_pass<Ops>(acc, xs, ys, nys, e_k, e_d, ne, S);
     pt_add(result, result, acc);
   }
-  delete[] digs; delete[] nys;
-  delete[] bx; delete[] by; delete[] bstate; delete[] busy;
-  delete[] jshadow; delete[] jstate;
-  delete[] pend_b; delete[] pend_k; delete[] pend_s;
-  delete[] nxt_b; delete[] nxt_k; delete[] nxt_s;
-  delete[] sel_b; delete[] sel_k; delete[] sel_s; delete[] sel_dbl;
-  delete[] denom; delete[] prefix;
+  delete[] digs; delete[] nys; delete[] e_k; delete[] e_d;
   out = result;
+}
+
+// ---------------------------------------------------------------------------
+// Fixed-base prepared MSM: when the base points are static (the KZG
+// Lagrange setup — kzg.rs wraps c-kzg over the same fixed ceremony),
+// precompute each point's window shifts P_k * 2^(c*win) once so every
+// later MSM is a SINGLE signed-digit bucket pass: the per-window bucket
+// reductions (half of Pippenger's cost) collapse into one, and the
+// window count stops constraining the bucket width.
+// ---------------------------------------------------------------------------
+
+template <class Ops>
+struct MsmPrepared {
+  typedef typename Ops::F F;
+  size_t n;
+  int c, windows;
+  F* xs;    // entry (k, win) = point k shifted by 2^(c*win), affine x
+  F* ys;
+  F* nys;
+  char* inf;  // infinity entries contribute nothing and are skipped
+  ~MsmPrepared() { delete[] xs; delete[] ys; delete[] nys; delete[] inf; }
+};
+
+static inline void msm_inv_batch(Fp* vals, int n) { fp_inv_batch(vals, n); }
+static inline void msm_inv_batch(Fp2* vals, int n) { fp2_inv_batch(vals, n); }
+
+template <class Ops>
+static MsmPrepared<Ops>* msm_prepare(const Point<Ops>* pts, size_t n, int c) {
+  typedef typename Ops::F F;
+  const int windows = (256 + c - 1) / c + 1;
+  const size_t total = n * (size_t)windows;
+  MsmPrepared<Ops>* h = new MsmPrepared<Ops>;
+  h->n = n;
+  h->c = c;
+  h->windows = windows;
+  h->xs = new F[total];
+  h->ys = new F[total];
+  h->nys = new F[total];
+  h->inf = new char[total];
+  Point<Ops>* jac = new Point<Ops>[total];
+  for (size_t k = 0; k < n; k++) {
+    Point<Ops> p = pts[k];
+    for (int win = 0; win < windows; win++) {
+      jac[k * windows + win] = p;
+      if (win + 1 < windows)
+        for (int i = 0; i < c; i++) pt_double(p, p);
+    }
+  }
+  // batch-normalize to affine: chunks of shared inversions
+  const size_t CH = 64;
+  F zs[CH];
+  for (size_t base = 0; base < total; base += CH) {
+    size_t m = total - base < CH ? total - base : CH;
+    for (size_t t = 0; t < m; t++) {
+      h->inf[base + t] = jac[base + t].is_inf();
+      zs[t] = h->inf[base + t] ? Ops::one() : jac[base + t].z;
+    }
+    // F == Fp or Fp2: route through the matching batch inverter
+    msm_inv_batch(zs, (int)m);
+    for (size_t t = 0; t < m; t++) {
+      if (h->inf[base + t]) {
+        h->xs[base + t] = Ops::zero();
+        h->ys[base + t] = Ops::zero();
+        h->nys[base + t] = Ops::zero();
+        continue;
+      }
+      F zi2, zi3;
+      Ops::sqr(zi2, zs[t]);
+      Ops::mul(zi3, zi2, zs[t]);
+      Ops::mul(h->xs[base + t], jac[base + t].x, zi2);
+      Ops::mul(h->ys[base + t], jac[base + t].y, zi3);
+      Ops::neg(h->nys[base + t], h->ys[base + t]);
+    }
+  }
+  delete[] jac;
+  return h;
+}
+
+template <class Ops>
+static void msm_prepared_run(Point<Ops>& out, const MsmPrepared<Ops>* h,
+                             const u64* scalars) {
+  const size_t n = h->n;
+  const int c = h->c, windows = h->windows;
+  int16_t* digs = new int16_t[(size_t)windows];
+  u32* e_k = new u32[n * (size_t)windows];
+  int16_t* e_d = new int16_t[n * (size_t)windows];
+  size_t ne = 0;
+  for (size_t k = 0; k < n; k++) {
+    msm_signed_digits(digs, scalars + 4 * k, c, windows);
+    for (int win = 0; win < windows; win++) {
+      size_t idx = k * (size_t)windows + win;
+      if (digs[win] && !h->inf[idx]) {
+        e_k[ne] = (u32)idx;
+        e_d[ne] = digs[win];
+        ne++;
+      }
+    }
+  }
+  MsmScratch<Ops> S(1 << (c - 1), ne ? ne : 1);
+  msm_bucket_pass<Ops>(out, h->xs, h->ys, h->nys, e_k, e_d, ne, S);
+  delete[] digs;
+  delete[] e_k;
+  delete[] e_d;
 }
 
 // ---------------------------------------------------------------------------
@@ -4794,6 +4968,44 @@ int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
   }
   g1_compress(out48, acc);
   return 0;
+}
+
+// Prepared fixed-base G1 MSM over static points (the KZG Lagrange
+// setup): precompute window shifts once, then every MSM is a single
+// signed-digit bucket pass. The handle owns native-side Montgomery
+// arrays; the caller frees it with ec_g1_msm_prepared_free.
+void* ec_g1_msm_prepare(const u8* points_raw, size_t n, int window_bits) {
+  ensure_init();
+  if (n == 0 || window_bits < 2 || window_bits > 15) return nullptr;
+  G1* pts = new G1[n];
+  for (size_t i = 0; i < n; i++) {
+    if (!g1_from_raw(pts[i], points_raw + 96 * i, 0)) {
+      delete[] pts;
+      return nullptr;
+    }
+  }
+  MsmPrepared<FpOps>* h = msm_prepare<FpOps>(pts, n, window_bits);
+  delete[] pts;
+  return h;
+}
+
+int ec_g1_msm_prepared_run(void* handle, const u8* scalars32, size_t n,
+                           u8* out_raw, int* out_inf) {
+  ensure_init();
+  MsmPrepared<FpOps>* h = (MsmPrepared<FpOps>*)handle;
+  if (!h || h->n != n) return -1;
+  u64* sc = new u64[4 * n];
+  for (size_t i = 0; i < n; i++) scalar_from_be32(sc + 4 * i, scalars32 + 32 * i);
+  G1 r;
+  msm_prepared_run<FpOps>(r, h, sc);
+  delete[] sc;
+  *out_inf = r.is_inf() ? 1 : 0;
+  g1_to_raw(out_raw, r);
+  return 0;
+}
+
+void ec_g1_msm_prepared_free(void* handle) {
+  delete (MsmPrepared<FpOps>*)handle;
 }
 
 // Bulk G1 decompression: n compressed keys -> n (rc, raw96, is_inf)
