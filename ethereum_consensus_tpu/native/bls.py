@@ -122,6 +122,8 @@ def _declare(lib) -> None:
         "ec_g2_subgroup_check_raw": ([p8], i32),
         "ec_pairing_product_is_one_raw": ([p8, p8, p8, p8, sz], i32),
         "ec_g1_decompress_batch": ([p8, sz, p8, c.POINTER(i32), c.POINTER(i32), i32], i32),
+        "ec_fr_eval_poly": ([p8, p8, sz, p8, p8], i32),
+        "ec_fr_eval_and_quotient": ([p8, p8, sz, p8, p8, p8], i32),
         "ec_g1_msm_prepare": ([p8, sz, i32], c.c_void_p),
         "ec_g1_msm_prepared_run": ([c.c_void_p, p8, sz, p8, c.POINTER(i32)], i32),
         "ec_g1_msm_prepared_free": ([c.c_void_p], None),
@@ -489,3 +491,28 @@ class PreparedMsm:
         if handle and _LIB is not None:
             _LIB.ec_g1_msm_prepared_free(handle)
             self._handle = None
+
+
+def fr_eval_poly(evals32: bytes, roots32: bytes, n: int, z32: bytes) -> bytes:
+    """Barycentric blob-polynomial evaluation at z over the brp domain
+    (EIP-4844); raises on non-canonical input or unsupported domain."""
+    y = _c.create_string_buffer(32)
+    rc = _lib().ec_fr_eval_poly(bytes(evals32), bytes(roots32), n, bytes(z32), y)
+    if rc != 0:
+        raise NativeBlsError(f"fr_eval_poly rc={rc}")
+    return y.raw
+
+
+def fr_eval_and_quotient(
+    evals32: bytes, roots32: bytes, n: int, z32: bytes
+) -> "tuple[bytes, bytes]":
+    """(y, quotient-evals) for the KZG proof at z — both branches of the
+    quotient construction (on-domain L'Hopital column and off-domain)."""
+    y = _c.create_string_buffer(32)
+    q = _c.create_string_buffer(32 * n)
+    rc = _lib().ec_fr_eval_and_quotient(
+        bytes(evals32), bytes(roots32), n, bytes(z32), y, q
+    )
+    if rc != 0:
+        raise NativeBlsError(f"fr_eval_and_quotient rc={rc}")
+    return y.raw, q.raw

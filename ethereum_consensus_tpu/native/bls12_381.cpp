@@ -4420,6 +4420,251 @@ static void msm_prepared_run(Point<Ops>& out, const MsmPrepared<Ops>* h,
 }
 
 // ---------------------------------------------------------------------------
+// Fr: the scalar field (4x64 Montgomery) — barycentric blob-polynomial
+// evaluation and quotient construction, the EIP-4844 math of kzg.py's
+// _evaluate_polynomial_in_evaluation_form / _compute_kzg_proof_impl
+// (the role c-kzg's C polynomial code plays for crypto/kzg.rs). The
+// Python big-int implementation stays as the cross-checked fallback.
+// ---------------------------------------------------------------------------
+
+struct Fr { u64 l[4]; };
+
+static u64 FR_NINV;   // -r^{-1} mod 2^64
+static Fr FR_R2;      // 2^512 mod r (canonical limbs)
+static Fr FR_ONE;     // Montgomery 1
+static bool FR_READY = false;
+
+static inline bool fr_is_zero(const Fr& a) {
+  return !(a.l[0] | a.l[1] | a.l[2] | a.l[3]);
+}
+static inline bool fr_eq(const Fr& a, const Fr& b) {
+  return a.l[0] == b.l[0] && a.l[1] == b.l[1] && a.l[2] == b.l[2] &&
+         a.l[3] == b.l[3];
+}
+static inline int fr_cmp_raw(const u64* a, const u64* b) {
+  for (int i = 3; i >= 0; i--) {
+    if (a[i] < b[i]) return -1;
+    if (a[i] > b[i]) return 1;
+  }
+  return 0;
+}
+static void fr_add(Fr& o, const Fr& a, const Fr& b) {
+  u64 carry = 0;
+  for (int i = 0; i < 4; i++) o.l[i] = adc(a.l[i], b.l[i], carry);
+  if (carry || fr_cmp_raw(o.l, R_RAW) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < 4; i++) o.l[i] = sbb(o.l[i], R_RAW[i], borrow);
+  }
+}
+static void fr_sub(Fr& o, const Fr& a, const Fr& b) {
+  u64 borrow = 0;
+  for (int i = 0; i < 4; i++) o.l[i] = sbb(a.l[i], b.l[i], borrow);
+  if (borrow) {
+    u64 carry = 0;
+    for (int i = 0; i < 4; i++) o.l[i] = adc(o.l[i], R_RAW[i], carry);
+  }
+}
+// CIOS Montgomery product, 4x64 (the scalar-field twin of fp_mul)
+static void fr_mul(Fr& o, const Fr& a, const Fr& b) {
+  u64 t[5] = {0, 0, 0, 0, 0};
+  for (int i = 0; i < 4; i++) {
+    u64 carry = 0, lo, hi;
+    for (int j = 0; j < 4; j++) {
+      madd2(a.l[j], b.l[i], t[j], carry, hi, lo);
+      t[j] = lo;
+      carry = hi;
+    }
+    u64 t4 = t[4] + carry;
+    u64 m = t[0] * FR_NINV;
+    madd1(m, R_RAW[0], t[0], hi, lo);
+    carry = hi;
+    for (int j = 1; j < 4; j++) {
+      madd2(m, R_RAW[j], t[j], carry, hi, lo);
+      t[j - 1] = lo;
+      carry = hi;
+    }
+    u64 c2 = 0;
+    t[3] = adc(t4, carry, c2);
+    t[4] = c2;
+  }
+  for (int i = 0; i < 4; i++) o.l[i] = t[i];
+  if (t[4] || fr_cmp_raw(o.l, R_RAW) >= 0) {
+    u64 borrow = 0;
+    for (int i = 0; i < 4; i++) o.l[i] = sbb(o.l[i], R_RAW[i], borrow);
+  }
+}
+static void fr_to_mont(Fr& o, const Fr& std_form) { fr_mul(o, std_form, FR_R2); }
+static void fr_from_mont(Fr& o, const Fr& mont) {
+  Fr one_std = {{1, 0, 0, 0}};
+  fr_mul(o, mont, one_std);
+}
+static void fr_pow(Fr& out, const Fr& base, const u64* exp) {
+  Fr result = FR_ONE;
+  bool started = false;
+  for (int bit = 255; bit >= 0; bit--) {
+    if (started) fr_mul(result, result, result);
+    if ((exp[bit >> 6] >> (bit & 63)) & 1) {
+      if (started) fr_mul(result, result, base);
+      else { result = base; started = true; }
+    }
+  }
+  out = started ? result : FR_ONE;
+}
+static void fr_inv(Fr& out, const Fr& a) {
+  u64 exp[4];
+  u64 borrow = 0;
+  exp[0] = sbb(R_RAW[0], 2, borrow);
+  for (int i = 1; i < 4; i++) exp[i] = sbb(R_RAW[i], 0, borrow);
+  fr_pow(out, a, exp);  // a^(r-2)
+}
+static void fr_batch_inv(Fr* vals, size_t n) {
+  if (n == 0) return;
+  Fr* pre = new Fr[n + 1];
+  pre[0] = FR_ONE;
+  for (size_t i = 0; i < n; i++) fr_mul(pre[i + 1], pre[i], vals[i]);
+  Fr inv;
+  fr_inv(inv, pre[n]);
+  for (size_t i = n; i-- > 0;) {
+    Fr v;
+    fr_mul(v, inv, pre[i]);
+    fr_mul(inv, inv, vals[i]);
+    vals[i] = v;
+  }
+  delete[] pre;
+}
+static bool fr_from_bytes(Fr& o, const u8 in[32]) {
+  Fr s;
+  for (int i = 0; i < 4; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w = (w << 8) | in[i * 8 + j];
+    s.l[3 - i] = w;
+  }
+  if (fr_cmp_raw(s.l, R_RAW) >= 0) return false;
+  fr_to_mont(o, s);
+  return true;
+}
+static void fr_to_bytes(u8 out[32], const Fr& mont) {
+  Fr s;
+  fr_from_mont(s, mont);
+  for (int i = 0; i < 4; i++) {
+    u64 w = s.l[3 - i];
+    for (int j = 7; j >= 0; j--) { out[i * 8 + j] = (u8)w; w >>= 8; }
+  }
+}
+static void fr_ensure_init() {
+  if (FR_READY) return;
+  u64 inv = 1;
+  for (int i = 0; i < 6; i++) inv *= 2 - R_RAW[0] * inv;
+  FR_NINV = (u64)0 - inv;
+  Fr acc = {{1, 0, 0, 0}};
+  for (int i = 0; i < 512; i++) fr_add(acc, acc, acc);
+  FR_R2 = acc;
+  Fr one_std = {{1, 0, 0, 0}};
+  fr_mul(FR_ONE, one_std, FR_R2);
+  FR_READY = true;
+}
+
+// Barycentric evaluation + (optionally) the quotient polynomial, shared
+// scaffolding: p(z) = (z^n - 1)/n * sum_i e_i w_i / (z - w_i), with the
+// in-domain short-circuit, and q(X) = (p(X) - y)/(X - z) in evaluation
+// form (both branches of _compute_kzg_proof_impl).
+static int fr_eval_quotient(const u8* evals32, const u8* roots32, size_t n,
+                            const u8* z32, u8* y32, u8* q32 /* or null */) {
+  fr_ensure_init();
+  if (n == 0 || (n & (n - 1)) != 0) return -2;  // z^n below squares up
+  Fr z;
+  if (!fr_from_bytes(z, z32)) return -1;
+  Fr* evals = new Fr[n];
+  Fr* roots = new Fr[n];
+  for (size_t i = 0; i < n; i++) {
+    if (!fr_from_bytes(evals[i], evals32 + 32 * i) ||
+        !fr_from_bytes(roots[i], roots32 + 32 * i)) {
+      delete[] evals;
+      delete[] roots;
+      return -1;
+    }
+  }
+  long m = -1;  // in-domain index
+  for (size_t i = 0; i < n; i++)
+    if (fr_eq(z, roots[i])) { m = (long)i; break; }
+  Fr y;
+  Fr* work = new Fr[n];
+  if (m >= 0) {
+    y = evals[m];
+  } else {
+    for (size_t i = 0; i < n; i++) fr_sub(work[i], z, roots[i]);
+    fr_batch_inv(work, n);  // 1/(z - w_i)
+    Fr total = {{0, 0, 0, 0}};
+    for (size_t i = 0; i < n; i++) {
+      Fr t;
+      fr_mul(t, evals[i], roots[i]);
+      fr_mul(t, t, work[i]);
+      fr_add(total, total, t);
+    }
+    // zn1 = z^n - 1, n_inv = 1/n
+    Fr zn = z;
+    size_t nn = n;
+    // n is a power of two for every preset; square up
+    while (nn > 1) { fr_mul(zn, zn, zn); nn >>= 1; }
+    Fr zn1;
+    fr_sub(zn1, zn, FR_ONE);
+    Fr n_fr = {{0, 0, 0, 0}}, n_std = {{(u64)n, 0, 0, 0}};
+    fr_to_mont(n_fr, n_std);
+    Fr n_inv;
+    fr_inv(n_inv, n_fr);
+    fr_mul(y, total, zn1);
+    fr_mul(y, y, n_inv);
+  }
+  fr_to_bytes(y32, y);
+  int rc = 0;
+  if (q32) {
+    if (m >= 0) {
+      // z on the domain: the L'Hopital-style special column
+      Fr* inv_wz = new Fr[n];
+      Fr* inv_zzw = new Fr[n];
+      for (size_t i = 0; i < n; i++) {
+        if ((long)i == m) { inv_wz[i] = FR_ONE; inv_zzw[i] = FR_ONE; continue; }
+        fr_sub(inv_wz[i], roots[i], z);
+        Fr t;
+        fr_sub(t, z, roots[i]);
+        fr_mul(inv_zzw[i], z, t);
+      }
+      fr_batch_inv(inv_wz, n);
+      fr_batch_inv(inv_zzw, n);
+      Fr acc = {{0, 0, 0, 0}};
+      for (size_t i = 0; i < n; i++) {
+        if ((long)i == m) continue;
+        Fr d, q;
+        fr_sub(d, evals[i], y);
+        fr_mul(q, d, inv_wz[i]);
+        fr_to_bytes(q32 + 32 * i, q);
+        Fr t;
+        fr_mul(t, d, roots[i]);
+        fr_mul(t, t, inv_zzw[i]);
+        fr_add(acc, acc, t);
+      }
+      fr_to_bytes(q32 + 32 * (size_t)m, acc);
+      delete[] inv_wz;
+      delete[] inv_zzw;
+    } else {
+      // work[i] already holds 1/(z - w_i); 1/(w_i - z) = -that
+      for (size_t i = 0; i < n; i++) {
+        Fr d, neg, q;
+        fr_sub(d, evals[i], y);
+        Fr zero = {{0, 0, 0, 0}};
+        fr_sub(neg, zero, work[i]);
+        fr_mul(q, d, neg);
+        fr_to_bytes(q32 + 32 * i, q);
+      }
+    }
+  }
+  delete[] evals;
+  delete[] roots;
+  delete[] work;
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
 // raw affine IO (standard-form big-endian coordinates)
 // g1 raw: x || y (96 bytes); g2 raw: x.c0 || x.c1 || y.c0 || y.c1 (192)
 // ---------------------------------------------------------------------------
@@ -4968,6 +5213,21 @@ int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
   }
   g1_compress(out48, acc);
   return 0;
+}
+
+// Barycentric evaluation of a blob polynomial (evaluation form over the
+// brp domain) at z; y32 gets the canonical 32-byte result. rc: 0 ok,
+// -1 non-canonical input, -2 unsupported domain size.
+int ec_fr_eval_poly(const u8* evals32, const u8* roots32, size_t n,
+                    const u8* z32, u8* y32) {
+  return fr_eval_quotient(evals32, roots32, n, z32, y32, nullptr);
+}
+
+// Same, plus the quotient polynomial q(X) = (p(X) - y)/(X - z) in
+// evaluation form (both the on-domain and off-domain branches).
+int ec_fr_eval_and_quotient(const u8* evals32, const u8* roots32, size_t n,
+                            const u8* z32, u8* y32, u8* q32) {
+  return fr_eval_quotient(evals32, roots32, n, z32, y32, q32);
 }
 
 // Prepared fixed-base G1 MSM over static points (the KZG Lagrange
