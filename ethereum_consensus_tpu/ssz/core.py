@@ -875,14 +875,29 @@ class Container(metaclass=_ContainerMeta):
         identical so the root is identical, and any later field write on
         either object invalidates its own cache (__setattr__). Without
         this, copying a state forced a full registry rehash — ~0.9s of
-        the mainnet block benchmark."""
-        out = {}
-        for key, typ in type(self).__ssz_fields__.items():
-            out[key] = _copy_value(typ, getattr(self, key))
-        new = type(self)(**out)
-        cached = self.__dict__.get("_htr_cache")
-        if cached is not None:
-            new.__dict__["_htr_cache"] = cached
+        the mainnet block benchmark.
+
+        Builds via __new__ + a dict update rather than the validating
+        constructor: every value comes from an already-constructed
+        container, so re-wrapping and field checks would only re-spend
+        what __init__ already paid (state copies dominated the mainnet
+        block benchmark before this). Scalars (ints, bytes, bools) are
+        immutable and shared; lists and nested containers are copied."""
+        cls = type(self)
+        new = cls.__new__(cls)
+        nd = new.__dict__
+        nd.update(self.__dict__)
+        for key, typ in cls.__ssz_fields__.items():
+            v = nd[key]
+            tv = v.__class__
+            if tv is int or tv is bytes or tv is bool:
+                continue
+            if tv is CachedRootList or tv is list:
+                nd[key] = _copy_value(typ, v)
+            elif isinstance(v, Container):
+                nd[key] = v.copy()
+            # any other value kind is immutable by SSZ construction and
+            # shares, exactly like the validating-constructor path did
         return new
 
     # -- SSZType protocol (classmethods) ------------------------------------
@@ -1040,7 +1055,11 @@ def _copy_value(typ: SSZType, value: Any):
     if isinstance(value, list):
         elem = getattr(typ, "elem", None)
         if elem is not None and not _is_basic(elem):
-            copied = CachedRootList(_copy_value(elem, v) for v in value)
+            # SSZ lists are homogeneous: one dispatch covers every element
+            if value and isinstance(value[0], Container):
+                copied = CachedRootList(v.copy() for v in value)
+            else:
+                copied = CachedRootList(_copy_value(elem, v) for v in value)
         else:
             copied = CachedRootList(value)
         # identical values ⇒ identical roots: the cache (only ever
