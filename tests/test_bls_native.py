@@ -369,3 +369,47 @@ class TestBatchPhasesSoundness:
                        for _ in range(n_sets)]
             got = nb.batch_verify_raw(sets, dst, scalars)
             assert got == all(per_set_ok), (trial, per_set_ok, got)
+
+
+class TestPreparedMsmAndFr:
+    """Edge semantics of the fixed-base MSM handle and the native Fr
+    barycentric helpers."""
+
+    def test_prepared_msm_matches_plain(self):
+        import secrets
+
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        gen = nb.g1_generator_raw()
+        pts = []
+        for i in range(40):
+            raw, _ = nb.g1_mul_raw(gen, False, (i * 31 + 5).to_bytes(32, "big"))
+            pts.append(raw)
+        scal = b"".join(secrets.token_bytes(31).rjust(32, b"\0") for _ in range(40))
+        want, winf = nb.g1_msm(b"".join(pts), scal, 40)
+        pre = nb.PreparedMsm(b"".join(pts), 40, window_bits=6)
+        got, ginf = pre.run(scal)
+        assert (got, ginf) == (want, winf)
+
+    def test_prepared_msm_rejects_wrong_length(self):
+        import secrets
+
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        gen = nb.g1_generator_raw()
+        pre = nb.PreparedMsm(gen, 1, window_bits=4)
+        ok, _ = pre.run(secrets.token_bytes(31).rjust(32, b"\0"))
+        assert len(ok) == 96
+
+    def test_fr_eval_rejects_non_canonical(self):
+        from ethereum_consensus_tpu.native import bls as nb
+
+        if not nb.available():
+            pytest.skip("native backend unavailable")
+        bad = b"\xff" * 32  # >= r
+        with pytest.raises(nb.NativeBlsError):
+            nb.fr_eval_poly(bad, bad, 1, b"\x00" * 32)
