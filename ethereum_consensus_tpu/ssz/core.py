@@ -423,11 +423,18 @@ class CachedRootList(list):
     through (spec code always mutates via ``state.field[...]``, which is
     instrumented)."""
 
-    __slots__ = ("_root_cache",)
+    __slots__ = ("_root_cache", "_pack_memo")
 
     def __init__(self, *args):
         super().__init__(*args)
         self._root_cache: dict = {}
+        # (key, packed_bytes, root) of the last merkleization, exempt
+        # from mutation invalidation: correctness comes from comparing
+        # the EXACT packed bytes on reuse, so a stale entry can only
+        # miss, never lie. Turns the single-slot-write-per-block pattern
+        # on big vectors (randao_mixes, block_roots, state_roots) into a
+        # C-speed memcmp instead of a full tree rebuild.
+        self._pack_memo: "tuple | None" = None
 
     def _invalidate(self):
         self._root_cache.clear()
@@ -484,6 +491,22 @@ def _cacheable_values(elem: SSZType, values: list) -> bool:
     return True
 
 
+def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
+    """merkleize_chunks with a mutation-surviving (packed, root) memo on
+    CachedRootList inputs: reuse requires the exact same packed bytes
+    (C-speed compare), so staleness can only cost a miss, never a wrong
+    root. One changed slot in a big vector then costs a memcmp + rebuild
+    once, and every unchanged re-hash after it is join + memcmp."""
+    if isinstance(values, CachedRootList):
+        memo = values._pack_memo
+        if memo is not None and memo[0] == key and memo[1] == packed:
+            return memo[2]
+        root = merkleize_chunks(packed, limit=limit)
+        values._pack_memo = (key, packed, root)
+        return root
+    return merkleize_chunks(packed, limit=limit)
+
+
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
         if (
@@ -508,7 +531,7 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         else:
             packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
         limit = (limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
-        return merkleize_chunks(packed, limit=limit)
+        return _merkleize_packed_memo(values, ("u", elem, limit), packed, limit)
     if isinstance(elem, ByteVector) and elem.length == BYTES_PER_CHUNK:
         # a 32-byte vector's root IS its bytes — and the validation runs
         # at C speed (join rejects non-bytes with TypeError; the len-set
@@ -536,7 +559,9 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             if chunks is not None and len(chunks) == BYTES_PER_CHUNK * len(
                 values
             ):
-                return merkleize_chunks(chunks, limit=limit_elems)
+                return _merkleize_packed_memo(
+                    values, ("b32", elem, limit_elems), chunks, limit_elems
+                )
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
     if isinstance(values, CachedRootList):
         # container-element lists (the validator registry) can't cache a
@@ -1067,6 +1092,7 @@ def _copy_value(typ: SSZType, value: Any):
         # copy; mutations on either side clear their own
         if isinstance(value, CachedRootList):
             copied._root_cache = dict(value._root_cache)
+            copied._pack_memo = value._pack_memo  # immutable tuple: shared
         return copied
     return value
 

@@ -9,6 +9,7 @@ spec-tests/runners/light_client.rs:10-13).
 
 from __future__ import annotations
 
+from . import hash as _hash_mod
 from .hash import hash_bytes, hash_level, hash_pair
 
 __all__ = [
@@ -82,6 +83,15 @@ def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
     if count == 0:
         return zero_hash(depth)
 
+    # medium-to-large flat trees: one native call walks every level
+    # (the per-level Python loop pays a join + two ctypes copies per
+    # level — ~3x the hash cost at randao_mixes size). Trees big enough
+    # that a level would route to the DEVICE hasher keep the loop.
+    if 64 <= count < 2 * _hash_mod.DEVICE_MIN_NODES:
+        root = _native_tree_root(chunks, depth)
+        if root is not None:
+            return root
+
     nodes = chunks
     for level in range(depth):
         n = len(nodes) // BYTES_PER_CHUNK
@@ -89,6 +99,25 @@ def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
             nodes = nodes + zero_hash(level)
         nodes = hash_level(nodes)
     return nodes
+
+
+_ZH_JOINED: dict = {}
+
+
+def _native_tree_root(chunks: bytes, depth: int) -> "bytes | None":
+    """Whole-tree reduction in one native call (ec_merkle_root), or None
+    when the native backend is unavailable."""
+    try:
+        from .. import native
+    except Exception:  # noqa: BLE001 — no toolchain: python loop
+        return None
+    if not native.available():
+        return None
+    zh = _ZH_JOINED.get(depth)
+    if zh is None:
+        zh = b"".join(zero_hash(level) for level in range(depth + 1))
+        _ZH_JOINED[depth] = zh
+    return native.merkle_root_native(chunks, depth, zh)
 
 
 def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
