@@ -2984,7 +2984,7 @@ EC_FP8_TARGET static __mmask8 g2x8_in_subgroup_mask(const G2x8& p,
   const __mmask8 linf = fp2x8_is_zero_mask(l.z);
   const __mmask8 rinf = fp2x8_is_zero_mask(r.z);
   exc |= (__mmask8)(linf | rinf);
-  Fp2x8 z1z1, z2z2, a, b, t, z1c, z2c;
+  Fp2x8 z1z1, z2z2, a, b, z1c, z2c;
   fp2x8_sqr(z1z1, l.z);
   fp2x8_sqr(z2z2, r.z);
   fp2x8_mul(a, l.x, z2z2);
@@ -4155,11 +4155,9 @@ static void msm_bucket_pass(Point<Ops>& acc_out, const typename Ops::F* xs,
     // phase 1 — selection only (no item mutation, so a too-small round
     // can abort cleanly): pairs, per-bucket survivor moves, new sizes
     size_t m = 0;
-    size_t total_multi = 0;
     for (int b = 0; b < nbuckets; b++) {
       u32 s = S.sz[b];
       if (s < 2) continue;
-      total_multi++;
       u32 base = S.off[b];
       u32 w = 0;
       u32 i = 0;
@@ -4201,7 +4199,6 @@ static void msm_bucket_pass(Point<Ops>& acc_out, const typename Ops::F* xs,
       }
       break;
     }
-    (void)total_multi;
     // one shared inversion for the whole round
     S.prefix[0] = Ops::one();
     for (size_t t = 0; t < m; t++)
