@@ -5401,10 +5401,20 @@ int ec_bls_batch_verify_raw(size_t n_sets, const u32* pk_counts,
   }
   // phase 3: hash-to-G2, SSWU sqrt chains batched eight-wide
   if (ok) ok = hash_to_g2_batch(qs, msgs, msg_lens, n_sets, dst, dst_len);
-  // phase 4: blinded-signature MSM + shared multi-pairing
+  // phase 4: blinded-signature MSM + shared multi-pairing. Decompressed
+  // signatures are affine (z = 1, infinity already rejected), so the
+  // signed-digit batch-affine Pippenger applies directly.
   if (ok) {
     G2 sig_acc;
-    pt_msm(sig_acc, sig_pts, sig_scalars, n_sets, 128);
+    Fp2* sxs = new Fp2[n_sets];
+    Fp2* sys = new Fp2[n_sets];
+    for (size_t i = 0; i < n_sets; i++) {
+      sxs[i] = sig_pts[i].x;
+      sys[i] = sig_pts[i].y;
+    }
+    pt_msm_batch_affine<Fp2Ops>(sig_acc, sxs, sys, sig_scalars, n_sets, 128);
+    delete[] sxs;
+    delete[] sys;
     pt_neg(ps[n_sets], G1_GEN);
     qs[n_sets] = sig_acc;
     ok = pairing_product_is_one(ps, qs, n_sets + 1);
