@@ -4182,10 +4182,14 @@ static void msm_bucket_pass(Point<Ops>& acc_out, const typename Ops::F* xs,
       S.cnt[b] = (i < s) ? (w + 1) : w;  // new size if the round commits
       S.pos[b] = (i < s) ? 1 : 0;        // survivor flag
     }
-    if (m == 0) break;
     if (m < BATCH_MIN) {
-      // too few pairs to amortize the shared inversion: fold every
-      // multi-item bucket's UNTOUCHED items through a Jacobian shadow
+      // Too few pairs to amortize the shared inversion — including the
+      // m == 0 case where every pair ANNIHILATED (a bucket can still
+      // hold >= 2 items then; treating its first item as the bucket
+      // value would drop the cancellation). Fold every multi-item
+      // bucket's UNTOUCHED items through a guarded Jacobian shadow and
+      // stop; a round with no pairs and no multi-item buckets folds
+      // nothing and just terminates.
       for (int b = 0; b < nbuckets; b++) {
         u32 s = S.sz[b];
         if (s < 2) continue;

@@ -1,0 +1,114 @@
+"""Randomized soak of the native engine's batched paths (not collected
+by pytest — run directly: ``python tests/soak_native.py [seconds]``).
+
+Families, each cross-checked against a scalar/serial oracle:
+  * fp8 selftest sweeps (mul/add/sub/sqrt/hash/decompress/Miller/sums)
+  * RLC batch verdicts vs per-set fast_aggregate_verify on random
+    valid/invalid mixes with random set sizes and duplicate keys
+  * G1 MSM vs serial sum of individual scalar mults (random sizes,
+    duplicate points, repeated and zero scalars)
+  * bulk G1/G2 decompression vs scalar decompression on mutated bytes
+"""
+
+import random
+import secrets
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ethereum_consensus_tpu.native import bls as nb
+
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def soak(seconds: float) -> None:
+    assert nb.available(), "native backend required"
+    rng = random.Random(secrets.randbits(64))
+    sks = [int.to_bytes(7_000 + i, 32, "big") for i in range(48)]
+    pks = [nb.sk_to_pk(sk) for sk in sks]
+    raws = [nb.g1_decompress(pk, check_subgroup=False)[1] for pk in pks]
+    gen = nb.g1_generator_raw()
+    deadline = time.monotonic() + seconds
+    iters = 0
+    while time.monotonic() < deadline:
+        iters += 1
+        seed = rng.getrandbits(63)
+        rc = nb.fp8_selftest(seed=seed, rounds=3)
+        assert rc == 0, f"fp8 selftest family {rc} (seed {seed})"
+
+        # batch verdict == AND of per-set verdicts
+        n_sets = rng.randrange(1, 40)
+        sets, per_ok = [], []
+        for i in range(n_sets):
+            k = rng.randrange(1, 5)
+            idxs = [rng.randrange(len(sks)) for _ in range(k)]
+            msg = secrets.token_bytes(rng.choice([8, 32, 55]))
+            _, agg = nb.aggregate_signatures([nb.sign(sks[j], msg, DST) for j in idxs])
+            if rng.random() < 0.25:
+                if rng.random() < 0.5:
+                    msg = secrets.token_bytes(32)
+                else:
+                    agg = nb.sign(sks[0], b"x" * 9, DST)
+            sets.append(([raws[j] for j in idxs], msg, agg))
+            per_ok.append(
+                nb.fast_aggregate_verify_raw(
+                    [raws[j] for j in idxs], msg, agg, DST, assume_valid=False
+                )
+                == 1
+            )
+        scal = [int.to_bytes(rng.getrandbits(128) | 1, 16, "big") for _ in range(n_sets)]
+        got = nb.batch_verify_raw(sets, DST, scal)
+        assert got == all(per_ok), (per_ok, got)
+
+        # MSM vs serial (duplicates, zero and repeated scalars)
+        n = rng.randrange(1, 70)
+        pts = []
+        for _ in range(n):
+            if pts and rng.random() < 0.3:
+                pts.append(rng.choice(pts))
+            else:
+                r, _ = nb.g1_mul_raw(gen, False, secrets.token_bytes(30).rjust(32, b"\0"))
+                pts.append(r)
+        scs = []
+        for _ in range(n):
+            roll = rng.random()
+            if roll < 0.1:
+                scs.append(b"\0" * 32)
+            elif scs and roll < 0.3:
+                scs.append(scs[-1])
+            else:
+                scs.append(secrets.token_bytes(rng.choice([16, 31])).rjust(32, b"\0"))
+        got_raw, got_inf = nb.g1_msm(b"".join(pts), b"".join(scs), n)
+        acc, acc_inf = None, True
+        for p, s in zip(pts, scs):
+            if s == b"\0" * 32:
+                continue
+            m, minf = nb.g1_mul_raw(p, False, s)
+            if acc_inf:
+                acc, acc_inf = m, minf
+            else:
+                acc, acc_inf = nb.g1_add_raw(acc, acc_inf, m, minf)
+        if acc_inf:
+            assert got_inf, "msm: expected infinity"
+        else:
+            assert not got_inf and got_raw == acc, "msm mismatch"
+
+        # bulk decompression == scalar decompression on mutated inputs
+        keys = [bytearray(rng.choice(pks)) for _ in range(rng.randrange(1, 20))]
+        for kb in keys:
+            if rng.random() < 0.3:
+                kb[rng.randrange(48)] ^= 1 << rng.randrange(8)
+        keys = [bytes(k) for k in keys]
+        for (rc1, raw1, inf1), key in zip(
+            nb.g1_decompress_batch(keys, check_subgroup=True), keys
+        ):
+            rc2, raw2, inf2 = nb.g1_decompress(key, check_subgroup=True)
+            assert rc1 == rc2 and (rc1 != 0 or (raw1 == raw2 and inf1 == inf2))
+        if iters % 10 == 0:
+            print(f"  {iters} iterations, {deadline - time.monotonic():.0f}s left")
+    print(f"soak clean: {iters} iterations")
+
+
+if __name__ == "__main__":
+    soak(float(sys.argv[1]) if len(sys.argv) > 1 else 60.0)

@@ -413,3 +413,31 @@ class TestPreparedMsmAndFr:
         bad = b"\xff" * 32  # >= r
         with pytest.raises(nb.NativeBlsError):
             nb.fr_eval_poly(bad, bad, 1, b"\x00" * 32)
+
+
+def test_msm_same_point_annihilating_digits():
+    """Regression: a pairing-tree round whose pairs ALL annihilate (same
+    point under opposite signed digits — reachable with duplicated MSM
+    inputs at small sizes) must still cancel the bucket instead of
+    leaking its first item. Caught by tests/soak_native.py."""
+    import random
+
+    from ethereum_consensus_tpu.native import bls as nb
+
+    if not nb.available():
+        pytest.skip("native backend unavailable")
+    gen = nb.g1_generator_raw()
+    p, _ = nb.g1_mul_raw(gen, False, (424242).to_bytes(32, "big"))
+    rng = random.Random(10)
+    for n in (2, 3, 8, 16):
+        pts = [p] * n
+        scs = [rng.randbytes(31).rjust(32, b"\0") for _ in range(n)]
+        got, got_inf = nb.g1_msm(b"".join(pts), b"".join(scs), n)
+        acc, acc_inf = None, True
+        for pt, s in zip(pts, scs):
+            m, mi = nb.g1_mul_raw(pt, False, s)
+            if acc_inf:
+                acc, acc_inf = m, mi
+            else:
+                acc, acc_inf = nb.g1_add_raw(acc, acc_inf, m, mi)
+        assert got_inf == acc_inf and (got_inf or got == acc), n
