@@ -504,17 +504,52 @@ def _decompress_or_kzg_error(data: bytes, what: str) -> tuple[bytes, bool]:
 # ---------------------------------------------------------------------------
 
 
+def _check_blob(blob: bytes, settings: KzgSettings) -> bytes:
+    """Length + canonicality gate for the native blob-direct fast paths
+    (blob bytes ARE the evaluation-form scalars — no int round-trip)."""
+    blob = bytes(blob)
+    expected = settings.n * BYTES_PER_FIELD_ELEMENT
+    if len(blob) != expected:
+        raise KzgError(f"blob must be {expected} bytes, got {len(blob)}")
+    if not native_bls.fr_validate(blob, settings.n):
+        raise KzgError("field element not canonical")
+    return blob
+
+
 def blob_to_kzg_commitment(blob: bytes, settings: KzgSettings) -> KzgCommitment:
+    if _native_on():
+        return KzgCommitment(_setup_lincomb_raw(settings, _check_blob(blob, settings)))
     evals = _blob_to_polynomial(blob, settings)
     return KzgCommitment(_setup_lincomb(settings, evals))
 
 
 def compute_kzg_proof(blob: bytes, z_bytes: bytes, settings: KzgSettings) -> tuple[KzgProof, bytes]:
     """Returns (proof, y_bytes) for evaluation at z (kzg.rs:71)."""
-    evals = _blob_to_polynomial(blob, settings)
     z = _fr_from_bytes(z_bytes)
+    if _native_on():
+        blob_proof = _compute_kzg_proof_from_blob(blob, z, settings)
+        if blob_proof is not None:
+            proof, y_b = blob_proof
+            return proof, y_b
+    evals = _blob_to_polynomial(blob, settings)
     proof, y = _compute_kzg_proof_impl(evals, z, settings)
     return proof, _fr_to_bytes(y)
+
+
+def _compute_kzg_proof_from_blob(
+    blob: bytes, z: int, settings: KzgSettings
+) -> "tuple[KzgProof, bytes] | None":
+    """Native blob-direct proof: the quotient scalars come back in MSM
+    wire layout, untouched by Python ints. None = fall back (e.g. a
+    non-power-of-two custom domain)."""
+    blob = _check_blob(blob, settings)
+    try:
+        y_b, q_b = native_bls.fr_eval_and_quotient(
+            blob, _roots_raw(settings), settings.n, (z % R).to_bytes(32, "big")
+        )
+    except native_bls.NativeBlsError:
+        return None
+    return KzgProof(_setup_lincomb_raw(settings, q_b)), y_b
 
 
 def _compute_kzg_proof_impl(
@@ -629,18 +664,37 @@ def compute_blob_kzg_proof(
             G1Point.deserialize(bytes(commitment))  # validate before transcript
         except InvalidPointError as exc:
             raise KzgError(f"invalid commitment: {exc}") from exc
-    evals = _blob_to_polynomial(blob, settings)
     z = _compute_challenge(blob, commitment, settings)
+    if _native_on():
+        blob_proof = _compute_kzg_proof_from_blob(blob, z, settings)
+        if blob_proof is not None:
+            return blob_proof[0]
+    evals = _blob_to_polynomial(blob, settings)
     proof, _ = _compute_kzg_proof_impl(evals, z, settings)
     return proof
+
+
+def _evaluate_blob_at(blob: bytes, z: int, settings: KzgSettings) -> int:
+    """p(z) from the raw blob bytes: native blob-direct when available,
+    Python int path otherwise (identical semantics and errors)."""
+    if _native_on():
+        try:
+            y = native_bls.fr_eval_poly(
+                _check_blob(blob, settings), _roots_raw(settings),
+                settings.n, (z % R).to_bytes(32, "big"),
+            )
+            return int.from_bytes(y, "big")
+        except native_bls.NativeBlsError:
+            pass  # non-power-of-two custom domain
+    evals = _blob_to_polynomial(blob, settings)
+    return _evaluate_polynomial_in_evaluation_form(evals, z, settings)
 
 
 def verify_blob_kzg_proof(
     blob: bytes, commitment: bytes, proof: bytes, settings: KzgSettings
 ) -> bool:
-    evals = _blob_to_polynomial(blob, settings)
     z = _compute_challenge(blob, commitment, settings)
-    y = _evaluate_polynomial_in_evaluation_form(evals, z, settings)
+    y = _evaluate_blob_at(blob, z, settings)
     return _verify_kzg_proof_bytes(bytes(commitment), z, y, bytes(proof), settings)
 
 
@@ -672,10 +726,9 @@ def verify_blob_kzg_proof_batch(
 
     zs, ys = [], []
     for blob, commitment in zip(blobs, commitments):
-        evals = _blob_to_polynomial(blob, settings)
         z = _compute_challenge(blob, commitment, settings)
         zs.append(z)
-        ys.append(_evaluate_polynomial_in_evaluation_form(evals, z, settings))
+        ys.append(_evaluate_blob_at(blob, z, settings))
 
     # r-powers from a transcript binding every (commitment, z, y, proof)
     data = RANDOM_CHALLENGE_KZG_BATCH_DOMAIN

@@ -122,6 +122,7 @@ def _declare(lib) -> None:
         "ec_g2_subgroup_check_raw": ([p8], i32),
         "ec_pairing_product_is_one_raw": ([p8, p8, p8, p8, sz], i32),
         "ec_g1_decompress_batch": ([p8, sz, p8, c.POINTER(i32), c.POINTER(i32), i32], i32),
+        "ec_fr_validate": ([p8, sz], i32),
         "ec_fr_eval_poly": ([p8, p8, sz, p8, p8], i32),
         "ec_fr_eval_and_quotient": ([p8, p8, sz, p8, p8, p8], i32),
         "ec_g1_msm_prepare": ([p8, sz, i32], c.c_void_p),
@@ -516,3 +517,8 @@ def fr_eval_and_quotient(
     if rc != 0:
         raise NativeBlsError(f"fr_eval_and_quotient rc={rc}")
     return y.raw, q.raw
+
+
+def fr_validate(evals32: bytes, n: int) -> bool:
+    """True when every 32-byte big-endian scalar is canonical (< r)."""
+    return _lib().ec_fr_validate(bytes(evals32), n) == 0

@@ -5216,6 +5216,22 @@ int ec_bls_aggregate_pubkeys(const u8* pks, size_t n, u8* out48) {
   return 0;
 }
 
+// Canonicality scan: every 32-byte big-endian scalar must be < r.
+// 0 ok, -1 the first non-canonical element's complaint.
+int ec_fr_validate(const u8* evals32, size_t n) {
+  for (size_t i = 0; i < n; i++) {
+    const u8* in = evals32 + 32 * i;
+    u64 s[4];
+    for (int k = 0; k < 4; k++) {
+      u64 w = 0;
+      for (int j = 0; j < 8; j++) w = (w << 8) | in[k * 8 + j];
+      s[3 - k] = w;
+    }
+    if (fr_cmp_raw(s, R_RAW) >= 0) return -1;
+  }
+  return 0;
+}
+
 // Barycentric evaluation of a blob polynomial (evaluation form over the
 // brp domain) at z; y32 gets the canonical 32-byte result. rc: 0 ok,
 // -1 non-canonical input, -2 unsupported domain size.
