@@ -1,0 +1,262 @@
+"""Every conformance runner executes against a synthesized vector.
+
+The harness's discovery/codec and several runners are covered in
+test_spec_harness.py; this module closes the loop on the REST of the 15
+runners (sanity/blocks, epoch_processing, finality, random, fork,
+genesis initialization+validity, transition, bls, merkle_proof,
+light_client), so "runner exists" always comes with "runner has run".
+Vectors are synthesized from this implementation (the official tarballs
+need network egress — spec_tests/download_vectors.py + SPEC_TEST_ROOT
+plug the real corpus into the same code paths).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import chain_utils  # noqa: E402
+
+from ethereum_consensus_tpu.config import Context  # noqa: E402
+from ethereum_consensus_tpu.crypto import bls as bls_crypto  # noqa: E402
+from ethereum_consensus_tpu.models import altair, phase0  # noqa: E402
+from ethereum_consensus_tpu.ssz import core as ssz_core  # noqa: E402
+from ethereum_consensus_tpu.utils import snappy  # noqa: E402
+from spec_tests import run_all  # noqa: E402
+
+
+def _write(root: Path, parts, files):
+    case_dir = root.joinpath("tests", *parts)
+    case_dir.mkdir(parents=True)
+    for name, content in files.items():
+        path = case_dir / name
+        if name.endswith(".ssz_snappy"):
+            path.write_bytes(snappy.compress(content))
+        else:
+            path.write_text(content)
+
+
+def test_every_remaining_runner_executes(tmp_path):
+    state, ctx = chain_utils.fresh_genesis(16, "minimal")
+    ns = phase0.build(ctx.preset)
+
+    # sanity/blocks: one real signed block
+    pre = state.copy()
+    block = chain_utils.produce_block(pre.copy(), 2, ctx)
+    post = pre.copy()
+    from ethereum_consensus_tpu.models.phase0.state_transition import (
+        state_transition,
+    )
+
+    state_transition(post, block, ctx)
+    _write(
+        tmp_path,
+        ("minimal", "phase0", "sanity", "blocks", "pyspec_tests", "one_block"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+            "post.ssz_snappy": ns.BeaconState.serialize(post),
+            "blocks_0.ssz_snappy": ns.SignedBeaconBlock.serialize(block),
+            "meta.yaml": "blocks_count: 1\n",
+        },
+    )
+
+    # finality + random reuse the blocks shape through their own runners
+    for runner, handler in (("finality", "finality"), ("random", "random")):
+        _write(
+            tmp_path,
+            ("minimal", "phase0", runner, handler, "pyspec_tests", "case_0"),
+            {
+                "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+                "post.ssz_snappy": ns.BeaconState.serialize(post),
+                "blocks_0.ssz_snappy": ns.SignedBeaconBlock.serialize(block),
+                "meta.yaml": "blocks_count: 1\n",
+            },
+        )
+
+    # epoch_processing/justification_and_finalization
+    ep_pre = post.copy()
+    from ethereum_consensus_tpu.models.phase0.epoch_processing import (
+        process_justification_and_finalization,
+    )
+
+    ep_post = ep_pre.copy()
+    process_justification_and_finalization(ep_post, ctx)
+    _write(
+        tmp_path,
+        ("minimal", "phase0", "epoch_processing",
+         "justification_and_finalization", "pyspec_tests", "case_0"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(ep_pre),
+            "post.ssz_snappy": ns.BeaconState.serialize(ep_post),
+        },
+    )
+
+    # fork: phase0 -> altair upgrade
+    alt_ns = altair.build(ctx.preset)
+    upgraded = altair.upgrade_to_altair(pre.copy(), ctx)
+    _write(
+        tmp_path,
+        ("minimal", "altair", "fork", "fork", "pyspec_tests", "fork_base"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(pre),
+            "post.ssz_snappy": alt_ns.BeaconState.serialize(upgraded),
+            "meta.yaml": "post_fork: altair\nfork_epoch: 0\n",
+        },
+    )
+
+    # genesis: validity + initialization (4 real deposits). The expected
+    # verdict is computed, not assumed: a 16-validator state is below
+    # minimal's MIN_GENESIS_ACTIVE_VALIDATOR_COUNT, so this exercises the
+    # negative verdict arm.
+    from ethereum_consensus_tpu.models.phase0.genesis import (
+        is_valid_genesis_state,
+    )
+
+    verdict = "true" if is_valid_genesis_state(state, ctx) else "false"
+    _write(
+        tmp_path,
+        ("minimal", "phase0", "genesis", "validity", "pyspec_tests", "valid"),
+        {
+            "genesis.ssz_snappy": ns.BeaconState.serialize(state),
+            "is_valid.yaml": f"{verdict}\n",
+        },
+    )
+    deposits = chain_utils.make_deposits(4, ctx)
+    from ethereum_consensus_tpu.models.phase0.genesis import (
+        initialize_beacon_state_from_eth1,
+    )
+
+    genesis_state = initialize_beacon_state_from_eth1(
+        chain_utils.ETH1_BLOCK_HASH, chain_utils.ETH1_TIMESTAMP, deposits, ctx
+    )
+    _write(
+        tmp_path,
+        ("minimal", "phase0", "genesis", "initialization", "pyspec_tests",
+         "four_deposits"),
+        {
+            "eth1.yaml": (
+                f"eth1_block_hash: '0x{chain_utils.ETH1_BLOCK_HASH.hex()}'\n"
+                f"eth1_timestamp: {chain_utils.ETH1_TIMESTAMP}\n"
+            ),
+            "meta.yaml": "deposits_count: 4\n",
+            "state.ssz_snappy": ns.BeaconState.serialize(genesis_state),
+            **{
+                f"deposits_{i}.ssz_snappy": ns.Deposit.serialize(d)
+                for i, d in enumerate(deposits)
+            },
+        },
+    )
+
+    # bls: verify (both verdicts) + aggregate
+    sk = bls_crypto.SecretKey(0x1234)
+    pk = sk.public_key().to_bytes().hex()
+    msg = b"\x0a" * 32
+    sig = sk.sign(msg).to_bytes().hex()
+    _write(
+        tmp_path,
+        ("general", "phase0", "bls", "verify", "bls", "verify_valid"),
+        {
+            "data.yaml": (
+                "input:\n"
+                f"  pubkey: '0x{pk}'\n"
+                f"  message: '0x{msg.hex()}'\n"
+                f"  signature: '0x{sig}'\n"
+                "output: true\n"
+            )
+        },
+    )
+    agg = bls_crypto.aggregate(
+        [bls_crypto.SecretKey(i + 1).sign(msg) for i in range(3)]
+    )
+    sig_list = "".join(
+        f"- '0x{bls_crypto.SecretKey(i + 1).sign(msg).to_bytes().hex()}'\n"
+        for i in range(3)
+    )
+    _write(
+        tmp_path,
+        ("general", "phase0", "bls", "aggregate", "bls", "aggregate_0"),
+        {
+            "data.yaml": (
+                "input:\n"
+                + sig_list.replace("- ", "- ").replace("\n- ", "\n- ")
+                + f"output: '0x{agg.to_bytes().hex()}'\n"
+            )
+        },
+    )
+
+    # merkle_proof + light_client: prove field 0 of BeaconBlockBody; its
+    # generalized index is tree_width + 0
+    body = block.message.body
+    from ethereum_consensus_tpu.ssz.merkle import (
+        get_generalized_index_length,
+        next_pow_of_two,
+    )
+
+    fields = type(body).__ssz_fields__
+    gindex = next_pow_of_two(len(fields))  # leaf of field 0
+    branch = ssz_core.prove(type(body), body, gindex)
+    first_field_name = next(iter(fields))
+    first_field_type = fields[first_field_name]
+    leaf = first_field_type.hash_tree_root(getattr(body, first_field_name))
+    proof_yaml = (
+        f"leaf: '0x{leaf.hex()}'\n"
+        f"leaf_index: {gindex}\n"
+        "branch:\n"
+        + "".join(f"- '0x{b.hex()}'\n" for b in branch)
+    )
+    for runner in ("merkle_proof", "light_client"):
+        _write(
+            tmp_path,
+            ("minimal", "phase0", runner, "single_merkle_proof",
+             "BeaconBlockBody", "proof_0"),
+            {
+                "object.ssz_snappy": type(body).serialize(body),
+                "proof.yaml": proof_yaml,
+            },
+        )
+    assert get_generalized_index_length(gindex) == len(branch)
+
+    # transition: one altair block applied across the phase0->altair fork
+    tctx = Context.for_minimal()
+    slots_per_epoch = int(tctx.SLOTS_PER_EPOCH)
+    for name in ("altair", "bellatrix", "capella", "deneb", "electra"):
+        setattr(tctx, f"{name}_fork_epoch", 2**64 - 1)
+    tctx.altair_fork_epoch = 1
+    t_pre, _ = chain_utils.fresh_genesis(16, "minimal")
+    from ethereum_consensus_tpu.models.phase0.slot_processing import (
+        process_slots as p0_slots,
+    )
+
+    scratch = t_pre.copy()
+    p0_slots(scratch, slots_per_epoch, tctx)
+    up = altair.upgrade_to_altair(scratch, tctx)
+    t_block = chain_utils.produce_block_altair(
+        up.copy(), slots_per_epoch + 1, tctx
+    )
+    from ethereum_consensus_tpu.executor import Executor
+    from ethereum_consensus_tpu.types import BeaconState as PolyState
+
+    executor = Executor(PolyState.wrap(t_pre.copy(), tctx.preset), tctx)
+    executor.apply_block(t_block)
+    _write(
+        tmp_path,
+        ("minimal", "altair", "transition", "core", "pyspec_tests",
+         "one_fork_block"),
+        {
+            "pre.ssz_snappy": ns.BeaconState.serialize(t_pre),
+            "post.ssz_snappy": alt_ns.BeaconState.serialize(
+                executor.state.data
+            ),
+            "blocks_0.ssz_snappy": alt_ns.SignedBeaconBlock.serialize(t_block),
+            "meta.yaml": (
+                "post_fork: altair\nfork_epoch: 1\nblocks_count: 1\n"
+            ),
+        },
+    )
+
+    results = run_all(str(tmp_path))
+    assert results["fail"] == 0, results["failures"]
+    # every vector above must actually PASS (none skipped/ignored)
+    assert results["pass"] == 12, results
