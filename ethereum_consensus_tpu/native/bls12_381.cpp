@@ -687,6 +687,8 @@ static u64 P52[8];        // p, radix-2^52 limbs
 static u64 P52_INV;       // -p^{-1} mod 2^52
 static u64 R52SQ_52[8];   // 2^832 mod p (canonical radix-52): to-Montgomery multiplier
 static u64 TWOINV_M52[8]; // 2^{-1} in R52-Montgomery form == 2^415 mod p
+static u64 X2_448_52[8];  // 2^448 mod p: scalar-Montgomery -> R52-Montgomery
+static u64 X2_384_52[8];  // 2^384 mod p: R52-Montgomery -> scalar-Montgomery
 static const u64 MASK52 = (1ULL << 52) - 1;
 
 // 384-bit value: 6x64 canonical limbs <-> 8x52 canonical limbs
@@ -838,41 +840,36 @@ EC_FP8_TARGET static __mmask8 fp8_is_zero_mask(const Fp8& a) {
 }
 
 // scalar-Montgomery Fp lanes -> R52-Montgomery SoA vector (lanes >= n
-// replicate lane 0 so padding never contains surprise values)
+// replicate lane 0 so padding never contains surprise values). The
+// scalar-Montgomery LIMBS repack directly (a*2^384 as an integer) and
+// one vector multiply by 2^448 rebases them: a*2^384 * 2^448 * 2^-416 =
+// a*2^416 — no per-element scalar conversion.
 EC_FP8_TARGET static void fp8_load(Fp8& o, const Fp* in, int n) {
   u64 t[8][8];
-  for (int k = 0; k < 8; k++) {
-    Fp std_form;
-    fp_from_mont(std_form, in[k < n ? k : 0]);
-    limbs6_to_52(t[k], std_form.l);
-  }
+  for (int k = 0; k < 8; k++) limbs6_to_52(t[k], in[k < n ? k : 0].l);
   for (int j = 0; j < 8; j++)
     o.l[j] = _mm512_setr_epi64(
         (long long)t[0][j], (long long)t[1][j], (long long)t[2][j],
         (long long)t[3][j], (long long)t[4][j], (long long)t[5][j],
         (long long)t[6][j], (long long)t[7][j]);
-  Fp8 r2;
-  fp8_bcast(r2, R52SQ_52);
-  fp8_montmul(o, o, r2);  // x_std * 2^832 * 2^-416 = x * 2^416: to Montgomery
+  Fp8 c;
+  fp8_bcast(c, X2_448_52);
+  fp8_montmul(o, o, c);
 }
 
-// R52-Montgomery SoA vector -> scalar-Montgomery Fp lanes
+// R52-Montgomery SoA vector -> scalar-Montgomery Fp lanes: one vector
+// multiply by 2^384 (a*2^416 * 2^384 * 2^-416 = a*2^384), then repack.
 EC_FP8_TARGET static void fp8_store(Fp* out, const Fp8& a, int n) {
-  static const u64 ONE52[8] = {1, 0, 0, 0, 0, 0, 0, 0};
-  Fp8 onev, red;
-  fp8_bcast(onev, ONE52);
-  fp8_montmul(red, a, onev);  // from Montgomery: x * 2^-416 = canonical
+  Fp8 c, red;
+  fp8_bcast(c, X2_384_52);
+  fp8_montmul(red, a, c);
   u64 t[8][8];
   for (int j = 0; j < 8; j++) {
     alignas(64) u64 lane[8];
     _mm512_store_si512((__m512i*)lane, red.l[j]);
     for (int k = 0; k < 8; k++) t[k][j] = lane[k];
   }
-  for (int k = 0; k < n; k++) {
-    Fp std_form;
-    limbs52_to_6(std_form.l, t[k]);
-    fp_to_mont(out[k], std_form);
-  }
+  for (int k = 0; k < n; k++) limbs52_to_6(out[k].l, t[k]);
 }
 
 // shared-exponent windowed power (all lanes raise to the SAME public
@@ -1196,11 +1193,15 @@ static void fp8_engine_init() {
     return;
   limbs6_to_52(P52, P_RAW.l);
   P52_INV = FP_INV & MASK52;  // inverse mod 2^64 truncates to mod 2^52
-  // 2^832 mod p and 2^415 mod p by doubling (canonical limbs)
+  // powers of two mod p by doubling (canonical limbs)
   Fp acc = {{1, 0, 0, 0, 0, 0}};
-  for (int i = 0; i < 415; i++) fp_add(acc, acc, acc);
+  for (int i = 0; i < 384; i++) fp_add(acc, acc, acc);
+  limbs6_to_52(X2_384_52, acc.l);
+  for (int i = 384; i < 415; i++) fp_add(acc, acc, acc);
   limbs6_to_52(TWOINV_M52, acc.l);
-  for (int i = 415; i < 832; i++) fp_add(acc, acc, acc);
+  for (int i = 415; i < 448; i++) fp_add(acc, acc, acc);
+  limbs6_to_52(X2_448_52, acc.l);
+  for (int i = 448; i < 832; i++) fp_add(acc, acc, acc);
   limbs6_to_52(R52SQ_52, acc.l);
   FP8_READY = fp8_selfcheck();
 #endif
