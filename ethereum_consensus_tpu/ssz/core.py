@@ -423,7 +423,7 @@ class CachedRootList(list):
     through (spec code always mutates via ``state.field[...]``, which is
     instrumented)."""
 
-    __slots__ = ("_root_cache", "_pack_memo")
+    __slots__ = ("_root_cache", "_pack_memo", "_uniform_len")
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -435,6 +435,11 @@ class CachedRootList(list):
         # on big vectors (randao_mixes, block_roots, state_roots) into a
         # C-speed memcmp instead of a full tree rebuild.
         self._pack_memo: "tuple | None" = None
+        # every element is `bytes` of exactly this length — established
+        # by a full scan at hash time and MAINTAINED by the instrumented
+        # mutators (a write of anything else resets it to None), so big
+        # vectors stop re-paying per-element type/size scans per rehash
+        self._uniform_len: "int | None" = None
 
     def _invalidate(self):
         self._root_cache.clear()
@@ -446,9 +451,22 @@ class CachedRootList(list):
 
 def _instrument(name):
     base = getattr(list, name)
+    # single-element writers can keep the uniform-bytes verdict alive
+    # when the incoming value matches it; everything else resets it
+    value_pos = {"__setitem__": 1, "append": 0, "insert": 1}.get(name)
 
     def method(self, *args, **kwargs):
         self._root_cache.clear()
+        ulen = self._uniform_len
+        if ulen is not None:
+            keep = False
+            if value_pos is not None and len(args) > value_pos and not kwargs:
+                v = args[value_pos]
+                keep = type(v) is bytes and len(v) == ulen
+                if name == "__setitem__" and type(args[0]) is not int:
+                    keep = False  # slice assignment: arbitrary payload
+            if not keep:
+                self._uniform_len = None
         return base(self, *args, **kwargs)
 
     method.__name__ = name
@@ -487,6 +505,8 @@ def _cacheable_values(elem: SSZType, values: list) -> bool:
     may cache. Uint/boolean values are ints/bools (immutable) — their
     lists always qualify."""
     if isinstance(elem, ByteVector):
+        if getattr(values, "_uniform_len", None) is not None:
+            return True  # maintained by the instrumented mutators
         return all(type(v) is bytes for v in values)
     return True
 
@@ -547,10 +567,13 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         # length rejects sized buffer objects whose len() isn't their
         # byte size (array.array('I', …)/memoryview of wider items would
         # fool the len-set alone)
-        try:
-            sizes_ok = not values or set(map(len, values)) == {BYTES_PER_CHUNK}
-        except TypeError:  # un-sized element (e.g. int)
-            sizes_ok = False
+        if getattr(values, "_uniform_len", None) == BYTES_PER_CHUNK:
+            sizes_ok = True  # full scan done once; mutators maintain it
+        else:
+            try:
+                sizes_ok = not values or set(map(len, values)) == {BYTES_PER_CHUNK}
+            except TypeError:  # un-sized element (e.g. int)
+                sizes_ok = False
         if sizes_ok:
             try:
                 chunks = b"".join(values)
@@ -559,6 +582,16 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             if chunks is not None and len(chunks) == BYTES_PER_CHUNK * len(
                 values
             ):
+                if (
+                    values
+                    and isinstance(values, CachedRootList)
+                    and values._uniform_len is None
+                    and all(type(v) is bytes for v in values)
+                ):
+                    # the flag asserts type-is-bytes too (a bytearray
+                    # joins fine but can mutate in place), so it is only
+                    # set after one full type scan; mutators keep it
+                    values._uniform_len = BYTES_PER_CHUNK
                 return _merkleize_packed_memo(
                     values, ("b32", elem, limit_elems), chunks, limit_elems
                 )
@@ -1093,6 +1126,7 @@ def _copy_value(typ: SSZType, value: Any):
         if isinstance(value, CachedRootList):
             copied._root_cache = dict(value._root_cache)
             copied._pack_memo = value._pack_memo  # immutable tuple: shared
+            copied._uniform_len = value._uniform_len
         return copied
     return value
 

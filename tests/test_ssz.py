@@ -394,3 +394,40 @@ def test_scalar_leaf_root_cache_invalidation():
     r1 = WithNested.hash_tree_root(n)
     n.inner.a = 10  # aliased child mutation
     assert WithNested.hash_tree_root(n) != r1
+
+
+def test_uniform_len_flag_safety():
+    """The uniform-bytes verdict (skip of per-element scans on big
+    vectors) must reset on non-conforming writes and never engage for
+    in-place-mutable elements (bytearray)."""
+    from ethereum_consensus_tpu.ssz.core import Vector, ByteVector
+
+    V = Vector[ByteVector[32], 8]
+    vals = [bytes([i]) * 32 for i in range(8)]
+    v = V.default()
+    lst = type(v)  # noqa: F841 — descriptor type sanity
+    from ethereum_consensus_tpu.ssz.core import CachedRootList
+
+    data = CachedRootList(vals)
+    root1 = V.hash_tree_root(data)
+    assert data._uniform_len == 32
+    # conforming write keeps the flag; root tracks the change
+    data[3] = b"\xaa" * 32
+    assert data._uniform_len == 32
+    root2 = V.hash_tree_root(data)
+    assert root2 != root1
+    assert root2 == V.hash_tree_root(CachedRootList(list(data)))
+    # non-conforming write resets it and the next hash re-validates
+    data[3] = bytearray(b"\xbb" * 32)
+    assert data._uniform_len is None
+    root3 = V.hash_tree_root(data)
+    assert root3 == V.hash_tree_root(CachedRootList([bytes(x) for x in data]))
+    # a bytearray-containing list never sets the flag (it could mutate
+    # in place without notification)
+    assert data._uniform_len is None
+    # slice assignment resets too
+    data[3] = b"\xbb" * 32
+    V.hash_tree_root(data)
+    assert data._uniform_len == 32
+    data[2:4] = [b"\xcc" * 32, b"\xdd" * 32]
+    assert data._uniform_len is None
