@@ -423,7 +423,7 @@ class CachedRootList(list):
     through (spec code always mutates via ``state.field[...]``, which is
     instrumented)."""
 
-    __slots__ = ("_root_cache", "_pack_memo", "_uniform_len")
+    __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind")
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -435,11 +435,13 @@ class CachedRootList(list):
         # on big vectors (randao_mixes, block_roots, state_roots) into a
         # C-speed memcmp instead of a full tree rebuild.
         self._pack_memo: "tuple | None" = None
-        # every element is `bytes` of exactly this length — established
-        # by a full scan at hash time and MAINTAINED by the instrumented
-        # mutators (a write of anything else resets it to None), so big
-        # vectors stop re-paying per-element type/size scans per rehash
-        self._uniform_len: "int | None" = None
+        # uniformity verdict — ("bytes", L): every element is `bytes` of
+        # exactly length L; ("int",): every element is a plain int.
+        # Established by a full scan at hash time and MAINTAINED by the
+        # instrumented mutators (a write of anything else resets it), so
+        # big vectors/lists stop re-paying per-element type/size scans
+        # on every rehash. Stored as a tuple; None = unknown.
+        self._uniform_kind: "tuple | None" = None
 
     def _invalidate(self):
         self._root_cache.clear()
@@ -457,16 +459,19 @@ def _instrument(name):
 
     def method(self, *args, **kwargs):
         self._root_cache.clear()
-        ulen = self._uniform_len
-        if ulen is not None:
+        kind = self._uniform_kind
+        if kind is not None:
             keep = False
             if value_pos is not None and len(args) > value_pos and not kwargs:
                 v = args[value_pos]
-                keep = type(v) is bytes and len(v) == ulen
+                if kind[0] == "bytes":
+                    keep = type(v) is bytes and len(v) == kind[1]
+                else:  # ("int",)
+                    keep = type(v) is int
                 if name == "__setitem__" and type(args[0]) is not int:
                     keep = False  # slice assignment: arbitrary payload
             if not keep:
-                self._uniform_len = None
+                self._uniform_kind = None
         return base(self, *args, **kwargs)
 
     method.__name__ = name
@@ -505,7 +510,8 @@ def _cacheable_values(elem: SSZType, values: list) -> bool:
     may cache. Uint/boolean values are ints/bools (immutable) — their
     lists always qualify."""
     if isinstance(elem, ByteVector):
-        if getattr(values, "_uniform_len", None) is not None:
+        kind = getattr(values, "_uniform_kind", None)
+        if kind is not None and kind[0] == "bytes":
             return True  # maintained by the instrumented mutators
         return all(type(v) is bytes for v in values)
     return True
@@ -529,13 +535,13 @@ def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
 
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
-        if (
-            isinstance(elem, _UintType)
-            and elem.byte_length == 8
-            and values
-            and set(map(type, values)) == {int}  # C-speed scan; keeps
-            # serialize()'s bool/float rejections out of the numpy path
-        ):
+        all_int = getattr(values, "_uniform_kind", None) == ("int",)
+        if not all_int and values and set(map(type, values)) == {int}:
+            all_int = True  # C-speed scan; keeps serialize()'s
+            # bool/float rejections out of the numpy path
+            if isinstance(values, CachedRootList):
+                values._uniform_kind = ("int",)  # mutators maintain it
+        if isinstance(elem, _UintType) and elem.byte_length == 8 and all_int:
             # vectorized u64 packing (balances/inactivity lists dominate);
             # the explicit little-endian dtype matches serialize(), and
             # numpy's OverflowError fires exactly where serialize
@@ -567,7 +573,7 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         # length rejects sized buffer objects whose len() isn't their
         # byte size (array.array('I', …)/memoryview of wider items would
         # fool the len-set alone)
-        if getattr(values, "_uniform_len", None) == BYTES_PER_CHUNK:
+        if getattr(values, "_uniform_kind", None) == ("bytes", BYTES_PER_CHUNK):
             sizes_ok = True  # full scan done once; mutators maintain it
         else:
             try:
@@ -585,13 +591,13 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                 if (
                     values
                     and isinstance(values, CachedRootList)
-                    and values._uniform_len is None
+                    and values._uniform_kind is None
                     and all(type(v) is bytes for v in values)
                 ):
                     # the flag asserts type-is-bytes too (a bytearray
                     # joins fine but can mutate in place), so it is only
                     # set after one full type scan; mutators keep it
-                    values._uniform_len = BYTES_PER_CHUNK
+                    values._uniform_kind = ("bytes", BYTES_PER_CHUNK)
                 return _merkleize_packed_memo(
                     values, ("b32", elem, limit_elems), chunks, limit_elems
                 )
@@ -1126,7 +1132,7 @@ def _copy_value(typ: SSZType, value: Any):
         if isinstance(value, CachedRootList):
             copied._root_cache = dict(value._root_cache)
             copied._pack_memo = value._pack_memo  # immutable tuple: shared
-            copied._uniform_len = value._uniform_len
+            copied._uniform_kind = value._uniform_kind
         return copied
     return value
 

@@ -410,24 +410,24 @@ def test_uniform_len_flag_safety():
 
     data = CachedRootList(vals)
     root1 = V.hash_tree_root(data)
-    assert data._uniform_len == 32
+    assert data._uniform_kind == ("bytes", 32)
     # conforming write keeps the flag; root tracks the change
     data[3] = b"\xaa" * 32
-    assert data._uniform_len == 32
+    assert data._uniform_kind == ("bytes", 32)
     root2 = V.hash_tree_root(data)
     assert root2 != root1
     assert root2 == V.hash_tree_root(CachedRootList(list(data)))
     # non-conforming write resets it and the next hash re-validates
     data[3] = bytearray(b"\xbb" * 32)
-    assert data._uniform_len is None
+    assert data._uniform_kind is None
     root3 = V.hash_tree_root(data)
     assert root3 == V.hash_tree_root(CachedRootList([bytes(x) for x in data]))
     # a bytearray-containing list never sets the flag (it could mutate
     # in place without notification)
-    assert data._uniform_len is None
+    assert data._uniform_kind is None
     # slice assignment resets too
     data[3] = b"\xbb" * 32
     V.hash_tree_root(data)
-    assert data._uniform_len == 32
+    assert data._uniform_kind == ("bytes", 32)
     data[2:4] = [b"\xcc" * 32, b"\xdd" * 32]
-    assert data._uniform_len is None
+    assert data._uniform_kind is None
