@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from .hash import hash_level
 from .merkle import (
     BYTES_PER_CHUNK,
     merkleize_chunks,
@@ -518,19 +519,63 @@ def _cacheable_values(elem: SSZType, values: list) -> bool:
 
 
 def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
-    """merkleize_chunks with a mutation-surviving (packed, root) memo on
-    CachedRootList inputs: reuse requires the exact same packed bytes
-    (C-speed compare), so staleness can only cost a miss, never a wrong
-    root. One changed slot in a big vector then costs a memcmp + rebuild
-    once, and every unchanged re-hash after it is join + memcmp."""
-    if isinstance(values, CachedRootList):
-        memo = values._pack_memo
-        if memo is not None and memo[0] == key and memo[1] == packed:
+    """merkleize_chunks with a mutation-surviving memo on CachedRootList
+    inputs: reuse requires the exact same packed bytes (C-speed compare),
+    so staleness can only cost a miss, never a wrong root.
+
+    FULL power-of-two vectors (randao_mixes, block_roots, state_roots —
+    always fully populated, count == limit) additionally keep the mid
+    level of the tree: on a byte-diff miss, only the subtrees whose
+    bytes changed re-hash plus the top tree, so the one-mix-per-block
+    write pattern costs ~sqrt(n) hashes instead of n."""
+    if not isinstance(values, CachedRootList):
+        return merkleize_chunks(packed, limit=limit)
+    count = len(packed) // BYTES_PER_CHUNK
+    two_level = (
+        count == limit and count >= 4096 and (count & (count - 1)) == 0
+    )
+    memo = values._pack_memo
+    if memo is not None and memo[0] == key:
+        if memo[1] == packed:
             return memo[2]
-        root = merkleize_chunks(packed, limit=limit)
-        values._pack_memo = (key, packed, root)
+        if two_level and len(memo) == 5 and len(memo[1]) == len(packed):
+            _, old, _, mids, sub_chunks = memo
+            bs = sub_chunks * BYTES_PER_CHUNK
+            nsub = count // sub_chunks
+            new_mids = bytearray(mids)
+            try:
+                import numpy as _np
+
+                a = _np.frombuffer(packed, dtype=_np.uint8).reshape(nsub, bs)
+                b = _np.frombuffer(old, dtype=_np.uint8).reshape(nsub, bs)
+                changed = _np.nonzero((a != b).any(axis=1))[0].tolist()
+            except Exception:  # noqa: BLE001 — no numpy: bytes-slice scan
+                changed = [
+                    i for i in range(nsub)
+                    if packed[i * bs : (i + 1) * bs] != old[i * bs : (i + 1) * bs]
+                ]
+            for i in changed:
+                new_mids[32 * i : 32 * (i + 1)] = merkleize_chunks(
+                    packed[i * bs : (i + 1) * bs], limit=sub_chunks
+                )
+            mids = bytes(new_mids)
+            root = merkleize_chunks(mids, limit=nsub)
+            values._pack_memo = (key, packed, root, mids, sub_chunks)
+            return root
+    if two_level:
+        depth = count.bit_length() - 1
+        k = depth // 2
+        sub_chunks = 1 << k
+        nodes = packed
+        for _ in range(k):  # full vector: every level is exact, no padding
+            nodes = hash_level(nodes)
+        mids = nodes
+        root = merkleize_chunks(mids, limit=count // sub_chunks)
+        values._pack_memo = (key, packed, root, mids, sub_chunks)
         return root
-    return merkleize_chunks(packed, limit=limit)
+    root = merkleize_chunks(packed, limit=limit)
+    values._pack_memo = (key, packed, root)
+    return root
 
 
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
