@@ -424,11 +424,21 @@ class CachedRootList(list):
     through (spec code always mutates via ``state.field[...]``, which is
     instrumented)."""
 
-    __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind")
+    __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind",
+                 "_elems_fresh", "_parents_registered", "_self_ref",
+                 "__weakref__")
 
     def __init__(self, *args):
         super().__init__(*args)
         self._root_cache: dict = {}
+        # True only while every scalar-leaf container element is known
+        # unchanged since the last full walk (elements notify through
+        # weakref parents on __setattr__; every list mutation resets it).
+        # Registration is one-time (_parents_registered) + incremental in
+        # the mutators; _self_ref is the stable weakref handed out.
+        self._elems_fresh: bool = False
+        self._parents_registered: bool = False
+        self._self_ref = None
         # (key, packed_bytes, root) of the last merkleization, exempt
         # from mutation invalidation: correctness comes from comparing
         # the EXACT packed bytes on reuse, so a stale entry can only
@@ -460,6 +470,7 @@ def _instrument(name):
 
     def method(self, *args, **kwargs):
         self._root_cache.clear()
+        self._elems_fresh = False
         kind = self._uniform_kind
         if kind is not None:
             keep = False
@@ -473,7 +484,31 @@ def _instrument(name):
                     keep = False  # slice assignment: arbitrary payload
             if not keep:
                 self._uniform_kind = None
-        return base(self, *args, **kwargs)
+        pre_len = len(self)
+        result = base(self, *args, **kwargs)
+        if self._parents_registered:
+            # keep newly added container elements wired to this list so
+            # the freshness scheme keeps seeing their mutations (read
+            # back from the list itself: extend/slice payloads may be
+            # one-shot iterables the base call consumed)
+            if value_pos is not None and len(args) > value_pos:
+                if name == "__setitem__" and type(args[0]) is not int:
+                    added = list.__getitem__(self, args[0])
+                else:
+                    added = (args[value_pos],)
+            elif name in ("extend", "__iadd__"):
+                added = list.__getitem__(self, slice(pre_len, len(self)))
+            else:
+                added = ()
+            ref = self._self_ref
+            for v in added:
+                if isinstance(v, Container):
+                    ps = v.__dict__.get("_ssz_parents")
+                    if ps is None:
+                        v.__dict__["_ssz_parents"] = [ref]
+                    elif ps[-1] is not ref:
+                        ps.append(ref)
+        return result
 
     method.__name__ = name
     return method
@@ -646,6 +681,19 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                 return _merkleize_packed_memo(
                     values, ("b32", elem, limit_elems), chunks, limit_elems
                 )
+    freshable = (
+        isinstance(values, CachedRootList)
+        and isinstance(elem, type)
+        and getattr(elem, "__ssz_scalar_leaf__", False)
+    )
+    if freshable and values._elems_fresh:
+        # SCALAR-LEAF container elements (the validator registry) notify
+        # this list through weakref parents on any field write, so a set
+        # freshness flag proves no element changed since the last walk —
+        # the O(n) per-element cache walk collapses to a dict hit.
+        memo = values._root_cache.get(("tree", elem, limit_elems))
+        if memo is not None:
+            return memo[1]
     chunks = b"".join(elem.hash_tree_root(v) for v in values)
     if isinstance(values, CachedRootList):
         # container-element lists (the validator registry) can't cache a
@@ -656,9 +704,34 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         # 256KB memcmp replaces the ~16k-hash tree rebuild per state root
         memo = values._root_cache.get(("tree", elem, limit_elems))
         if memo is not None and memo[0] == chunks:
-            return memo[1]
-        root = merkleize_chunks(chunks, limit=limit_elems)
-        values._root_cache[("tree", elem, limit_elems)] = (chunks, root)
+            root = memo[1]
+        else:
+            root = merkleize_chunks(chunks, limit=limit_elems)
+            values._root_cache[("tree", elem, limit_elems)] = (chunks, root)
+        if freshable:
+            if not values._parents_registered:
+                # one-time: register this list as a weak parent of every
+                # element (only scalar-leaf containers: their ONLY
+                # mutation channel is __setattr__, which notifies;
+                # nested-mutable elements like PendingAttestation never
+                # take this path). The instrumented mutators keep later
+                # additions wired, so walks never rescan.
+                import weakref
+
+                ref = values._self_ref
+                if ref is None:
+                    ref = weakref.ref(values)
+                    values._self_ref = ref
+                for v in values:
+                    parents = v.__dict__.get("_ssz_parents")
+                    if parents is None:
+                        v.__dict__["_ssz_parents"] = [ref]
+                    elif ref not in parents:
+                        if len(parents) > 16:  # prune dead lineages
+                            parents[:] = [p for p in parents if p() is not None]
+                        parents.append(ref)
+                values._parents_registered = True
+            values._elems_fresh = True
         return root
     return merkleize_chunks(chunks, limit=limit_elems)
 
@@ -953,8 +1026,18 @@ class Container(metaclass=_ContainerMeta):
     def __setattr__(self, key, value):
         # any field write invalidates the cached root (scalar-leaf
         # containers only pay a dict pop; others never populate it);
-        # plain-list values wrap into the root-caching list
-        self.__dict__.pop("_htr_cache", None)
+        # plain-list values wrap into the root-caching list. Lists that
+        # registered as weak parents (the registry freshness scheme)
+        # lose their freshness here — THE invalidation edge that makes
+        # the walk-skip sound.
+        d = self.__dict__
+        d.pop("_htr_cache", None)
+        parents = d.get("_ssz_parents")
+        if parents is not None:
+            for ref in parents:
+                p = ref()
+                if p is not None:
+                    p._elems_fresh = False
         if type(value) is list:
             value = CachedRootList(value)
         object.__setattr__(self, key, value)
@@ -996,6 +1079,9 @@ class Container(metaclass=_ContainerMeta):
         new = cls.__new__(cls)
         nd = new.__dict__
         nd.update(self.__dict__)
+        # the copy belongs to no list yet: carrying the original's weak
+        # parents would make its mutations invalidate the WRONG lists
+        nd.pop("_ssz_parents", None)
         for key, typ in cls.__ssz_fields__.items():
             v = nd[key]
             tv = v.__class__
