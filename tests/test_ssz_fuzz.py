@@ -184,3 +184,60 @@ def test_polymorphic_deserialize_fuzz():
                 bit,
                 "accepted non-canonical polymorphic encoding",
             )
+
+
+def test_cached_roots_equal_cache_free_rehash_under_mutation():
+    """Property: after ANY sequence of mutations (field writes, list
+    writes, appends, copies), the cached hash_tree_root equals the root
+    of a freshly deserialized (cache-free) clone. This pins every cache
+    layer at once: container _htr_cache, list root caches, pack memos,
+    two-level tree memos, uniformity verdicts, and the registry
+    freshness scheme."""
+    import random
+    import sys as _sys
+    from pathlib import Path
+
+    _sys.path.insert(0, str(Path(__file__).parent))
+    import chain_utils
+
+    from ethereum_consensus_tpu.models import phase0
+
+    state, ctx = chain_utils.fresh_genesis(64, "minimal")
+    ns = phase0.build(ctx.preset)
+    rng = random.Random(0x5A11)
+    states = [state]
+    for step in range(120):
+        st = rng.choice(states)
+        roll = rng.random()
+        if roll < 0.25:
+            v = st.validators[rng.randrange(len(st.validators))]
+            field = rng.choice(
+                ["effective_balance", "slashed", "exit_epoch",
+                 "activation_epoch", "withdrawable_epoch"]
+            )
+            cur = getattr(v, field)
+            setattr(v, field, (not cur) if field == "slashed"
+                    else rng.randrange(2**32))
+        elif roll < 0.45:
+            i = rng.randrange(len(st.balances))
+            st.balances[i] = rng.randrange(2**40)
+        elif roll < 0.6:
+            st.randao_mixes[rng.randrange(len(st.randao_mixes))] = (
+                rng.getrandbits(256).to_bytes(32, "big")
+            )
+        elif roll < 0.7:
+            st.block_roots[rng.randrange(len(st.block_roots))] = (
+                rng.getrandbits(256).to_bytes(32, "big")
+            )
+        elif roll < 0.8:
+            st.validators.append(st.validators[0].copy())
+            st.balances.append(32 * 10**9)
+        elif roll < 0.9 and len(states) < 6:
+            states.append(st.copy())
+        else:
+            st.slot = rng.randrange(2**20)
+        if step % 10 == 9:
+            got = ns.BeaconState.hash_tree_root(st)
+            clean = ns.BeaconState.deserialize(ns.BeaconState.serialize(st))
+            want = ns.BeaconState.hash_tree_root(clean)
+            assert got == want, f"cache drift at step {step}"
