@@ -426,7 +426,12 @@ def process_effective_balance_updates(state, context) -> None:
         packed = _sweeps.pack_registry(state, h.get_current_epoch(state, context))
         updated = _sweeps.effective_balance_updates_device(packed, context)
         for index, validator in enumerate(state.validators):
-            validator.effective_balance = int(updated[index])
+            value = int(updated[index])
+            # only real changes write: an unconditional store would pop
+            # every validator's root cache (and the registry freshness)
+            # for the hysteresis-typical no-op case
+            if validator.effective_balance != value:
+                validator.effective_balance = value
         return
     hysteresis_increment = (
         context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
