@@ -396,15 +396,18 @@ def _hash_to_bls_field(data: bytes) -> int:
 # ---------------------------------------------------------------------------
 
 
-_ROOTS_RAW: "dict[int, bytes]" = {}
+# keyed by id() but ALSO holding the settings object: an entry must pin
+# its owner alive, or a recycled id() could serve another setup's data
+_ROOTS_RAW: "dict[int, tuple]" = {}
 
 
 def _roots_raw(settings: KzgSettings) -> bytes:
-    raw = _ROOTS_RAW.get(id(settings))
-    if raw is None:
-        raw = b"".join(w.to_bytes(32, "big") for w in settings.roots_brp)
-        _ROOTS_RAW.clear()
-        _ROOTS_RAW[id(settings)] = raw
+    hit = _ROOTS_RAW.get(id(settings))
+    if hit is not None and hit[0] is settings:
+        return hit[1]
+    raw = b"".join(w.to_bytes(32, "big") for w in settings.roots_brp)
+    _ROOTS_RAW.clear()
+    _ROOTS_RAW[id(settings)] = (settings, raw)
     return raw
 
 
@@ -470,14 +473,16 @@ def _setup_lincomb(settings: KzgSettings, scalars: list[int]) -> bytes:
 def _setup_lincomb_raw(settings: KzgSettings, sc: bytes) -> bytes:
     """Native-only variant taking pre-serialized 32-byte scalars (the
     native quotient builder emits exactly this layout)."""
-    pre = _MSM_PREPARED.get(id(settings))
-    if pre is None:
+    hit = _MSM_PREPARED.get(id(settings))
+    if hit is not None and hit[0] is settings:
+        pre = hit[1]
+    else:
         try:
             pre = native_bls.PreparedMsm(settings.g1_raw(), settings.n)
         except native_bls.NativeBlsError:
             pre = False  # precompute unavailable: plain Pippenger
         _MSM_PREPARED.clear()  # at most one live setup's tables
-        _MSM_PREPARED[id(settings)] = pre
+        _MSM_PREPARED[id(settings)] = (settings, pre)
     if pre:
         raw, is_inf = pre.run(sc)
     else:
