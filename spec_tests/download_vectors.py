@@ -53,8 +53,20 @@ def download(dest: str = "consensus-spec-tests", version: str = VERSION) -> str:
 
 
 def main() -> int:
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__)
+        return 0
     dest = sys.argv[1] if len(sys.argv) > 1 else "consensus-spec-tests"
-    root = download(dest)
+    try:
+        root = download(dest)
+    except OSError as exc:
+        print(
+            f"download failed ({exc}); this environment may have no "
+            "network egress — run this script wherever the network "
+            "exists and point SPEC_TEST_ROOT at the checkout",
+            file=sys.stderr,
+        )
+        return 1
     print(f"vectors ready: SPEC_TEST_ROOT={root}")
     return 0
 
