@@ -121,7 +121,33 @@ def process_execution_payload(state, body, context) -> None:
 
 
 def get_expected_withdrawals(state, context) -> list:
-    """(block_processing.rs:348)"""
+    """(block_processing.rs:348) — numpy sweep when the registry is big
+    enough to matter, with the literal per-index loop as the fallback
+    (and the cross-checked oracle in tests)."""
+    if len(state.validators) >= 256:
+        hits = _sweep_hits_vectorized(state, context)
+        if hits is not None:
+            withdrawal_index = state.next_withdrawal_index
+            withdrawals = []
+            for validator_index, full in hits:
+                validator = state.validators[validator_index]
+                balance = state.balances[validator_index]
+                withdrawals.append(
+                    Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=bytes(validator.withdrawal_credentials)[12:],
+                        amount=balance if full
+                        else balance - context.MAX_EFFECTIVE_BALANCE,
+                    )
+                )
+                withdrawal_index += 1
+            return withdrawals
+    return _get_expected_withdrawals_loop(state, context)
+
+
+def _get_expected_withdrawals_loop(state, context) -> list:
+    """The literal spec sweep (block_processing.rs:348)."""
     epoch = h.get_current_epoch(state, context)
     withdrawal_index = state.next_withdrawal_index
     validator_index = state.next_withdrawal_validator_index
@@ -154,6 +180,54 @@ def get_expected_withdrawals(state, context) -> list:
             break
         validator_index = (validator_index + 1) % len(state.validators)
     return withdrawals
+
+
+def _sweep_hits_vectorized(state, context) -> "list[tuple[int, bool]] | None":
+    """(validator_index, is_full) of the sweep's first hits, in sweep
+    order, capped at MAX_WITHDRAWALS_PER_PAYLOAD — exactly the indices
+    the literal loop would emit. None = fall back (no numpy / odd
+    values)."""
+    try:
+        import numpy as np
+    except Exception:  # noqa: BLE001 — environment without numpy
+        return None
+    from ...primitives import ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+    vals = state.validators
+    n = len(vals)
+    epoch = h.get_current_epoch(state, context)
+    try:
+        prefix_ok = np.fromiter(
+            (
+                bytes(v.withdrawal_credentials)[:1]
+                == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+                for v in vals
+            ),
+            dtype=bool,
+            count=n,
+        )
+        weps = np.fromiter(
+            (int(v.withdrawable_epoch) for v in vals), dtype=np.uint64, count=n
+        )
+        effs = np.fromiter(
+            (int(v.effective_balance) for v in vals), dtype=np.uint64, count=n
+        )
+        bals = np.fromiter(
+            (int(b) for b in state.balances), dtype=np.uint64, count=n
+        )
+    except (TypeError, ValueError, OverflowError):
+        return None
+    if len(bals) != n:
+        return None
+    maxeb = np.uint64(int(context.MAX_EFFECTIVE_BALANCE))
+    full = prefix_ok & (weps <= np.uint64(int(epoch))) & (bals > 0)
+    part = prefix_ok & (effs == maxeb) & (bals > maxeb) & ~full
+    hit = full | part
+    bound = min(n, int(context.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP))
+    cursor = int(state.next_withdrawal_validator_index)
+    order = (np.arange(bound, dtype=np.int64) + cursor) % n
+    sel = order[hit[order]][: int(context.MAX_WITHDRAWALS_PER_PAYLOAD)]
+    return [(int(vi), bool(full[vi])) for vi in sel.tolist()]
 
 
 def process_withdrawals(state, execution_payload, context) -> None:

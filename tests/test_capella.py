@@ -220,3 +220,38 @@ def test_capella_chain_with_withdrawal():
     assert state.balances[7] < balance_before
     assert state.next_withdrawal_index >= 1
     assert state.latest_execution_payload_header.block_number == ctx.SLOTS_PER_EPOCH
+
+
+def test_vectorized_withdrawal_sweep_matches_loop():
+    """The numpy sweep must emit exactly what the literal loop emits —
+    randomized registries mixing credentials, withdrawable epochs,
+    balances (zero / at / above / below MAX_EFFECTIVE_BALANCE), cursors,
+    and payload saturation."""
+    import random
+
+    from ethereum_consensus_tpu.models.capella import block_processing as bp
+
+    state, ctx = fresh_genesis_capella(300, "minimal")
+    rng = random.Random(0xCA11)
+    epoch_now = int(state.slot) // int(ctx.SLOTS_PER_EPOCH)
+    maxeb = int(ctx.MAX_EFFECTIVE_BALANCE)
+    for trial in range(30):
+        for i, v in enumerate(state.validators):
+            kind = rng.random()
+            cred = (b"\x01" if kind < 0.6 else b"\x00") + bytes(11) + bytes(
+                [i % 256]
+            ) * 20
+            v.withdrawal_credentials = cred
+            v.withdrawable_epoch = rng.choice(
+                [0, epoch_now, epoch_now + 1, 2**64 - 1]
+            )
+            v.effective_balance = rng.choice([0, maxeb // 2, maxeb])
+            state.balances[i] = rng.choice(
+                [0, 1, maxeb - 1, maxeb, maxeb + 1, 2 * maxeb]
+            )
+        state.next_withdrawal_validator_index = rng.randrange(
+            len(state.validators)
+        )
+        want = bp._get_expected_withdrawals_loop(state, ctx)
+        got = bp.get_expected_withdrawals(state, ctx)
+        assert got == want, f"trial {trial}: sweep divergence"
