@@ -41,10 +41,17 @@ The ``detail.configs`` dict carries the BASELINE.md configs and more:
                           (u64 vs int8-MXU), the routing-threshold probe
   * ``large_agg``       — 2^16-point G1 aggregation, device vs native
 
-Prints ONE JSON line:
+Prints ONE JSON line. Healthy chip:
   {"metric": "hash_tree_root_leaves_per_sec", "value": ..., "unit":
    "leaves/sec", "vs_baseline": device/native-single-core speedup,
    "detail": {...}}
+Degraded (no chip): the headline switches to the HOST result for
+BASELINE config 3 —
+  {"metric": "attestation_sets_per_sec_host", "unit": "sets/sec",
+   "vs_baseline": sets_per_s / 700 (the single-core blst-class
+   estimate), ...}
+— because a device-kernel-on-CPU-fallback rate would misrepresent the
+run; the device configs stay under detail.configs either way.
 """
 
 import json
