@@ -397,7 +397,11 @@ def _hash_to_bls_field(data: bytes) -> int:
 
 
 # keyed by id() but ALSO holding the settings object: an entry must pin
-# its owner alive, or a recycled id() could serve another setup's data
+# its owner alive, or a recycled id() could serve another setup's data.
+# Bounded at a few slots (not cleared on each new setup) so a process
+# alternating between two live setups — mainnet + minimal presets in one
+# pytest session — doesn't rebuild the ~0.5s MSM tables on every switch.
+_SETUP_CACHE_SLOTS = 4
 _ROOTS_RAW: "dict[int, tuple]" = {}
 
 
@@ -406,7 +410,8 @@ def _roots_raw(settings: KzgSettings) -> bytes:
     if hit is not None and hit[0] is settings:
         return hit[1]
     raw = b"".join(w.to_bytes(32, "big") for w in settings.roots_brp)
-    _ROOTS_RAW.clear()
+    if len(_ROOTS_RAW) >= _SETUP_CACHE_SLOTS:
+        _ROOTS_RAW.pop(next(iter(_ROOTS_RAW)))  # FIFO evict oldest
     _ROOTS_RAW[id(settings)] = (settings, raw)
     return raw
 
@@ -481,7 +486,8 @@ def _setup_lincomb_raw(settings: KzgSettings, sc: bytes) -> bytes:
             pre = native_bls.PreparedMsm(settings.g1_raw(), settings.n)
         except native_bls.NativeBlsError:
             pre = False  # precompute unavailable: plain Pippenger
-        _MSM_PREPARED.clear()  # at most one live setup's tables
+        if len(_MSM_PREPARED) >= _SETUP_CACHE_SLOTS:
+            _MSM_PREPARED.pop(next(iter(_MSM_PREPARED)))  # FIFO evict
         _MSM_PREPARED[id(settings)] = (settings, pre)
     if pre:
         raw, is_inf = pre.run(sc)

@@ -726,12 +726,26 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                     parents = v.__dict__.get("_ssz_parents")
                     if parents is None:
                         v.__dict__["_ssz_parents"] = [ref]
-                    elif ref not in parents:
+                    elif not any(p is ref for p in parents):
+                        # identity, not ==: weakref.ref.__eq__ compares
+                        # live referents by VALUE, and these lists
+                        # compare field-wise — a distinct but value-equal
+                        # sibling list (state copy sharing elements)
+                        # would be mistaken for self, skipping
+                        # registration while still claiming freshness
                         if len(parents) > 16:  # prune dead lineages
                             parents[:] = [p for p in parents if p() is not None]
                         parents.append(ref)
                 values._parents_registered = True
-            values._elems_fresh = True
+            # Freshness is only sound if every element's sole mutation
+            # channel really is __setattr__: an element holding a mutable
+            # buffer (bytearray in a ByteVector slot) can change in place
+            # without notifying. elem.hash_tree_root() just ran on every
+            # element and set _htr_cache iff all field values were
+            # immutable (int|bool|bytes), so cache presence IS that proof.
+            values._elems_fresh = all(
+                "_htr_cache" in v.__dict__ for v in values
+            )
         return root
     return merkleize_chunks(chunks, limit=limit_elems)
 

@@ -618,3 +618,295 @@ def make_attestation_electra(state, slot: int, context, participation=1.0):
         committee_bits=committee_bits,
         signature=signature.to_bytes(),
     )
+
+
+# ---------------------------------------------------------------------------
+# mainnet-scale direct registry construction (bench + scale-test scaffolding)
+#
+# Deposit-crypto genesis is O(n) signatures + O(n) pairings — minutes at
+# 2^17 validators. The benches need a mainnet-SHAPED state (full committee
+# structure, real sync committees, verifiable attestation/proposer sigs),
+# not a mainnet-HISTORY state, so this builds the registry directly: every
+# validator gets a deterministic synthetic pubkey (an invalid G1 encoding —
+# any crypto path touching a validator that wasn't explicitly given a real
+# key fails loudly instead of silently verifying), and only the validators
+# that actually sign in a bench (attesting committees, the proposer, sync
+# committee members) get real EIP-2333-free bench keys. Shuffling, proposer
+# sampling and sync-committee sampling read seeds and effective balances,
+# never pubkey bytes, so realizing keys after index selection is sound.
+# ---------------------------------------------------------------------------
+
+_FASTREG_VERSION = "v1"  # bump to invalidate disk-cached artifacts
+
+
+def synthetic_pubkey_bytes(index: int) -> bytes:
+    """48 deterministic bytes that can NEVER decompress: leading byte 0xFF
+    sets the compression+infinity bits with a nonzero remainder, which
+    every BLS12-381 decoder rejects."""
+    return b"\xff" + bls.hash(b"synthetic-pk" + index.to_bytes(8, "little"))[:15] + index.to_bytes(32, "big")
+
+
+def _genesis_fork_version_for(context, fork_name: str) -> bytes:
+    if fork_name == "phase0":
+        return context.genesis_fork_version
+    return getattr(context, f"{fork_name}_fork_version")
+
+
+def build_fast_registry_state(validator_count: int, fork_name: str = "phase0",
+                              preset_name: str = "mainnet"):
+    """Uncached direct construction — see the section comment above."""
+    from ethereum_consensus_tpu.models.genesis_common import (
+        initialize_state_generic,
+    )
+    from ethereum_consensus_tpu.primitives import (
+        FAR_FUTURE_EPOCH,
+        GENESIS_EPOCH,
+    )
+
+    mod = _fork_module(fork_name) if fork_name != "phase0" else None
+    from ethereum_consensus_tpu.models import phase0 as _phase0_mod
+
+    mod = mod or _phase0_mod
+    context = (
+        Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    )
+    ns = mod.build(context.preset)
+    kwargs = {}
+    if fork_name in _PAYLOAD_FORKS:
+        kwargs["execution_payload_header"] = make_genesis_payload_header(
+            context, fork_name
+        )
+    state = initialize_state_generic(
+        ns,
+        _genesis_fork_version_for(context, fork_name),
+        ETH1_BLOCK_HASH,
+        ETH1_TIMESTAMP,
+        [],  # no deposits: the registry is injected below
+        context,
+        process_deposit_fn=lambda *a, **k: None,
+        get_next_sync_committee_fn=None,
+        **kwargs,
+    )
+
+    if fork_name == "electra":
+        from ethereum_consensus_tpu.primitives import (
+            UNSET_DEPOSIT_RECEIPTS_START_INDEX,
+        )
+
+        state.deposit_receipts_start_index = UNSET_DEPOSIT_RECEIPTS_START_INDEX
+        effective = int(context.MIN_ACTIVATION_BALANCE)
+    else:
+        effective = int(context.MAX_EFFECTIVE_BALANCE)
+    balance = int(context.MAX_EFFECTIVE_BALANCE)
+
+    state.validators = [
+        ns.Validator(
+            public_key=synthetic_pubkey_bytes(i),
+            withdrawal_credentials=b"\x00"
+            + bls.hash(b"wc" + i.to_bytes(8, "little"))[1:],
+            effective_balance=effective,
+            activation_eligibility_epoch=GENESIS_EPOCH,
+            activation_epoch=GENESIS_EPOCH,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for i in range(validator_count)
+    ]
+    state.balances = [balance] * validator_count
+    # deposit bookkeeping: all "deposits" are consumed, so block
+    # processing expects zero new Deposit operations
+    state.eth1_data.deposit_count = validator_count
+    state.eth1_deposit_index = validator_count
+    if hasattr(state, "previous_epoch_participation"):
+        state.previous_epoch_participation = [0] * validator_count
+        state.current_epoch_participation = [0] * validator_count
+        state.inactivity_scores = [0] * validator_count
+    state.__dict__.pop("_active_idx_cache", None)
+
+    state.genesis_validators_root = type(state).__ssz_fields__[
+        "validators"
+    ].hash_tree_root(state.validators)
+
+    if hasattr(state, "current_sync_committee"):
+        from ethereum_consensus_tpu.models.altair.helpers import (
+            get_next_sync_committee,
+            get_next_sync_committee_indices,
+        )
+
+        # realize members BEFORE building the committee containers so they
+        # carry real keys and the aggregate pubkey is computable
+        realize_validator_keys(
+            state, get_next_sync_committee_indices(state, context)
+        )
+        sync_committee = get_next_sync_committee(state, context)
+        state.current_sync_committee = sync_committee
+        state.next_sync_committee = sync_committee.copy()
+    return state, context
+
+
+def realize_validator_keys(state, indices) -> None:
+    """Swap the synthetic pubkeys of ``indices`` for the real deterministic
+    bench keys (``secret_key(i)``); idempotent."""
+    for i in set(indices):
+        v = state.validators[i]
+        real = public_key_bytes(i)
+        if bytes(v.public_key) != real:
+            v.public_key = real
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_fast_registry(fork_name: str, validator_count: int, preset_name: str):
+    context = (
+        Context.for_minimal() if preset_name == "minimal" else Context.for_mainnet()
+    )
+    mod = _fork_module(fork_name)
+    state_type = mod.build(context.preset).BeaconState
+    state = _disk_cached(
+        f"fastreg-{_FASTREG_VERSION}-{fork_name}-{preset_name}-{validator_count}",
+        state_type.serialize,
+        state_type.deserialize,
+        lambda: build_fast_registry_state(validator_count, fork_name, preset_name)[0],
+    )
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)  # warm the root memo (see cached_genesis)
+    return state, context
+
+
+def fast_registry_state(validator_count: int, fork_name: str = "phase0",
+                        preset_name: str = "mainnet"):
+    state, context = _cached_fast_registry(fork_name, validator_count, preset_name)
+    return state.copy(), context
+
+
+def mainnet_block_bundle(fork_name: str, validator_count: int, atts: int):
+    """(pre_state, context, signed_block) at mainnet committee structure:
+    a ``validator_count`` registry, a block at slot 2 carrying up to
+    ``atts`` aggregate attestations (full participation) over slots 0-1's
+    committees, plus a full sync aggregate and execution payload on
+    altair+/bellatrix+ forks. Disk-cached: the driver-time bench pays one
+    deserialize, not thousands of signatures."""
+    context = Context.for_mainnet()
+    mod = _fork_module(fork_name)
+    ns = mod.build(context.preset)
+
+    def build():
+        state, ctx = fast_registry_state(validator_count, fork_name)
+        target = state.slot + 2
+        # index selection on a throwaway advance (shuffle is pubkey-blind)
+        scratch = state.copy()
+        mod.slot_processing.process_slots(scratch, target, ctx)
+        per_slot = h.get_committee_count_per_slot(
+            scratch, h.get_current_epoch(scratch, ctx), ctx
+        )
+        needed = set()
+        att_plan = []  # (slot, committee_index) in inclusion order
+        for slot in range(max(0, target - 2), target):
+            if slot + ctx.MIN_ATTESTATION_INCLUSION_DELAY > target:
+                continue
+            if fork_name == "electra":
+                if len(att_plan) < atts:
+                    att_plan.append((slot, None))
+                    for index in range(per_slot):
+                        needed.update(
+                            h.get_beacon_committee(scratch, slot, index, ctx)
+                        )
+                continue
+            for index in range(per_slot):
+                if len(att_plan) >= atts:
+                    break
+                att_plan.append((slot, index))
+                needed.update(h.get_beacon_committee(scratch, slot, index, ctx))
+        needed.add(h.get_beacon_proposer_index(scratch, ctx))
+        realize_validator_keys(state, needed)
+
+        # attestation data reads roots off the REALIZED state's advance
+        scratch = state.copy()
+        mod.slot_processing.process_slots(scratch, target, ctx)
+        attestations = []
+        for slot, index in att_plan:
+            if fork_name == "electra":
+                attestations.append(
+                    make_attestation_electra(scratch, slot, ctx)
+                )
+            else:
+                attestations.append(
+                    make_attestation(scratch, slot, index, ctx)
+                )
+        if fork_name == "phase0":
+            signed = produce_block(
+                state.copy(), target, context, attestations=attestations
+            )
+        else:
+            signed = produce_block_fork(
+                fork_name, state.copy(), target, ctx,
+                attestations=attestations,
+            )
+        return state, signed
+
+    def serialize(value):
+        state, signed = value
+        sb = type(state).serialize(state)
+        bb = ns.SignedBeaconBlock.serialize(signed)
+        return len(sb).to_bytes(8, "little") + sb + bb
+
+    def deserialize(data):
+        n = int.from_bytes(data[:8], "little")
+        state = ns.BeaconState.deserialize(data[8 : 8 + n])
+        signed = ns.SignedBeaconBlock.deserialize(data[8 + n :])
+        return state, signed
+
+    state, signed = _disk_cached(
+        f"blockbundle-{_FASTREG_VERSION}-{fork_name}-mainnet-"
+        f"{validator_count}-{atts}",
+        serialize,
+        deserialize,
+        build,
+    )
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)  # warm the root memo
+    return state.copy(), context, signed
+
+
+def inject_full_epoch_pendings(state, context, epoch: int) -> int:
+    """Fill ``state``'s pending-attestation list for ``epoch`` with full
+    participation over every (slot, committee) — the realistic pre-epoch-
+    boundary shape — WITHOUT signatures (epoch processing never verifies
+    them; block processing already did). Returns the pending count.
+
+    ``state`` must have advanced past the epoch so block roots exist."""
+    ns = build(context.preset)
+    start = epoch * int(context.SLOTS_PER_EPOCH)
+    per_slot = h.get_committee_count_per_slot(state, epoch, context)
+    current = epoch == h.get_current_epoch(state, context)
+    if current:
+        source = state.current_justified_checkpoint.copy()
+        pendings = state.current_epoch_attestations
+    else:
+        source = state.previous_justified_checkpoint.copy()
+        pendings = state.previous_epoch_attestations
+    target_root = _block_root_at_or_latest(state, start)
+    n = 0
+    for slot in range(start, start + int(context.SLOTS_PER_EPOCH)):
+        if slot + int(context.MIN_ATTESTATION_INCLUSION_DELAY) > state.slot:
+            continue
+        block_root = _block_root_at_or_latest(state, slot)
+        for index in range(per_slot):
+            committee = h.get_beacon_committee(state, slot, index, context)
+            pendings.append(
+                ns.PendingAttestation(
+                    aggregation_bits=[True] * len(committee),
+                    data=ns.AttestationData(
+                        slot=slot,
+                        index=index,
+                        beacon_block_root=block_root,
+                        source=source,
+                        target=ns.Checkpoint(epoch=epoch, root=target_root),
+                    ),
+                    inclusion_delay=int(context.MIN_ATTESTATION_INCLUSION_DELAY),
+                    proposer_index=committee[0],
+                )
+            )
+            n += 1
+    return n

@@ -431,3 +431,76 @@ def test_uniform_len_flag_safety():
     assert data._uniform_kind == ("bytes", 32)
     data[2:4] = [b"\xcc" * 32, b"\xdd" * 32]
     assert data._uniform_kind is None
+
+
+def test_value_equal_sibling_list_registers_independently():
+    """Regression (advisor, round 4): parent registration compared
+    weakrefs with ``in`` — but weakref.ref.__eq__ compares live
+    referents by VALUE, and CachedRootList compares field-wise. A
+    distinct but value-equal sibling list sharing element objects
+    (``state2.validators = list(state1.validators)``) found the other
+    list's ref "equal", skipped registering itself, yet still claimed
+    freshness — later element mutations notified only the first list and
+    the second served a stale root. Must compare by identity."""
+    from ethereum_consensus_tpu.ssz.core import (
+        CachedRootList,
+        Container,
+        List,
+        uint64,
+    )
+
+    class Rec(Container):
+        a: uint64
+        b: uint64
+
+    L = List[Rec, 64]
+    recs = [Rec(a=i, b=2 * i) for i in range(8)]
+    lst1 = CachedRootList(recs)
+    lst2 = CachedRootList(list(recs))  # distinct list, SHARED elements
+    r1 = L.hash_tree_root(lst1)  # registers lst1 as parent, sets fresh
+    r2 = L.hash_tree_root(lst2)  # value-equal to lst1 at this moment
+    assert r1 == r2
+    recs[3].a = 999  # element write must invalidate BOTH lists
+    r1b = L.hash_tree_root(lst1)
+    r2b = L.hash_tree_root(lst2)
+    assert r1b != r1
+    assert r2b == r1b, "sibling list served a stale root"
+    # ground truth from a cache-free rebuild
+    assert r2b == L.hash_tree_root([Rec(a=v.a, b=v.b) for v in recs])
+
+
+def test_freshness_never_claimed_over_mutable_buffers():
+    """Regression (advisor, round 4): the freshness fast path skipped
+    the chunk rebuild entirely, so an element holding a mutable buffer
+    (bytearray in a ByteVector slot) mutated in place — bypassing
+    __setattr__ — would be served stale. Freshness may only be claimed
+    when every element's field values are immutable (the same proof
+    _htr_cache relies on)."""
+    from ethereum_consensus_tpu.ssz.core import (
+        ByteVector,
+        CachedRootList,
+        Container,
+        List,
+        uint64,
+    )
+
+    class Leaf(Container):
+        tag: uint64
+        data: ByteVector[32]
+
+    L = List[Leaf, 64]
+    buf = bytearray(b"\x11" * 32)
+    elems = [Leaf(tag=0, data=buf), Leaf(tag=1, data=b"\x22" * 32)]
+    lst = CachedRootList(elems)
+    r1 = L.hash_tree_root(lst)
+    assert not lst._elems_fresh, "freshness claimed over a bytearray field"
+    buf[0] = 0xFF  # in-place mutation, no __setattr__ fired
+    r2 = L.hash_tree_root(lst)
+    assert r2 != r1
+    assert r2 == L.hash_tree_root(
+        [Leaf(tag=e.tag, data=bytes(e.data)) for e in elems]
+    )
+    # all-immutable lists DO claim freshness (the fast path stays live)
+    lst2 = CachedRootList([Leaf(tag=5, data=b"\x33" * 32)])
+    L.hash_tree_root(lst2)
+    assert lst2._elems_fresh
