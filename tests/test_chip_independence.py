@@ -165,6 +165,9 @@ def test_bench_parent_emits_json_with_broken_plugin(tmp_path):
     # keep the run short: the probe fails fast (poison raises), the
     # child runs hermetically — cap it so the test stays cheap
     env["EC_BENCH_TEST_FAST"] = "1"
+    # the full-dump must NOT clobber the repo-root evidence artifact of a
+    # real run (code-review r5 finding: a pytest pass was poisoning it)
+    env["EC_BENCH_FULL_PATH"] = str(tmp_path / "BENCH_FULL.json")
     proc = _run(
         [sys.executable, "bench.py"], env, timeout=600
     )
@@ -173,3 +176,5 @@ def test_bench_parent_emits_json_with_broken_plugin(tmp_path):
     out = json.loads(line)
     assert out["metric"] == "hash_tree_root_leaves_per_sec"
     assert out["detail"]["degraded"]
+    assert (tmp_path / "BENCH_FULL.json").exists()
+    assert out["detail"]["full_results"] == "BENCH_FULL.json"
