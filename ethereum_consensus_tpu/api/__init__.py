@@ -3,8 +3,18 @@
 Reference parity: beacon-api-client crate (1,804 LoC).
 """
 
+from .async_client import AsyncClient  # noqa: F401
 from .client import CONSENSUS_VERSION_HEADER, Client  # noqa: F401
 from .errors import ApiError, IndexedError  # noqa: F401
+from .events import (  # noqa: F401
+    BlobSidecarTopic,
+    BlockTopic,
+    ChainReorgTopic,
+    FinalizedCheckpointTopic,
+    HeadTopic,
+    PayloadAttributesTopic,
+    Topic,
+)
 from .types import (  # noqa: F401
     AttestationDuty,
     BalanceSummary,

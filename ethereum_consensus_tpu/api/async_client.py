@@ -1,0 +1,355 @@
+"""Async Beacon-API client — asyncio/aiohttp transport.
+
+Reference parity: beacon-api-client/src/api_client.rs — the reference
+client is async end-to-end (reqwest/tokio); this is the matching
+concurrency model, with the existing synchronous ``Client`` kept as the
+convenience facade. Endpoint surface is identical by construction (and
+pinned by ``tests/test_api_async.py::test_surface_parity``).
+
+Design — a sans-io bridge, not 69 duplicated method bodies:
+
+Every endpoint method on the sync ``Client`` is (pure request shaping) →
+exactly ONE transport-primitive call (``get`` / ``get_enveloped`` /
+``post`` / ``http_get`` / ``http_post``) → (pure response parsing).
+``AsyncClient`` reuses those bodies unchanged by running each against two
+proxies: a *recording* pass captures the request and aborts at the
+transport call; the real I/O happens once on the aiohttp session; a
+*replay* pass re-runs the body with the transport primed to hand back the
+completed response, yielding the parsed result. The pure halves run
+twice; the network is hit once. A method that never reaches a transport
+primitive (or reaches it twice with different requests) trips a loud
+invariant error rather than silently misbehaving.
+
+Streaming (``get_events``, typed topics per events.py) and raw-status
+(``get_health``) endpoints don't fit the one-shot shape and are
+implemented natively below.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+from typing import Any, AsyncIterator
+
+from .client import CONSENSUS_VERSION_HEADER, Client  # noqa: F401 (re-export)
+from .errors import ApiError
+from .events import parse_event, topic_name
+from .types import HealthStatus, VersionedValue  # noqa: F401
+
+__all__ = ["AsyncClient"]
+
+# sync-Client attributes that are transport plumbing or natively
+# reimplemented here — everything else is bridged automatically
+_NON_BRIDGED = {
+    "get",
+    "get_enveloped",
+    "post",
+    "http_get",
+    "http_post",
+    "get_events",
+    "get_health",
+    "_url",
+    "_raise_for_api_error",
+    "_block_json",
+}
+
+
+class _Pending(Exception):
+    """Control-flow carrier: the captured transport request."""
+
+    def __init__(self, kind: str, path: str, params=None, payload=None,
+                 headers=None):
+        super().__init__(kind, path)
+        self.kind = kind
+        self.path = path
+        self.params = params
+        self.payload = payload
+        self.headers = headers
+
+    def key(self) -> tuple:
+        return (self.kind, self.path, repr(self.params), repr(self.payload),
+                repr(self.headers))
+
+
+class _FakeResponse:
+    """Stands in for a requests.Response inside replayed bodies (only the
+    surface the sync bodies touch: .json())."""
+
+    def __init__(self, body: Any):
+        self._body = body
+
+    def json(self) -> Any:
+        return self._body
+
+
+class _Proxy:
+    """Base for the recording/replay stand-ins for ``self`` inside sync
+    method bodies. Unknown attributes resolve to the sync Client's own
+    methods bound to this proxy, so endpoint-to-endpoint delegation
+    (``get_beacon_header_at_head`` → ``get_beacon_header``) just works."""
+
+    _block_json = staticmethod(Client.__dict__["_block_json"].__func__)
+
+    def __init__(self, context):
+        self.context = context
+
+    def __getattr__(self, name: str):
+        fn = getattr(Client, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError(name)
+        return fn.__get__(self, type(self))
+
+
+class _Recorder(_Proxy):
+    def get(self, path, params=None):
+        raise _Pending("get", path, params=params)
+
+    def get_enveloped(self, path, params=None):
+        raise _Pending("get_enveloped", path, params=params)
+
+    def post(self, path, payload=None, headers=None):
+        raise _Pending("post", path, payload=payload, headers=headers)
+
+    def http_get(self, path, params=None, headers=None):
+        raise _Pending("http_get", path, params=params, headers=headers)
+
+    def http_post(self, path, payload=None, headers=None):
+        raise _Pending("http_post", path, payload=payload, headers=headers)
+
+
+class _Replayer(_Proxy):
+    def __init__(self, context, expected_key: tuple, result: Any):
+        super().__init__(context)
+        self._expected = expected_key
+        self._result = result
+        self.used = False
+
+    def _serve(self, pending: _Pending) -> Any:
+        if self.used or pending.key() != self._expected:
+            raise RuntimeError(
+                "sans-io bridge invariant broken: endpoint body issued a "
+                f"second/different transport call {pending.key()} vs "
+                f"{self._expected}"
+            )
+        self.used = True
+        return self._result
+
+    def get(self, path, params=None):
+        return self._serve(_Pending("get", path, params=params))
+
+    def get_enveloped(self, path, params=None):
+        return self._serve(_Pending("get_enveloped", path, params=params))
+
+    def post(self, path, payload=None, headers=None):
+        return self._serve(
+            _Pending("post", path, payload=payload, headers=headers)
+        )
+
+    def http_get(self, path, params=None, headers=None):
+        return self._serve(_Pending("http_get", path, params=params,
+                                    headers=headers))
+
+    def http_post(self, path, payload=None, headers=None):
+        return self._serve(
+            _Pending("http_post", path, payload=payload, headers=headers)
+        )
+
+
+class AsyncClient:
+    """(api_client.rs:78, async) — bind to an endpoint; pass ``context``
+    for SSZ-typed block/state decoding; pass an ``aiohttp.ClientSession``
+    to share a connection pool, else one is created lazily and owned.
+
+    Usable as an async context manager; otherwise call ``close()``."""
+
+    def __init__(self, endpoint: str, context=None, session=None):
+        self.endpoint = endpoint.rstrip("/")
+        self.context = context
+        self._session = session
+        self._owns_session = session is None
+
+    # -- session lifecycle ---------------------------------------------------
+    def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._owns_session and self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def __aenter__(self) -> "AsyncClient":
+        self._ensure_session()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.close()
+
+    # -- transport (api_client.rs:94-130, async) -----------------------------
+    def _url(self, path: str) -> str:
+        return f"{self.endpoint}/{path.lstrip('/')}"
+
+    @staticmethod
+    async def _raise_for_api_error(response) -> None:
+        if response.status >= 400:
+            text = await response.text()
+            try:
+                error = ApiError.from_json(json.loads(text))
+            except Exception:  # non-JSON / non-envelope error body
+                raise ApiError(response.status, text) from None
+            raise error
+
+    async def http_get(self, path: str, params=None, headers=None):
+        """GET returning the parsed JSON body (the async analogue hands
+        back the body rather than a live response object)."""
+        session = self._ensure_session()
+        async with session.get(
+            self._url(path), params=params, headers=headers
+        ) as response:
+            await self._raise_for_api_error(response)
+            return await response.json()
+
+    async def get(self, path: str, params=None):
+        return (await self.http_get(path, params=params))["data"]
+
+    async def get_enveloped(self, path: str, params=None) -> VersionedValue:
+        body = await self.http_get(path, params=params)
+        meta = {k: v for k, v in body.items() if k not in ("version", "data")}
+        return VersionedValue(
+            version=body.get("version", ""), data=body["data"], meta=meta
+        )
+
+    async def http_post(self, path: str, payload=None, headers=None):
+        session = self._ensure_session()
+        async with session.post(
+            self._url(path), json=payload, headers=headers
+        ) as response:
+            await self._raise_for_api_error(response)
+            try:
+                return await response.json()
+            except Exception:  # empty-ok bodies
+                return None
+
+    async def post(self, path: str, payload=None, headers=None) -> None:
+        await self.http_post(path, payload, headers=headers)
+
+    # -- the sans-io bridge --------------------------------------------------
+    async def _perform(self, pending: _Pending) -> Any:
+        """One real round-trip for a captured request; returns whatever the
+        sync body expects its transport primitive to have returned."""
+        if pending.kind == "get":
+            return await self.get(pending.path, params=pending.params)
+        if pending.kind == "get_enveloped":
+            return await self.get_enveloped(pending.path, params=pending.params)
+        if pending.kind == "post":
+            await self.post(pending.path, pending.payload,
+                            headers=pending.headers)
+            return None
+        if pending.kind == "http_get":
+            return _FakeResponse(
+                await self.http_get(pending.path, params=pending.params,
+                                    headers=pending.headers)
+            )
+        if pending.kind == "http_post":
+            return _FakeResponse(
+                await self.http_post(pending.path, pending.payload,
+                                     headers=pending.headers)
+            )
+        raise AssertionError(pending.kind)
+
+    async def _invoke(self, name: str, args: tuple, kwargs: dict) -> Any:
+        fn = getattr(Client, name)
+        try:
+            fn(_Recorder(self.context), *args, **kwargs)
+        except _Pending as pending:
+            captured = pending
+        else:
+            raise RuntimeError(
+                f"sans-io bridge invariant broken: Client.{name} returned "
+                "without a transport call — implement it natively on "
+                "AsyncClient"
+            )
+        result = await self._perform(captured)
+        replayer = _Replayer(self.context, captured.key(), result)
+        out = fn(replayer, *args, **kwargs)
+        if not replayer.used:
+            raise RuntimeError(
+                f"sans-io bridge invariant broken: Client.{name} replay "
+                "diverged from its recording pass"
+            )
+        return out
+
+    # -- natively-async endpoints -------------------------------------------
+    async def get_events(self, topics: list) -> AsyncIterator[tuple[str, Any]]:
+        """(api_client.rs:610) — async SSE stream of (topic_name, event)
+        pairs; ``topics`` mixes Topic classes/instances (typed events,
+        events.py) and bare strings (raw dict payloads)."""
+        by_name = {topic_name(t): t for t in topics}
+        session = self._ensure_session()
+        import aiohttp
+
+        response = await session.get(
+            self._url("eth/v1/events"),
+            params={"topics": ",".join(by_name)},
+            headers={"Accept": "text/event-stream"},
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
+        )
+        try:
+            await self._raise_for_api_error(response)
+        except BaseException:
+            response.close()  # error path never reaches stream()'s finally
+            raise
+
+        async def stream() -> AsyncIterator[tuple[str, Any]]:
+            event = None
+            try:
+                async for raw in response.content:
+                    line = raw.decode().rstrip("\r\n")
+                    if line.startswith("event:"):
+                        event = line.split(":", 1)[1].strip()
+                    elif line.startswith("data:"):
+                        payload = json.loads(line.split(":", 1)[1].strip())
+                        name = event or "message"
+                        yield name, parse_event(by_name.get(name, name), payload)
+                    elif not line:
+                        event = None
+            finally:
+                response.close()
+
+        return stream()
+
+    async def get_health(self) -> HealthStatus:
+        """(api_client.rs:668) — raw status code, no error envelope."""
+        session = self._ensure_session()
+        async with session.get(self._url("eth/v1/node/health")) as response:
+            return {
+                200: HealthStatus.READY,
+                206: HealthStatus.SYNCING,
+                503: HealthStatus.NOT_INITIALIZED,
+            }.get(response.status, HealthStatus.UNKNOWN)
+
+
+def _bridge(name: str, sync_fn):
+    async def method(self, *args, **kwargs):
+        return await self._invoke(name, args, kwargs)
+
+    method.__name__ = name
+    method.__qualname__ = f"AsyncClient.{name}"
+    method.__doc__ = sync_fn.__doc__
+    method.__wrapped__ = sync_fn  # inspect.signature sees the sync one
+    return method
+
+
+for _name, _fn in vars(Client).items():
+    if (
+        _name.startswith("__")
+        or _name in _NON_BRIDGED
+        or not callable(getattr(Client, _name))
+        or not inspect.isfunction(_fn)
+    ):
+        continue
+    setattr(AsyncClient, _name, _bridge(_name, _fn))
+del _name, _fn

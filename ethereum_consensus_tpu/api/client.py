@@ -6,8 +6,11 @@ validator duties (get_attester_duties:683, get_proposer_duties:700), block
 production (get_block_proposal:726), light-client (:428-466), blobs
 (get_blob_sidecars:395), node/debug/events (get_events:610 via SSE),
 post_signed_beacon_block_v2:355 with the Eth-Consensus-Version header
-(lib.rs:14). Synchronous `requests` transport (the reference uses async
-reqwest; the endpoint surface and semantics match 1:1).
+(lib.rs:14). This module is the synchronous `requests` facade; the
+async/aiohttp transport matching the reference's concurrency model
+(async reqwest/tokio) lives in async_client.py, sharing these endpoint
+bodies via a sans-io bridge. Endpoint-for-endpoint audit:
+docs/API_AUDIT.md (69/69 present under identical names).
 """
 
 from __future__ import annotations
@@ -410,11 +413,17 @@ class Client:
         ]
 
     # -- events (api_client.rs:610) ------------------------------------------
-    def get_events(self, topics: list[str]) -> Iterator[tuple[str, dict]]:
-        """SSE stream of (event, data) pairs."""
+    def get_events(self, topics: list) -> Iterator[tuple[str, object]]:
+        """SSE stream of (topic_name, event) pairs; ``topics`` mixes Topic
+        classes/instances (typed events, events.py — the analogue of the
+        reference's ``Topic`` trait, types.rs:284) and bare strings (raw
+        dict payloads)."""
+        from .events import parse_event, topic_name
+
+        by_name = {topic_name(t): t for t in topics}
         response = self.session.get(
             self._url("eth/v1/events"),
-            params={"topics": ",".join(topics)},
+            params={"topics": ",".join(by_name)},
             stream=True,
             headers={"Accept": "text/event-stream"},
         )
@@ -425,8 +434,9 @@ class Client:
             if line.startswith("event:"):
                 event = line.split(":", 1)[1].strip()
             elif line.startswith("data:"):
-                payload = line.split(":", 1)[1].strip()
-                yield event or "message", json.loads(payload)
+                payload = json.loads(line.split(":", 1)[1].strip())
+                name = event or "message"
+                yield name, parse_event(by_name.get(name, name), payload)
             elif not line:
                 event = None
 

@@ -1,0 +1,269 @@
+"""Typed Beacon-API SSE topics.
+
+Reference parity: beacon-api-client/src/types.rs:284 (`Topic` trait —
+``NAME`` + a deserializable ``Data`` type) and :290
+(``PayloadAttributesTopic`` / ``PayloadAttributesEvent``), consumed by
+``get_events`` (api_client.rs:610 via mev-share-sse). The reference ships
+one concrete topic; this module covers the standard beacon event topics,
+each parsing its payload into a typed event.
+
+A topic is any object with a ``NAME: str`` and a ``parse(obj) -> Data``;
+``Client.get_events`` / ``AsyncClient.get_events`` accept topic classes,
+topic instances, or bare strings (bare strings parse to raw dicts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..serde import from_hex
+from .types import VersionedValue
+
+__all__ = [
+    "Topic",
+    "HeadTopic",
+    "BlockTopic",
+    "AttestationTopic",
+    "VoluntaryExitTopic",
+    "FinalizedCheckpointTopic",
+    "ChainReorgTopic",
+    "ContributionAndProofTopic",
+    "BlobSidecarTopic",
+    "BlsToExecutionChangeTopic",
+    "PayloadAttributesTopic",
+    "HeadEvent",
+    "BlockEvent",
+    "FinalizedCheckpointEvent",
+    "ChainReorgEvent",
+    "BlobSidecarEvent",
+    "PayloadAttributesEvent",
+    "PayloadAttributes",
+    "topic_name",
+    "parse_event",
+]
+
+
+class Topic:
+    """(types.rs:284) — subclass with ``NAME`` and override ``parse``."""
+
+    NAME: str = ""
+
+    @staticmethod
+    def parse(obj: Any) -> Any:
+        return obj
+
+
+def topic_name(topic) -> str:
+    """Accepts a Topic class/instance or a bare string."""
+    if isinstance(topic, str):
+        return topic
+    return topic.NAME
+
+
+def parse_event(topic, obj: Any) -> Any:
+    if isinstance(topic, str):
+        return obj
+    return topic.parse(obj)
+
+
+@dataclass
+class HeadEvent:
+    slot: int
+    block: bytes
+    state: bytes
+    epoch_transition: bool
+    previous_duty_dependent_root: bytes
+    current_duty_dependent_root: bytes
+
+    @classmethod
+    def from_json(cls, obj) -> "HeadEvent":
+        return cls(
+            slot=int(obj["slot"]),
+            block=from_hex(obj["block"], 32),
+            state=from_hex(obj["state"], 32),
+            epoch_transition=bool(obj.get("epoch_transition", False)),
+            previous_duty_dependent_root=from_hex(
+                obj.get("previous_duty_dependent_root", "0x" + "00" * 32), 32
+            ),
+            current_duty_dependent_root=from_hex(
+                obj.get("current_duty_dependent_root", "0x" + "00" * 32), 32
+            ),
+        )
+
+
+@dataclass
+class BlockEvent:
+    slot: int
+    block: bytes
+    execution_optimistic: bool
+
+    @classmethod
+    def from_json(cls, obj) -> "BlockEvent":
+        return cls(
+            slot=int(obj["slot"]),
+            block=from_hex(obj["block"], 32),
+            execution_optimistic=bool(obj.get("execution_optimistic", False)),
+        )
+
+
+@dataclass
+class FinalizedCheckpointEvent:
+    block: bytes
+    state: bytes
+    epoch: int
+
+    @classmethod
+    def from_json(cls, obj) -> "FinalizedCheckpointEvent":
+        return cls(
+            block=from_hex(obj["block"], 32),
+            state=from_hex(obj["state"], 32),
+            epoch=int(obj["epoch"]),
+        )
+
+
+@dataclass
+class ChainReorgEvent:
+    slot: int
+    depth: int
+    old_head_block: bytes
+    new_head_block: bytes
+    old_head_state: bytes
+    new_head_state: bytes
+    epoch: int
+
+    @classmethod
+    def from_json(cls, obj) -> "ChainReorgEvent":
+        return cls(
+            slot=int(obj["slot"]),
+            depth=int(obj["depth"]),
+            old_head_block=from_hex(obj["old_head_block"], 32),
+            new_head_block=from_hex(obj["new_head_block"], 32),
+            old_head_state=from_hex(obj["old_head_state"], 32),
+            new_head_state=from_hex(obj["new_head_state"], 32),
+            epoch=int(obj["epoch"]),
+        )
+
+
+@dataclass
+class BlobSidecarEvent:
+    block_root: bytes
+    index: int
+    slot: int
+    kzg_commitment: bytes
+    versioned_hash: bytes
+
+    @classmethod
+    def from_json(cls, obj) -> "BlobSidecarEvent":
+        return cls(
+            block_root=from_hex(obj["block_root"], 32),
+            index=int(obj["index"]),
+            slot=int(obj["slot"]),
+            kzg_commitment=from_hex(obj["kzg_commitment"], 48),
+            versioned_hash=from_hex(obj["versioned_hash"], 32),
+        )
+
+
+@dataclass
+class PayloadAttributes:
+    """(types.rs:313) — all-fork merge with optional post-capella fields."""
+
+    timestamp: int
+    prev_randao: bytes
+    suggested_fee_recipient: bytes
+    withdrawals: list | None = None
+    parent_beacon_block_root: bytes | None = None
+
+    @classmethod
+    def from_json(cls, obj) -> "PayloadAttributes":
+        return cls(
+            timestamp=int(obj["timestamp"]),
+            prev_randao=from_hex(obj["prev_randao"], 32),
+            suggested_fee_recipient=from_hex(obj["suggested_fee_recipient"], 20),
+            withdrawals=obj.get("withdrawals"),
+            parent_beacon_block_root=(
+                from_hex(obj["parent_beacon_block_root"], 32)
+                if "parent_beacon_block_root" in obj
+                else None
+            ),
+        )
+
+
+@dataclass
+class PayloadAttributesEvent:
+    """(types.rs:299)"""
+
+    proposer_index: int
+    proposal_slot: int
+    parent_block_number: int
+    parent_block_root: bytes
+    parent_block_hash: bytes
+    payload_attributes: PayloadAttributes
+
+    @classmethod
+    def from_json(cls, obj) -> "PayloadAttributesEvent":
+        return cls(
+            proposer_index=int(obj["proposer_index"]),
+            proposal_slot=int(obj["proposal_slot"]),
+            parent_block_number=int(obj["parent_block_number"]),
+            parent_block_root=from_hex(obj["parent_block_root"], 32),
+            parent_block_hash=from_hex(obj["parent_block_hash"], 32),
+            payload_attributes=PayloadAttributes.from_json(
+                obj["payload_attributes"]
+            ),
+        )
+
+
+class HeadTopic(Topic):
+    NAME = "head"
+    parse = staticmethod(HeadEvent.from_json)
+
+
+class BlockTopic(Topic):
+    NAME = "block"
+    parse = staticmethod(BlockEvent.from_json)
+
+
+class AttestationTopic(Topic):
+    NAME = "attestation"  # payload is the fork's Attestation JSON
+
+
+class VoluntaryExitTopic(Topic):
+    NAME = "voluntary_exit"
+
+
+class FinalizedCheckpointTopic(Topic):
+    NAME = "finalized_checkpoint"
+    parse = staticmethod(FinalizedCheckpointEvent.from_json)
+
+
+class ChainReorgTopic(Topic):
+    NAME = "chain_reorg"
+    parse = staticmethod(ChainReorgEvent.from_json)
+
+
+class ContributionAndProofTopic(Topic):
+    NAME = "contribution_and_proof"
+
+
+class BlobSidecarTopic(Topic):
+    NAME = "blob_sidecar"
+    parse = staticmethod(BlobSidecarEvent.from_json)
+
+
+class BlsToExecutionChangeTopic(Topic):
+    NAME = "bls_to_execution_change"
+
+
+class PayloadAttributesTopic(Topic):
+    """(types.rs:290) — data is a fork-versioned envelope."""
+
+    NAME = "payload_attributes"
+
+    @staticmethod
+    def parse(obj) -> VersionedValue:
+        return VersionedValue(
+            version=obj.get("version", ""),
+            data=PayloadAttributesEvent.from_json(obj["data"]),
+            meta={},
+        )

@@ -99,6 +99,22 @@ class Handler(BaseHTTPRequestHandler):
             self.send_response(206)
             self.end_headers()
             return
+        if path == "/eth/v1/events":
+            # a short canned SSE stream, then EOF
+            chunks = (
+                b"event: head\n"
+                b'data: {"slot": "5", "block": "0x' + b"aa" * 32 + b'", '
+                b'"state": "0x' + b"bb" * 32 + b'"}\n\n'
+                b"event: finalized_checkpoint\n"
+                b'data: {"block": "0x' + b"cc" * 32 + b'", '
+                b'"state": "0x' + b"dd" * 32 + b'", "epoch": "9"}\n\n'
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Content-Length", str(len(chunks)))
+            self.end_headers()
+            self.wfile.write(chunks)
+            return
         if path in ROUTES:
             self._respond(200, ROUTES[path])
         else:
@@ -122,6 +138,25 @@ class Handler(BaseHTTPRequestHandler):
             return
         if self.path.startswith("/eth/v1/validator/duties/proposer"):
             self._respond(200, ROUTES["/eth/v1/validator/duties/proposer/3"])
+            return
+        if self.path.startswith("/eth/v1/validator/duties/attester"):
+            self._respond(
+                200,
+                {
+                    "dependent_root": "0x" + "11" * 32,
+                    "data": [
+                        {
+                            "pubkey": "0x" + "aa" * 48,
+                            "validator_index": "5",
+                            "committee_index": "1",
+                            "committee_length": "128",
+                            "committees_at_slot": "2",
+                            "validator_committee_index": "3",
+                            "slot": "97",
+                        }
+                    ],
+                },
+            )
             return
         self._respond(200, {})
 

@@ -1,0 +1,150 @@
+"""Async Beacon-API client tests — the same mock server as test_api.py
+driven through the aiohttp transport, plus the surface-parity pin that
+keeps the sync and async clients endpoint-for-endpoint identical (the
+reference client is async end-to-end, api_client.rs:94)."""
+
+import asyncio
+import inspect
+
+import pytest
+
+from ethereum_consensus_tpu.api import ApiError, Client, HealthStatus
+from ethereum_consensus_tpu.api.async_client import _NON_BRIDGED, AsyncClient
+from ethereum_consensus_tpu.api.events import (
+    FinalizedCheckpointEvent,
+    FinalizedCheckpointTopic,
+    HeadEvent,
+    HeadTopic,
+)
+
+from test_api import Handler, server  # noqa: F401 — the shared mock fixture
+
+
+def _endpoint_names(cls) -> set:
+    return {
+        name
+        for name, fn in vars(cls).items()
+        if not name.startswith("_")
+        and callable(fn)
+        and name not in ("get", "get_enveloped", "post", "http_get", "http_post")
+    }
+
+
+def test_surface_parity():
+    """Every sync endpoint exists on AsyncClient with the same signature —
+    the pin that the sans-io bridge can't silently drop surface."""
+    sync_names = _endpoint_names(Client)
+    async_names = _endpoint_names(AsyncClient) - {"close"}  # session lifecycle
+    assert sync_names == async_names
+    for name in sorted(sync_names):
+        sync_sig = inspect.signature(getattr(Client, name))
+        async_sig = inspect.signature(getattr(AsyncClient, name))
+        # parameters must match exactly; return annotations legitimately
+        # differ for streaming (Iterator vs AsyncIterator)
+        assert sync_sig.parameters == async_sig.parameters, name
+        if name not in _NON_BRIDGED:
+            assert asyncio.iscoroutinefunction(
+                inspect.unwrap(getattr(AsyncClient, name))
+            ) or hasattr(getattr(AsyncClient, name), "__wrapped__"), name
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_async_get_endpoints(server):  # noqa: F811
+    async def flow():
+        async with AsyncClient(server) as client:
+            details = await client.get_genesis_details()
+            root = await client.get_state_root("head")
+            vals = await client.get_validators("head")
+            header = await client.get_beacon_header_at_head()
+            envelope = await client.get_beacon_block("head")
+            status = await client.get_sync_status()
+            return details, root, vals, header, envelope, status
+
+    details, root, vals, header, envelope, status = _run(flow())
+    assert details.genesis_time == 1606824023
+    assert root == b"\xcd" * 32
+    assert vals[0].index == 7 and vals[0].balance == 32000000000
+    assert header.root == b"\xee" * 32
+    assert envelope.version == "deneb"
+    assert envelope.meta["execution_optimistic"] is False
+    assert status.head_slot == 100 and not status.is_syncing
+
+
+def test_async_concurrent_requests(server):  # noqa: F811
+    """The point of the async transport: N in-flight requests on one
+    session, no thread pool."""
+
+    async def flow():
+        async with AsyncClient(server) as client:
+            return await asyncio.gather(
+                *(client.get_state_root("head") for _ in range(16))
+            )
+
+    roots = _run(flow())
+    assert roots == [b"\xcd" * 32] * 16
+
+
+def test_async_post_and_duties(server):  # noqa: F811
+    async def flow():
+        async with AsyncClient(server) as client:
+            dependent_root, duties = await client.get_attester_duties(3, [5])
+            await client.prepare_proposers([{"validator_index": "5"}])
+            return dependent_root, duties
+
+    Handler.posts.clear()
+    dependent_root, duties = _run(flow())
+    assert dependent_root == b"\x11" * 32
+    assert duties[0].validator_index == 5
+    paths = [p for p, _, _ in Handler.posts]
+    assert "/eth/v1/validator/prepare_beacon_proposer" in paths
+
+
+def test_async_error_schema(server):  # noqa: F811
+    async def flow():
+        async with AsyncClient(server) as client:
+            await client.post_attestations([])
+
+    with pytest.raises(ApiError) as info:
+        _run(flow())
+    assert info.value.code == 400
+    assert "invalid" in str(info.value)
+
+
+def test_async_health(server):  # noqa: F811
+    async def flow():
+        async with AsyncClient(server) as client:
+            return await client.get_health()
+
+    assert _run(flow()) == HealthStatus.SYNCING
+
+
+def test_async_typed_events(server):  # noqa: F811
+    async def flow():
+        async with AsyncClient(server) as client:
+            events = []
+            stream = await client.get_events(
+                [HeadTopic, FinalizedCheckpointTopic]
+            )
+            async for name, event in stream:
+                events.append((name, event))
+            return events
+
+    events = _run(flow())
+    assert [name for name, _ in events] == ["head", "finalized_checkpoint"]
+    head, final = events[0][1], events[1][1]
+    assert isinstance(head, HeadEvent)
+    assert head.slot == 5 and head.block == b"\xaa" * 32
+    assert isinstance(final, FinalizedCheckpointEvent)
+    assert final.epoch == 9 and final.state == b"\xdd" * 32
+
+
+def test_sync_typed_events(server):  # noqa: F811
+    """The sync facade accepts typed topics too."""
+    client = Client(server)
+    events = list(client.get_events([HeadTopic, FinalizedCheckpointTopic]))
+    assert [name for name, _ in events] == ["head", "finalized_checkpoint"]
+    assert isinstance(events[0][1], HeadEvent)
+    assert events[0][1].slot == 5
