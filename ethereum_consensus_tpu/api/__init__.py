@@ -7,13 +7,17 @@ from .async_client import AsyncClient  # noqa: F401
 from .client import CONSENSUS_VERSION_HEADER, Client  # noqa: F401
 from .errors import ApiError, IndexedError  # noqa: F401
 from .events import (  # noqa: F401
+    AttestationTopic,
     BlobSidecarTopic,
     BlockTopic,
+    BlsToExecutionChangeTopic,
     ChainReorgTopic,
+    ContributionAndProofTopic,
     FinalizedCheckpointTopic,
     HeadTopic,
     PayloadAttributesTopic,
     Topic,
+    VoluntaryExitTopic,
 )
 from .types import (  # noqa: F401
     AttestationDuty,

@@ -228,10 +228,12 @@ class AsyncClient:
             self._url(path), json=payload, headers=headers
         ) as response:
             await self._raise_for_api_error(response)
-            try:
-                return await response.json()
-            except Exception:  # empty-ok bodies
-                return None
+            text = await response.text()
+            if not text.strip():
+                return None  # empty-ok bodies (most pool/validator POSTs)
+            # non-empty bodies must parse: surfacing the decode error here
+            # beats the TypeError a replayed endpoint body would hit on None
+            return json.loads(text)
 
     async def post(self, path: str, payload=None, headers=None) -> None:
         await self.http_post(path, payload, headers=headers)

@@ -99,6 +99,9 @@ def process_pending_consolidations(state, context) -> None:
 def process_effective_balance_updates(state, context) -> None:
     """(epoch_processing.rs electra process_effective_balance_updates) —
     per-validator limit depends on compounding credentials."""
+    # the ONLY spec site that mutates effective balances: drop the
+    # total-active-balance memo (helpers.get_total_active_balance)
+    state.__dict__.pop("_total_active_balance_cache", None)
     hysteresis_increment = (
         context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
     )

@@ -61,6 +61,7 @@ def initialize_beacon_state_from_eth1(
     # direct current-epoch activation is unique to genesis: drop the
     # (future-epoch-mutation-invariant) active-set cache it violates
     state.__dict__.pop("_active_idx_cache", None)
+    state.__dict__.pop("_total_active_balance_cache", None)
 
     state.genesis_validators_root = type(state).__ssz_fields__[
         "validators"

@@ -212,6 +212,9 @@ def queue_entire_balance_and_reset_validator(state, index: int) -> None:
     validator = state.validators[index]
     validator.effective_balance = 0
     validator.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+    # a (pre-active) validator's effective balance changed outside
+    # process_effective_balance_updates: drop the total memo defensively
+    state.__dict__.pop("_total_active_balance_cache", None)
     state.pending_balance_deposits.append(
         PendingBalanceDeposit(index=index, amount=balance)
     )

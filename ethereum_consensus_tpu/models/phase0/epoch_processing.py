@@ -420,6 +420,9 @@ def process_eth1_data_reset(state, context) -> None:
 def process_effective_balance_updates(state, context) -> None:
     """Hysteresis sweep over the whole registry; device twin above
     threshold (ops/sweeps.py effective_balance_updates_device)."""
+    # the ONLY spec site that mutates effective balances: drop the
+    # total-active-balance memo (helpers.get_total_active_balance)
+    state.__dict__.pop("_total_active_balance_cache", None)
     if _device_flags.sweeps_enabled(len(state.validators)):
         from ...ops import sweeps as _sweeps
 

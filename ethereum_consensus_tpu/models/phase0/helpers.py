@@ -246,8 +246,22 @@ def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
     # guarded (tens of thousands of committee lookups per epoch)
     key = (seed, context.SHUFFLE_ROUND_COUNT, len(indices))
     hit = _SHUFFLE_CACHE.get(key)
-    if hit is not None and (hit[0] is indices or hit[0] == indices):
-        return hit[1]
+    if hit is not None:
+        if hit[0] is indices:
+            # fires on every lookup within one state now that
+            # get_active_validator_indices returns a stable tuple
+            return hit[1]
+        if tuple(hit[0]) == tuple(indices):
+            # same active set from a DIFFERENT state object (fresh
+            # deserialize of the same chain position): rebind the entry
+            # so the O(n) equality check is paid once, not per lookup.
+            # Never store a caller's mutable list — an in-place edit
+            # would make the identity fast path serve a stale shuffle.
+            _SHUFFLE_CACHE[key] = (
+                indices if isinstance(indices, tuple) else tuple(indices),
+                hit[1],
+            )
+            return hit[1]
     if _device_flags.shuffle_enabled(len(indices)):
         from ...ops.shuffle import compute_shuffled_indices_device
 
@@ -257,7 +271,10 @@ def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
     # overwrite in place on key aliasing; evict only for genuinely new keys
     if key not in _SHUFFLE_CACHE and len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
         _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
-    _SHUFFLE_CACHE[key] = (list(indices), shuffled)
+    _SHUFFLE_CACHE[key] = (
+        indices if isinstance(indices, tuple) else list(indices),
+        shuffled,
+    )
     return shuffled
 
 
@@ -297,9 +314,14 @@ def compute_proposer_index(state, indices: list[int], seed: bytes, context) -> i
         i += 1
 
 
-def get_active_validator_indices(state, epoch: int) -> list[int]:
-    """Active-validator index list, cached on the state per
-    (epoch, registry length).
+def get_active_validator_indices(state, epoch: int) -> tuple[int, ...]:
+    """Active-validator index TUPLE, cached on the state per
+    (epoch, registry length). Returning the same immutable object on
+    every hit (rather than a defensive list copy) matters twice at
+    mainnet scale: the 131k-element copy itself (~0.5ms x hundreds of
+    committee lookups per block), and downstream identity-keyed caches —
+    the shuffle cache's `hit[0] is indices` fast path only fires when
+    the same object comes back each call.
 
     Soundness: every spec mutation of the activity schedule targets a
     FUTURE epoch — `compute_activation_exit_epoch` is ≥ epoch+1+lookahead
@@ -309,15 +331,27 @@ def get_active_validator_indices(state, epoch: int) -> list[int]:
     constant. Deposits append validators with far-future activation,
     changing the length key. (helpers.rs has no such cache; the sweep is
     free in Rust and 8k-element Python loops are not.)"""
-    cached = state.__dict__.get("_active_idx_cache")
+    cache = state.__dict__.get("_active_idx_cache")
     key = (epoch, len(state.validators))
-    if cached is not None and cached[0] == key:
-        return list(cached[1])  # fresh list: callers may sort/mutate
-    out = [
+    if isinstance(cache, dict):
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+    else:
+        cache = None  # legacy tuple form (pre-r5 pickles) or absent
+    out = tuple(
         i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
-    ]
-    state.__dict__["_active_idx_cache"] = (key, out)
-    return list(out)
+    )
+    if cache is None:
+        cache = {}
+        state.__dict__["_active_idx_cache"] = cache
+    elif len(cache) >= 4:
+        # epoch-boundary processing alternates previous/current epoch
+        # queries — a single slot thrashed and every rebuild broke the
+        # shuffle cache's identity fast path downstream
+        cache.pop(next(iter(cache)))
+    cache[key] = out
+    return out
 
 
 def get_validator_churn_limit(state, context) -> int:
@@ -400,11 +434,27 @@ def get_total_balance(state, indices, context) -> int:
 
 
 def get_total_active_balance(state, context) -> int:
-    return get_total_balance(
-        state,
-        get_active_validator_indices(state, get_current_epoch(state, context)),
-        context,
+    """Cached on the state per (epoch, registry length) — altair+ block
+    processing consults this per attestation via
+    get_base_reward_per_increment, and an O(registry) sum per aggregate
+    (64/block at mainnet shape) dominated block time.
+
+    Soundness: within one (epoch, registry-length) window the active set
+    is fixed (see get_active_validator_indices) and effective balances
+    only move in process_effective_balance_updates — which drops this
+    cache explicitly. Balance (non-effective) writes, exits scheduled for
+    future epochs, and slashing penalties never touch the inputs;
+    deposits change the registry length key."""
+    epoch = get_current_epoch(state, context)
+    key = (epoch, len(state.validators))
+    cached = state.__dict__.get("_total_active_balance_cache")
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    total = get_total_balance(
+        state, get_active_validator_indices(state, epoch), context
     )
+    state.__dict__["_total_active_balance_cache"] = (key, total)
+    return total
 
 
 def increase_balance(state, index: int, delta: int) -> None:

@@ -426,11 +426,15 @@ class CachedRootList(list):
 
     __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind",
                  "_elems_fresh", "_parents_registered", "_self_ref",
-                 "__weakref__")
+                 "_container_parents", "__weakref__")
 
     def __init__(self, *args):
         super().__init__(*args)
         self._root_cache: dict = {}
+        # weakrefs to Containers whose instance root cache covers this
+        # list as a field (the nested-root scheme): every mutation fires
+        # their _ssz_root_dirty. None until a parent registers.
+        self._container_parents: "list | None" = None
         # True only while every scalar-leaf container element is known
         # unchanged since the last full walk (elements notify through
         # weakref parents on __setattr__; every list mutation resets it).
@@ -471,6 +475,14 @@ def _instrument(name):
     def method(self, *args, **kwargs):
         self._root_cache.clear()
         self._elems_fresh = False
+        cps = self._container_parents
+        if cps is not None:
+            # containers whose instance root covers this list field
+            # (nested-root scheme) are now stale
+            for _ref in cps:
+                _p = _ref()
+                if _p is not None:
+                    _p._ssz_root_dirty()
         kind = self._uniform_kind
         if kind is not None:
             keep = False
@@ -1011,6 +1023,69 @@ class _ContainerMeta(type):
         return cls
 
 
+def _register_weak_parent(store: list, ref) -> None:
+    """Identity-guarded append of a parent weakref (identity, never ==:
+    weakref equality compares live referents by value)."""
+    if not any(p is ref for p in store):
+        if len(store) > 16:  # prune dead lineages
+            store[:] = [p for p in store if p() is not None]
+        store.append(ref)
+
+
+def _try_cache_nested_root(cls, value, root: bytes) -> None:
+    """Instance-root caching for NESTED containers (the general case the
+    scalar-leaf fast path can't cover): cache iff every field value is an
+    immutable scalar, a Container that itself holds a cached root (its
+    mutations notify us through the parent link installed here), or a
+    CachedRootList of immutable scalars (its instrumented mutators fire
+    _ssz_root_dirty through _container_parents). Anything else — a list
+    holding containers, a mutable buffer — leaves the value uncached and
+    every walk honest. This is what makes per-slot state roots cheap over
+    the 1,024 PendingAttestations of a mainnet epoch and over execution
+    payload headers: their subtrees stop re-merkleizing when untouched."""
+    d = value.__dict__
+    containers: list = []
+    lists: list = []
+    for k in cls.__ssz_fields__:
+        v = d.get(k)
+        t = v.__class__
+        if t is int or t is bytes or t is bool:
+            continue
+        if isinstance(v, Container):
+            if "_htr_cache" not in v.__dict__:
+                return  # child uncovered: its mutations couldn't notify
+            containers.append(v)
+        elif t is CachedRootList:
+            kind = v._uniform_kind
+            if kind is None and not all(
+                x.__class__ is int or x.__class__ is bool or x.__class__ is bytes
+                for x in v
+            ):
+                return  # container elements mutate without list notice
+            lists.append(v)
+        else:
+            return  # unknown value kind: stay conservative
+    ref = d.get("_ssz_self_ref")
+    if ref is None:
+        import weakref
+
+        ref = weakref.ref(value)
+        d["_ssz_self_ref"] = ref
+    for child in containers:
+        ps = child.__dict__.get("_ssz_parents")
+        if ps is None:
+            child.__dict__["_ssz_parents"] = [ref]
+        else:
+            _register_weak_parent(ps, ref)
+    for child in lists:
+        ps = child._container_parents
+        if ps is None:
+            child._container_parents = [ref]
+        else:
+            _register_weak_parent(ps, ref)
+    d["_htr_cache"] = root
+
+
 class Container(metaclass=_ContainerMeta):
     """SSZ container. Declare fields as class annotations whose *values* are
     SSZType descriptors::
@@ -1038,23 +1113,52 @@ class Container(metaclass=_ContainerMeta):
 
     # -- python niceties ----------------------------------------------------
     def __setattr__(self, key, value):
-        # any field write invalidates the cached root (scalar-leaf
-        # containers only pay a dict pop; others never populate it);
-        # plain-list values wrap into the root-caching list. Lists that
-        # registered as weak parents (the registry freshness scheme)
-        # lose their freshness here — THE invalidation edge that makes
-        # the walk-skip sound.
+        # any field write invalidates the cached root; plain-list values
+        # wrap into the root-caching list. Weak parents lose their
+        # covering state here — THE invalidation edge that makes both
+        # cache schemes sound: list parents (the registry freshness
+        # scheme) drop their freshness flag; container parents (the
+        # nested-root scheme) drop their instance roots transitively.
+        # Container parents only need the notification when this object
+        # actually held a cached root: a parent can only have cached
+        # while this child's root was cached (registration happens
+        # inside the parent's walk, which re-caches the child), so an
+        # already-absent cache means the ancestors are already dirty.
         d = self.__dict__
-        d.pop("_htr_cache", None)
+        had = d.pop("_htr_cache", None) is not None
         parents = d.get("_ssz_parents")
         if parents is not None:
             for ref in parents:
                 p = ref()
-                if p is not None:
+                if p is None:
+                    continue
+                if p.__class__ is CachedRootList:
                     p._elems_fresh = False
+                elif had:
+                    p._ssz_root_dirty()
         if type(value) is list:
             value = CachedRootList(value)
         object.__setattr__(self, key, value)
+
+    def _ssz_root_dirty(self) -> None:
+        """A covered child (field container or list) changed: drop the
+        instance root and propagate. The pop-guard both terminates
+        aliasing diamonds and skips ancestors that are already dirty
+        (cache present ⇒ every ancestor's cache was populated after
+        this one — see __setattr__)."""
+        d = self.__dict__
+        if d.pop("_htr_cache", None) is None:
+            return
+        parents = d.get("_ssz_parents")
+        if parents is not None:
+            for ref in parents:
+                p = ref()
+                if p is None:
+                    continue
+                if p.__class__ is CachedRootList:
+                    p._elems_fresh = False
+                else:
+                    p._ssz_root_dirty()
 
     def __eq__(self, other) -> bool:
         if type(self) is not type(other):
@@ -1096,6 +1200,15 @@ class Container(metaclass=_ContainerMeta):
         # the copy belongs to no list yet: carrying the original's weak
         # parents would make its mutations invalidate the WRONG lists
         nd.pop("_ssz_parents", None)
+        # the self-weakref points at the ORIGINAL; children registered
+        # under it would notify the wrong object
+        nd.pop("_ssz_self_ref", None)
+        if not cls.__ssz_scalar_leaf__:
+            # a nested-cached root is only sound with child->parent links
+            # installed, and the copied children aren't wired to the copy;
+            # the next walk re-caches and re-registers. (Scalar-leaf
+            # containers have no children — their cache travels.)
+            nd.pop("_htr_cache", None)
         for key, typ in cls.__ssz_fields__.items():
             v = nd[key]
             tv = v.__class__
@@ -1204,24 +1317,26 @@ class Container(metaclass=_ContainerMeta):
 
     @classmethod
     def hash_tree_root(cls, value: "Container") -> bytes:
-        if cls.__ssz_scalar_leaf__:
-            cached = value.__dict__.get("_htr_cache")
-            if cached is not None:
-                return cached
+        cached = value.__dict__.get("_htr_cache")
+        if cached is not None:
+            return cached
         chunks = b"".join(
             typ.hash_tree_root(getattr(value, key))
             for key, typ in cls.__ssz_fields__.items()
         )
         root = merkleize_chunks(chunks)
-        if cls.__ssz_scalar_leaf__ and all(
-            isinstance(value.__dict__.get(k), (int, bool, bytes))
-            for k in cls.__ssz_fields__
-        ):
-            # cache only when every field VALUE is immutable — a
-            # bytearray in a ByteVector field could mutate in place
-            # without passing through __setattr__.
-            # (bypass __setattr__, which would immediately evict it)
-            value.__dict__["_htr_cache"] = root
+        if cls.__ssz_scalar_leaf__:
+            if all(
+                isinstance(value.__dict__.get(k), (int, bool, bytes))
+                for k in cls.__ssz_fields__
+            ):
+                # cache only when every field VALUE is immutable — a
+                # bytearray in a ByteVector field could mutate in place
+                # without passing through __setattr__.
+                # (bypass __setattr__, which would immediately evict it)
+                value.__dict__["_htr_cache"] = root
+        else:
+            _try_cache_nested_root(cls, value, root)
         return root
 
     @classmethod

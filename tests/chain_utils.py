@@ -722,6 +722,7 @@ def build_fast_registry_state(validator_count: int, fork_name: str = "phase0",
         state.current_epoch_participation = [0] * validator_count
         state.inactivity_scores = [0] * validator_count
     state.__dict__.pop("_active_idx_cache", None)
+    state.__dict__.pop("_total_active_balance_cache", None)
 
     state.genesis_validators_root = type(state).__ssz_fields__[
         "validators"

@@ -229,11 +229,50 @@ def test_cached_roots_equal_cache_free_rehash_under_mutation():
             st.block_roots[rng.randrange(len(st.block_roots))] = (
                 rng.getrandbits(256).to_bytes(32, "big")
             )
-        elif roll < 0.8:
+        elif roll < 0.75:
             st.validators.append(st.validators[0].copy())
             st.balances.append(32 * 10**9)
-        elif roll < 0.9 and len(states) < 6:
+        elif roll < 0.85 and len(states) < 6:
             states.append(st.copy())
+        elif roll < 0.95:
+            # nested-root cache coverage: mutate pending attestations
+            # through every depth — bits in place, a nested checkpoint
+            # field, wholesale replacement, append/pop
+            pa_ns = phase0.build(ctx.preset)
+            pendings = rng.choice(
+                [st.previous_epoch_attestations, st.current_epoch_attestations]
+            )
+            sub = rng.random()
+            if not len(pendings) or sub < 0.3:
+                committee_len = rng.randrange(1, 9)
+                pendings.append(
+                    pa_ns.PendingAttestation(
+                        aggregation_bits=[
+                            rng.random() < 0.5 for _ in range(committee_len)
+                        ],
+                        data=pa_ns.AttestationData(
+                            slot=rng.randrange(64),
+                            index=rng.randrange(4),
+                        ),
+                        inclusion_delay=rng.randrange(1, 32),
+                        proposer_index=rng.randrange(64),
+                    )
+                )
+            elif sub < 0.5:
+                pa = pendings[rng.randrange(len(pendings))]
+                if len(pa.aggregation_bits):
+                    pa.aggregation_bits[
+                        rng.randrange(len(pa.aggregation_bits))
+                    ] = rng.random() < 0.5
+            elif sub < 0.7:
+                pa = pendings[rng.randrange(len(pendings))]
+                # deepest edge: a checkpoint field two containers down
+                pa.data.target.epoch = rng.randrange(2**20)
+            elif sub < 0.9:
+                pa = pendings[rng.randrange(len(pendings))]
+                pa.data = pa_ns.AttestationData(slot=rng.randrange(64))
+            else:
+                pendings.pop(rng.randrange(len(pendings)))
         else:
             st.slot = rng.randrange(2**20)
         if step % 10 == 9:
