@@ -330,7 +330,14 @@ def get_active_validator_indices(state, epoch: int) -> tuple[int, ...]:
     so within one (epoch, registry-length) window the active set is
     constant. Deposits append validators with far-future activation,
     changing the length key. (helpers.rs has no such cache; the sweep is
-    free in Rust and 8k-element Python loops are not.)"""
+    free in Rust and 8k-element Python loops are not.)
+
+    Contract limit: entries reflect the state AT CACHE TIME and spec
+    flows only query previous/current/next epochs — all below the
+    exit/activation scheduling horizon (current+1+lookahead). Code that
+    BOTH writes exit/activation epochs directly (bypassing
+    initiate_validator_exit) AND queries an epoch it already cached past
+    that horizon would read a stale set; no spec path does."""
     cache = state.__dict__.get("_active_idx_cache")
     key = (epoch, len(state.validators))
     if isinstance(cache, dict):
@@ -342,15 +349,18 @@ def get_active_validator_indices(state, epoch: int) -> tuple[int, ...]:
     out = tuple(
         i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
     )
-    if cache is None:
-        cache = {}
-        state.__dict__["_active_idx_cache"] = cache
-    elif len(cache) >= 4:
-        # epoch-boundary processing alternates previous/current epoch
-        # queries — a single slot thrashed and every rebuild broke the
-        # shuffle cache's identity fast path downstream
-        cache.pop(next(iter(cache)))
-    cache[key] = out
+    # REBIND a fresh dict rather than mutating in place: Container.copy()
+    # shares the state __dict__ values, so an in-place insert would leak
+    # a diverged copy's active set into the original (and vice versa) —
+    # wrong committees/proposers. Rebinding keeps each state's view
+    # frozen at copy time; the ≤4-entry rebuild only happens on a miss.
+    # Keeping a few epochs matters because boundary processing alternates
+    # previous/current-epoch queries — a single slot thrashed and every
+    # rebuild broke the shuffle cache's identity fast path downstream.
+    items = list(cache.items()) if cache else []
+    if len(items) >= 4:
+        items = items[1:]
+    state.__dict__["_active_idx_cache"] = dict(items + [(key, out)])
     return out
 
 

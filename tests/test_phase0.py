@@ -269,3 +269,26 @@ def test_genesis_skips_invalid_deposit_signatures():
     assert public_key_bytes(2) not in keys
     assert b"\x11" * 48 not in keys
     assert all(v.activation_epoch == 0 for v in state.validators)
+
+
+def test_active_index_cache_isolated_across_copies(genesis16):
+    """Regression (code-review r5): a diverged copy's active-set insert
+    must never land in the original's cache (or vice versa). The cache
+    dict is shared at copy() time, so insertion must REBIND, not mutate
+    in place — otherwise whichever object queries an epoch first poisons
+    the other with its own active set (wrong committees/proposers)."""
+    from ethereum_consensus_tpu.models.phase0 import helpers as h
+
+    state, ctx = genesis16
+    epoch = 10
+    # diverge the copy BEFORE either object has cached `epoch`
+    st2 = state.copy()
+    st2.validators[3].exit_epoch = 5  # exits well before `epoch`
+    without = h.get_active_validator_indices(st2, epoch)
+    assert 3 not in without
+    # the copy's insert must not have leaked into the original
+    assert 3 in h.get_active_validator_indices(state, epoch)
+    # nor the original's insert back into the copy
+    assert 3 not in h.get_active_validator_indices(st2, epoch)
+    # repeated queries stay stable on both objects
+    assert 3 in h.get_active_validator_indices(state, epoch)
