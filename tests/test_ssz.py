@@ -504,3 +504,71 @@ def test_freshness_never_claimed_over_mutable_buffers():
     lst2 = CachedRootList([Leaf(tag=5, data=b"\x33" * 32)])
     L.hash_tree_root(lst2)
     assert lst2._elems_fresh
+
+
+def test_bulk_registry_roots_match_and_reject_nonconforming():
+    """The cold-walk columnar bulk path (code-review r5): roots must be
+    bit-identical to the per-element path, and any value the strict
+    per-element path rejects must send the whole walk to the fallback
+    (which raises) rather than silently rooting it — truncated floats,
+    bools in uint slots, out-of-range booleans, and compensating
+    wrong-length byte vectors all poisoned _htr_cache in the first cut."""
+    import pytest
+
+    from ethereum_consensus_tpu.ssz import core as ssz
+    from ethereum_consensus_tpu.ssz.core import (
+        ByteVector,
+        CachedRootList,
+        Container,
+        List,
+        boolean,
+        uint64,
+    )
+
+    class Rec(Container):
+        key: ByteVector[48]
+        tag: uint64
+        ok: boolean
+
+    n = ssz._BULK_ROOTS_MIN
+    L = List[Rec, 1 << 24]
+
+    def fresh(mutate=None):
+        recs = [
+            Rec(key=bytes([i % 251]) * 48, tag=i, ok=i % 2 == 0)
+            for i in range(n)
+        ]
+        if mutate:
+            mutate(recs)
+        return CachedRootList(recs)
+
+    bulk = L.hash_tree_root(fresh())
+    old = ssz._BULK_ROOTS_MIN
+    ssz._BULK_ROOTS_MIN = 10**9  # force per-element
+    try:
+        assert L.hash_tree_root(fresh()) == bulk
+    finally:
+        ssz._BULK_ROOTS_MIN = old
+
+    def poke(field, value, err):
+        def mutate(recs):
+            object.__setattr__(recs[1], field, value)
+
+        with pytest.raises(err):
+            L.hash_tree_root(fresh(mutate))
+
+    poke("tag", 31.5e9, TypeError)          # float would truncate
+    poke("tag", True, TypeError)            # bool in a uint slot
+    poke("tag", -1, (ValueError, OverflowError))
+    poke("ok", 7, ValueError)               # non-boolean "truthy"
+    poke("key", b"\x00" * 47, ValueError)   # short vector
+
+    # compensating wrong lengths (47+49) must not fool a total-length
+    # check — and the failed bulk attempt must not have poisoned caches
+    def compensate(recs):
+        object.__setattr__(recs[1], "key", b"\x11" * 47)
+        object.__setattr__(recs[2], "key", b"\x22" * 49)
+
+    with pytest.raises(ValueError):
+        L.hash_tree_root(fresh(compensate))
+    assert L.hash_tree_root(fresh()) == bulk
