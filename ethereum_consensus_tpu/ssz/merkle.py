@@ -58,13 +58,21 @@ def pack_bytes(data: bytes) -> bytes:
     return data
 
 
-def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
+def merkleize_chunks(
+    chunks: bytes, limit: int | None = None, level_offset: int = 0
+) -> bytes:
     """Merkleize packed ``chunks`` (concatenated 32-byte chunks) into a root.
 
     ``limit`` is the chunk-count bound (virtual tree width); ``None`` means
     the tree width is the padded actual chunk count. Sparse padding uses the
     zero-subtree cache, so a List[..., 2**40] bound costs only ~40 extra
     hashes above the populated subtree.
+
+    ``level_offset`` declares that each input "chunk" is actually the root
+    of a full zero-padded subtree of that height, so sparse padding must
+    use ``zero_hash(level_offset + i)`` per level — the contract the
+    two-level tree memo needs to merkleize subtree mids (padding with leaf
+    zero chunks there would change every sparse root).
     """
     if len(chunks) % BYTES_PER_CHUNK != 0:
         raise ValueError(
@@ -81,13 +89,15 @@ def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
     depth = (width - 1).bit_length()
 
     if count == 0:
-        return zero_hash(depth)
+        return zero_hash(depth + level_offset)
 
     # medium-to-large flat trees: one native call walks every level
     # (the per-level Python loop pays a join + two ctypes copies per
     # level — ~3x the hash cost at randao_mixes size). Trees big enough
     # that a level would route to the DEVICE hasher keep the loop.
-    if 64 <= count < 2 * _hash_mod.DEVICE_MIN_NODES:
+    # (The native walk pads with the standard zero table, so it only
+    # applies at level_offset 0.)
+    if level_offset == 0 and 64 <= count < 2 * _hash_mod.DEVICE_MIN_NODES:
         root = _native_tree_root(chunks, depth)
         if root is not None:
             return root
@@ -96,7 +106,7 @@ def merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
     for level in range(depth):
         n = len(nodes) // BYTES_PER_CHUNK
         if n % 2 == 1:
-            nodes = nodes + zero_hash(level)
+            nodes = nodes + zero_hash(level + level_offset)
         nodes = hash_level(nodes)
     return nodes
 

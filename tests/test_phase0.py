@@ -292,3 +292,76 @@ def test_active_index_cache_isolated_across_copies(genesis16):
     assert 3 not in h.get_active_validator_indices(st2, epoch)
     # repeated queries stay stable on both objects
     assert 3 in h.get_active_validator_indices(state, epoch)
+
+
+def test_rewards_vectorized_equals_literal_randomized():
+    """The numpy rewards/penalties twin must match the literal spec loops
+    value-for-value over randomized registries: mixed activity (active /
+    exited / slashed / pending), partial participation, duplicate and
+    multi-delay attestations, leak and non-leak finality. The literal
+    path is the oracle (same pattern as the capella withdrawals sweep)."""
+    import random
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.models import phase0
+    from ethereum_consensus_tpu.models.phase0 import epoch_processing as ep
+    from ethereum_consensus_tpu.models.phase0 import helpers as h
+    from ethereum_consensus_tpu.models.phase0.slot_processing import (
+        process_slots,
+    )
+
+    rng = random.Random(0xEC5)
+    state0, ctx = chain_utils.fresh_genesis(256, "minimal")
+    ns = phase0.build(ctx.preset)
+    slots = int(ctx.SLOTS_PER_EPOCH)
+
+    for trial, leak in ((0, False), (1, True)):
+        state = state0.copy()
+        if leak:
+            # an old finalized checkpoint puts the state deep in leak
+            process_slots(state, 8 * slots, ctx)
+        else:
+            process_slots(state, slots, ctx)
+        # registry variety: exits, slashes, balance spread
+        for i in range(0, 256, 7):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = rng.choice([1, 50])
+        for i in range(0, 256, 11):
+            state.validators[i].exit_epoch = rng.randrange(1, 4)
+        for i in range(256):
+            state.validators[i].effective_balance = rng.choice(
+                [16, 24, 31, 32]
+            ) * 10**9
+        epoch = h.get_previous_epoch(state, ctx)
+        chain_utils.inject_full_epoch_pendings(state, ctx, epoch=epoch)
+        # degrade participation + vary delays/proposers for realism
+        pendings = (
+            state.previous_epoch_attestations
+            if epoch < h.get_current_epoch(state, ctx)
+            else state.current_epoch_attestations
+        )
+        for a in pendings:
+            a.inclusion_delay = rng.randrange(1, slots)
+            a.proposer_index = rng.randrange(256)
+            for j in range(len(a.aggregation_bits)):
+                if rng.random() < 0.3:
+                    a.aggregation_bits[j] = False
+        assert ep.is_in_inactivity_leak(state, ctx) == leak
+
+        lit_r, lit_p = ep._get_attestation_deltas_literal(state, ctx)
+        vec_r, vec_p = ep._attestation_deltas_vectorized(state, ctx)
+        assert [int(x) for x in vec_r] == lit_r, f"rewards diverge (trial {trial})"
+        assert [int(x) for x in vec_p] == lit_p, f"penalties diverge (trial {trial})"
+
+        # and the applied balances must agree end-to-end
+        s_lit, s_vec = state.copy(), state.copy()
+        old_min = ep._VECTORIZED_REWARDS_MIN_N
+        try:
+            ep._VECTORIZED_REWARDS_MIN_N = 10**9
+            ep.process_rewards_and_penalties(s_lit, ctx)
+            ep._VECTORIZED_REWARDS_MIN_N = 1
+            ep.process_rewards_and_penalties(s_vec, ctx)
+        finally:
+            ep._VECTORIZED_REWARDS_MIN_N = old_min
+        assert list(s_lit.balances) == list(s_vec.balances)

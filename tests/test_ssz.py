@@ -572,3 +572,49 @@ def test_bulk_registry_roots_match_and_reject_nonconforming():
     with pytest.raises(ValueError):
         L.hash_tree_root(fresh(compensate))
     assert L.hash_tree_root(fresh()) == bulk
+
+
+def test_two_level_tree_memo_sparse_limit_and_incremental_edits():
+    """Regression (code-review r5, consensus-critical): the two-level tree
+    memo must produce the SAME root as a single merkleize over the sparse
+    list limit — its top tree pads with zero-SUBTREE hashes, not leaf
+    zeros (the first cut returned wrong roots for every count<limit
+    registry above 16,384 elements) — and must stay correct across
+    incremental edits, appends, and a shrink."""
+    from ethereum_consensus_tpu.ssz import core as ssz
+    from ethereum_consensus_tpu.ssz.core import (
+        CachedRootList,
+        Container,
+        List,
+        uint64,
+    )
+    from ethereum_consensus_tpu.ssz.merkle import merkleize_chunks, mix_in_length
+
+    class Rec(Container):
+        a: uint64
+        b: uint64
+
+    n = (ssz._TREE_TWO_LEVEL_MIN_BYTES // 32) + 77  # past threshold, ragged
+    L = List[Rec, 2**24]  # sparse: count << limit, limit % sub == 0
+
+    lst = CachedRootList([Rec(a=i, b=i ^ 0xFF) for i in range(n)])
+
+    def ground_truth():
+        joined = b"".join(Rec.hash_tree_root(Rec(a=v.a, b=v.b)) for v in lst)
+        return mix_in_length(merkleize_chunks(joined, limit=2**24), len(lst))
+
+    r_cold = L.hash_tree_root(lst)
+    assert r_cold == ground_truth()
+    # warm walk with a mid-list edit: engages the two-level mids path
+    lst[n // 2].a = 999_999
+    assert L.hash_tree_root(lst) == ground_truth()
+    # second edit reuses the stored mids for untouched groups
+    lst[17].b = 123
+    assert L.hash_tree_root(lst) == ground_truth()
+    # append crosses into a new (padded) group
+    lst.append(Rec(a=1, b=2))
+    n += 1
+    assert L.hash_tree_root(lst) == ground_truth()
+    # shrink back
+    lst.pop()
+    assert L.hash_tree_root(lst) == ground_truth()
