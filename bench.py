@@ -507,14 +507,16 @@ def bench_epoch_mainnet(validators: int = 1 << 17):
         chain_utils.inject_full_epoch_pendings(state, ctx, epoch=0)
         return state
 
-    state = chain_utils._disk_cached(
+    loaded = chain_utils._disk_cached(
         f"epochstate-{chain_utils._FASTREG_VERSION}-mainnet-{validators}",
         ns.BeaconState.serialize,
         ns.BeaconState.deserialize,
         build,
     )
-    state = state.copy()
-    ns.BeaconState.hash_tree_root(state)  # warm the root memo
+    ns.BeaconState.hash_tree_root(loaded)  # warm the root memo
+    scratch = loaded.copy()
+    process_slots(scratch, 2 * slots, ctx)  # warm imports/caches once
+    state = loaded.copy()
     n_atts = len(state.previous_epoch_attestations)
     t0 = time.perf_counter()
     process_slots(state, 2 * slots, ctx)  # crosses one epoch boundary
@@ -523,6 +525,54 @@ def bench_epoch_mainnet(validators: int = 1 << 17):
         "validators": validators,
         "slots": slots,
         "pending_attestations": n_atts,
+        "epoch_s": epoch_s,
+        "ms_per_slot": 1e3 * epoch_s / slots,
+    }
+
+
+def bench_epoch_deneb(validators: int = 1 << 17):
+    """One full deneb epoch at mainnet-real scale — the altair-family
+    epoch path (participation-flag rewards x3 + inactivity + sync/
+    registry/slashings machinery) with FULL previous-epoch participation
+    over 131,072 validators, plus the per-slot state roots. Prepared
+    pre-boundary state is disk-cached."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import chain_utils
+
+    from ethereum_consensus_tpu.models.deneb import containers as dc
+    from ethereum_consensus_tpu.models.deneb.slot_processing import (
+        process_slots,
+    )
+
+    ctx = chain_utils.Context.for_mainnet()
+    ns = dc.build(ctx.preset)
+    slots = int(ctx.SLOTS_PER_EPOCH)
+
+    def build():
+        state, _ = chain_utils.fast_registry_state(validators, "deneb")
+        process_slots(state, slots, ctx)
+        # full epoch-0 participation (all three timely flags)
+        state.previous_epoch_participation = [0b111] * validators
+        return state
+
+    loaded = chain_utils._disk_cached(
+        f"epochstate-deneb-{chain_utils._FASTREG_VERSION}-mainnet-{validators}",
+        ns.BeaconState.serialize,
+        ns.BeaconState.deserialize,
+        build,
+    )
+    ns.BeaconState.hash_tree_root(loaded)  # warm the root memo
+    scratch = loaded.copy()
+    process_slots(scratch, 2 * slots, ctx)  # warm imports/caches once
+    state = loaded.copy()
+    t0 = time.perf_counter()
+    process_slots(state, 2 * slots, ctx)
+    epoch_s = time.perf_counter() - t0
+    return {
+        "validators": validators,
+        "slots": slots,
+        "fork": "deneb",
+        "full_participation": True,
         "epoch_s": epoch_s,
         "ms_per_slot": 1e3 * epoch_s / slots,
     }
@@ -741,6 +791,7 @@ CONFIGS = [
     ("process_block_deneb", bench_process_block_deneb),
     ("process_block_electra", bench_process_block_electra),
     ("epoch_mainnet", bench_epoch_mainnet),
+    ("epoch_deneb", bench_epoch_deneb),
     # the single heaviest cold-cache build (2^20-validator registry):
     # after the priority numbers, and self-bounding via _child_elapsed
     ("state_htr", bench_state_htr),

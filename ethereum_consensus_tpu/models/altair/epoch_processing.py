@@ -34,6 +34,97 @@ __all__ = [
 ]
 
 
+# below this registry size the numpy column extraction costs more than
+# the Python loops it replaces (mirrors phase0's threshold)
+_VECTORIZED_DELTAS_MIN_N = 1 << 12
+
+
+def _host_deltas_vectorized(state, context, hm, inactivity_quotient_name):
+    """numpy host twin of the altair-family delta sweeps (flag deltas x3 +
+    inactivity penalties) over validator columns — identical integer
+    semantics to the literal helpers (which stay the oracle, the
+    small-registry path, and the spec-test rewards surface). Products
+    stay inside uint64: base_reward < 2^26, unslashed increments < 2^23,
+    weights <= 64 (an effective_balance x inactivity_score product that
+    could reach 2^63 falls back per-index)."""
+    import numpy as np
+
+    from ...ops.registry_columns import pack_registry
+    from .constants import TIMELY_HEAD_FLAG_INDEX, WEIGHT_DENOMINATOR
+
+    n = len(state.validators)
+    prev = hm.get_previous_epoch(state, context)
+    cur = hm.get_current_epoch(state, context)
+    packed = pack_registry(
+        state, prev, use_current_participation=(prev == cur)
+    )
+    part = packed["previous_participation"]
+    eff = packed["effective_balance"]
+    slashed = packed["slashed"]
+    active_prev = packed["active_previous"]
+    eligible = packed["eligible"]
+
+    increment = int(context.EFFECTIVE_BALANCE_INCREMENT)
+    brpi = np.uint64(hm.get_base_reward_per_increment(state, context))
+    base_reward = (eff // np.uint64(increment)) * brpi
+    active_increments = (
+        int(hm.get_total_active_balance(state, context)) // increment
+    )
+    leaking = hm.is_in_inactivity_leak(state, context)
+    denom_w = np.uint64(WEIGHT_DENOMINATOR)
+
+    out = []
+    target_unslashed = None
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        unslashed = (
+            active_prev
+            & ~slashed
+            & ((part >> np.uint8(flag_index)) & np.uint8(1)).astype(bool)
+        )
+        if flag_index == TIMELY_TARGET_FLAG_INDEX:
+            target_unslashed = unslashed
+        rewards = np.zeros(n, dtype=np.uint64)
+        penalties = np.zeros(n, dtype=np.uint64)
+        attesting = eligible & unslashed
+        if not leaking:
+            # get_total_balance floors at one increment
+            unslashed_increments = (
+                max(increment, int(eff[unslashed].sum())) // increment
+            )
+            rewards[attesting] = (
+                base_reward[attesting]
+                * np.uint64(weight)
+                * np.uint64(unslashed_increments)
+            ) // np.uint64(active_increments * WEIGHT_DENOMINATOR)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            absent = eligible & ~unslashed
+            penalties[absent] = (
+                base_reward[absent] * np.uint64(weight) // denom_w
+            )
+        out.append((rewards, penalties))
+
+    scores = packed["inactivity_scores"]
+    missed = eligible & ~target_unslashed
+    denominator = int(context.inactivity_score_bias) * int(
+        getattr(context, inactivity_quotient_name)
+    )
+    penalties = np.zeros(n, dtype=np.uint64)
+    if n == 0 or int(eff.max()) * int(scores.max()) < 2**64:
+        penalties[missed] = (
+            eff[missed] * scores[missed] // np.uint64(denominator)
+        )
+    else:  # pathological scores: exact per-index Python ints, clamped to
+        # the u64 lane — a penalty at the clamp already saturates any
+        # real balance to zero, so the applied result is unchanged
+        u64_max = 2**64 - 1
+        for i in np.nonzero(missed)[0]:
+            penalties[i] = min(
+                int(eff[i]) * int(scores[i]) // denominator, u64_max
+            )
+    out.append((np.zeros(n, dtype=np.uint64), penalties))
+    return out
+
+
 def process_justification_and_finalization(state, context) -> None:
     """(epoch_processing.rs:51) — target balances from participation flags."""
     current_epoch = h.get_current_epoch(state, context)
@@ -129,6 +220,36 @@ def process_rewards_and_penalties(
                 packed, context, getattr(context, inactivity_quotient_name)
             ))
         )
+    elif n >= _VECTORIZED_DELTAS_MIN_N:
+        deltas = _host_deltas_vectorized(
+            state, context, hm, inactivity_quotient_name
+        )
+        import numpy as np
+
+        # apply each (rewards, penalties) PAIR in sequence, saturating at
+        # zero between pairs — summing first and clamping once diverges
+        # for a low-balance validator whose early-pair penalty saturates
+        # before a later-pair reward lands (spec order, and the literal
+        # loop below)
+        balances = np.fromiter(state.balances, dtype=np.uint64, count=n)
+        overflowed = False
+        for rewards, penalties in deltas:
+            raised = balances + rewards
+            if bool((raised < balances).any()):
+                overflowed = True
+                break
+            balances = np.where(raised >= penalties, raised - penalties, 0)
+        if not overflowed:
+            # one instrumented slice write instead of 8n __setitem__ calls
+            state.balances[:] = balances.tolist()
+            return
+        # u64 overflow (unreachable for real balances): literal fallback
+        # raises the structured checked_add error at the exact index
+        for rewards, penalties in deltas:
+            for index in range(n):
+                hm.increase_balance(state, index, int(rewards[index]))
+                hm.decrease_balance(state, index, int(penalties[index]))
+        return
     else:
         deltas = [
             hm.get_flag_index_deltas(state, flag_index, context)

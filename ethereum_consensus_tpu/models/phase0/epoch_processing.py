@@ -357,24 +357,15 @@ def _attestation_deltas_vectorized(state, context):
     attesting increments < 2^23)."""
     import numpy as np
 
-    vals = state.validators
-    n = len(vals)
+    from ...ops.registry_columns import pack_registry
+
+    n = len(state.validators)
     prev = h.get_previous_epoch(state, context)
-    eff = np.fromiter(
-        (v.effective_balance for v in vals), dtype=np.uint64, count=n
-    )
-    slashed = np.fromiter((v.slashed for v in vals), dtype=bool, count=n)
-    activation = np.fromiter(
-        (v.activation_epoch for v in vals), dtype=np.uint64, count=n
-    )
-    exit_epoch = np.fromiter(
-        (v.exit_epoch for v in vals), dtype=np.uint64, count=n
-    )
-    withdrawable = np.fromiter(
-        (v.withdrawable_epoch for v in vals), dtype=np.uint64, count=n
-    )
-    active_prev = (activation <= prev) & (prev < exit_epoch)
-    eligible = active_prev | (slashed & (prev + 1 < withdrawable))
+    packed = pack_registry(state, prev)
+    eff = packed["effective_balance"]
+    slashed = packed["slashed"]
+    active_prev = packed["active_previous"]
+    eligible = packed["eligible"]
 
     source_atts = get_matching_source_attestations(state, prev, context)
     target_root = h.get_block_root(state, prev, context)
@@ -492,7 +483,7 @@ def process_rewards_and_penalties(state, context) -> None:
             return
         final = np.where(raised >= penalties, raised - penalties, 0)
         # one instrumented slice write instead of 2n __setitem__ calls
-        state.balances[:] = [int(b) for b in final]
+        state.balances[:] = final.tolist()
         return
     rewards, penalties = _get_attestation_deltas_literal(state, context)
     for index in range(n):

@@ -1,0 +1,72 @@
+"""Host-side registry column extraction (jax-free).
+
+The packed columns feed BOTH the device sweeps (ops/sweeps.py — jnp twins
+of the epoch loops) and the numpy host twins
+(models/altair/epoch_processing._host_deltas_vectorized); keeping the
+eligibility formula and the genesis participation corner in ONE place
+stops the two consumers drifting (code-review r5)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pack_registry"]
+
+
+def pack_registry(state, previous_epoch: int, use_current_participation: bool = False) -> dict:
+    """Host→device packing of the registry fields the sweeps touch.
+    Activity/eligibility are evaluated at ``previous_epoch`` (the epoch the
+    deltas reward/penalize, altair helpers.rs:265).
+
+    ``use_current_participation`` covers the genesis corner where
+    previous_epoch == current_epoch and the spec's
+    get_unslashed_participating_indices reads the CURRENT epoch's flags."""
+    n = len(state.validators)
+    # phase0 states have no participation flags or inactivity scores — the
+    # sweeps that need them are altair+; zero-fill so phase0-only sweeps
+    # (effective-balance hysteresis) can share the same pack
+    participation_list = getattr(
+        state,
+        "current_epoch_participation"
+        if use_current_participation
+        else "previous_epoch_participation",
+        None,
+    )
+    if participation_list is None:
+        participation_list = [0] * n
+    inactivity_scores = getattr(state, "inactivity_scores", None)
+    if inactivity_scores is None:
+        inactivity_scores = [0] * n
+    out = {
+        "effective_balance": np.fromiter(
+            (v.effective_balance for v in state.validators), np.uint64, n
+        ),
+        "slashed": np.fromiter(
+            (bool(v.slashed) for v in state.validators), np.bool_, n
+        ),
+        "active_previous": np.fromiter(
+            (
+                v.activation_epoch <= previous_epoch < v.exit_epoch
+                for v in state.validators
+            ),
+            np.bool_,
+            n,
+        ),
+        "eligible": np.fromiter(
+            (
+                (v.activation_epoch <= previous_epoch < v.exit_epoch)
+                or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+                for v in state.validators
+            ),
+            np.bool_,
+            n,
+        ),
+        "previous_participation": np.fromiter(
+            (int(f) for f in participation_list), np.uint8, n
+        ),
+        "inactivity_scores": np.fromiter(
+            (int(s) for s in inactivity_scores), np.uint64, n
+        ),
+        "balances": np.fromiter((int(b) for b in state.balances), np.uint64, n),
+    }
+    return out

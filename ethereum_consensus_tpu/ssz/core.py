@@ -841,8 +841,8 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             sub = _TREE_SUB_CHUNKS
             bs = sub * BYTES_PER_CHUNK
             nsub = (len(chunks) + bs - 1) // bs
-            old = memo[0] if memo is not None else b""
-            old_mids = memo[2] if memo is not None and len(memo) > 2 else b""
+            old = memo[0]
+            old_mids = memo[2] if len(memo) > 2 else b""  # cold memo: 2-tuple
             mids = bytearray(nsub * 32)
             for i in range(nsub):
                 seg = chunks[i * bs : (i + 1) * bs]

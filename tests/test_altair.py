@@ -183,3 +183,71 @@ def test_altair_state_hash_tree_root_changes_with_participation():
     root_before = type(a).hash_tree_root(a)
     a.current_epoch_participation[0] = 1
     assert type(a).hash_tree_root(a) != root_before
+
+
+def test_altair_deltas_vectorized_equals_literal_randomized():
+    """The numpy host twin of the altair-family delta sweeps must match
+    the literal helpers value-for-value over randomized registries:
+    mixed activity/slashes, random participation flags, random inactivity
+    scores, leak and non-leak. The literal path is the oracle (same
+    pattern as the phase0 rewards twin)."""
+    import random
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.models.altair import epoch_processing as ep
+    from ethereum_consensus_tpu.models.altair import helpers as ah
+    from ethereum_consensus_tpu.models.altair.constants import (
+        PARTICIPATION_FLAG_WEIGHTS,
+    )
+    from ethereum_consensus_tpu.models.altair.slot_processing import (
+        process_slots,
+    )
+
+    rng = random.Random(0xA17A)
+    state0, ctx = chain_utils.fresh_genesis_altair(256, "minimal")
+    slots = int(ctx.SLOTS_PER_EPOCH)
+
+    for trial, leak in ((0, False), (1, True)):
+        state = state0.copy()
+        process_slots(state, (8 * slots) if leak else slots, ctx)
+        for i in range(0, 256, 7):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = rng.choice([1, 50])
+        for i in range(0, 256, 11):
+            state.validators[i].exit_epoch = rng.randrange(1, 4)
+        for i in range(256):
+            state.validators[i].effective_balance = (
+                rng.choice([16, 24, 31, 32]) * 10**9
+            )
+            state.previous_epoch_participation[i] = rng.randrange(8)
+            state.inactivity_scores[i] = rng.randrange(0, 200)
+        for i in range(0, 256, 13):
+            # near-zero balances force PER-PAIR saturation: an early
+            # pair's penalty must clamp at 0 before a later pair's reward
+            # lands (sum-then-clamp diverges here — code-review r5)
+            state.balances[i] = rng.choice([0, 1, 1000])
+        assert ah.is_in_inactivity_leak(state, ctx) == leak
+
+        vec = ep._host_deltas_vectorized(
+            state, ctx, ah, "INACTIVITY_PENALTY_QUOTIENT_ALTAIR"
+        )
+        lit = [
+            ah.get_flag_index_deltas(state, flag_index, ctx)
+            for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
+        ]
+        lit.append(ah.get_inactivity_penalty_deltas(state, ctx))
+        for comp, ((vr, vp), (lr, lp)) in enumerate(zip(vec, lit)):
+            assert [int(x) for x in vr] == list(lr), f"rewards {comp} trial {trial}"
+            assert [int(x) for x in vp] == list(lp), f"penalties {comp} trial {trial}"
+
+        s_lit, s_vec = state.copy(), state.copy()
+        old = ep._VECTORIZED_DELTAS_MIN_N
+        try:
+            ep._VECTORIZED_DELTAS_MIN_N = 10**9
+            ep.process_rewards_and_penalties(s_lit, ctx)
+            ep._VECTORIZED_DELTAS_MIN_N = 1
+            ep.process_rewards_and_penalties(s_vec, ctx)
+        finally:
+            ep._VECTORIZED_DELTAS_MIN_N = old
+        assert list(s_lit.balances) == list(s_vec.balances)
