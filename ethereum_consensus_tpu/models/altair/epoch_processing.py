@@ -58,10 +58,7 @@ def _host_deltas_vectorized(state, context, hm, inactivity_quotient_name):
     packed = pack_registry(
         state, prev, use_current_participation=(prev == cur)
     )
-    part = packed["previous_participation"]
     eff = packed["effective_balance"]
-    slashed = packed["slashed"]
-    active_prev = packed["active_previous"]
     eligible = packed["eligible"]
 
     increment = int(context.EFFECTIVE_BALANCE_INCREMENT)
@@ -73,14 +70,12 @@ def _host_deltas_vectorized(state, context, hm, inactivity_quotient_name):
     leaking = hm.is_in_inactivity_leak(state, context)
     denom_w = np.uint64(WEIGHT_DENOMINATOR)
 
+    from ...ops.registry_columns import unslashed_flag_mask
+
     out = []
     target_unslashed = None
     for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-        unslashed = (
-            active_prev
-            & ~slashed
-            & ((part >> np.uint8(flag_index)) & np.uint8(1)).astype(bool)
-        )
+        unslashed = unslashed_flag_mask(packed, flag_index)
         if flag_index == TIMELY_TARGET_FLAG_INDEX:
             target_unslashed = unslashed
         rewards = np.zeros(n, dtype=np.uint64)
@@ -164,9 +159,43 @@ def process_inactivity_updates(state, context) -> None:
         for i, score in enumerate(scores):
             state.inactivity_scores[i] = int(score)
         return
+    n = len(state.validators)
+    prev_epoch = h.get_previous_epoch(state, context)
+    if n >= _VECTORIZED_DELTAS_MIN_N:
+        import numpy as np
+
+        from ...ops.registry_columns import pack_registry
+
+        packed = pack_registry(
+            state, prev_epoch,
+            use_current_participation=(prev_epoch == current_epoch),
+        )
+        scores = packed["inactivity_scores"]
+        bias = int(context.inactivity_score_bias)
+        if n == 0 or int(scores.max()) < 2**64 - bias:
+            from ...ops.registry_columns import unslashed_flag_mask
+
+            participating = unslashed_flag_mask(
+                packed, TIMELY_TARGET_FLAG_INDEX
+            )
+            eligible = packed["eligible"]
+            new = scores.copy()
+            hit = eligible & participating
+            new[hit] -= np.minimum(np.uint64(1), new[hit])
+            miss = eligible & ~participating
+            new[miss] += np.uint64(bias)
+            if not h.is_in_inactivity_leak(state, context):
+                new[eligible] -= np.minimum(
+                    np.uint64(int(context.inactivity_score_recovery_rate)),
+                    new[eligible],
+                )
+            # one instrumented slice write instead of up to 2n setitems
+            state.inactivity_scores[:] = new.tolist()
+            return
+        # pathological near-2^64 scores: exact literal loop below
     eligible = h.get_eligible_validator_indices(state, context)
     unslashed_participating = h.get_unslashed_participating_indices(
-        state, TIMELY_TARGET_FLAG_INDEX, h.get_previous_epoch(state, context), context
+        state, TIMELY_TARGET_FLAG_INDEX, prev_epoch, context
     )
     not_leaking = not h.is_in_inactivity_leak(state, context)
     for index in eligible:

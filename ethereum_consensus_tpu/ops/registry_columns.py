@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["pack_registry"]
+__all__ = ["pack_registry", "unslashed_flag_mask"]
 
 
 def pack_registry(state, previous_epoch: int, use_current_participation: bool = False) -> dict:
@@ -70,3 +70,18 @@ def pack_registry(state, previous_epoch: int, use_current_participation: bool = 
         "balances": np.fromiter((int(b) for b in state.balances), np.uint64, n),
     }
     return out
+
+
+def unslashed_flag_mask(packed: dict, flag_index: int):
+    """Boolean column: active-in-previous-epoch, unslashed, and holding
+    participation ``flag_index`` — get_unslashed_participating_indices as
+    a mask. Shared by the rewards and inactivity numpy twins so the flag
+    semantics live in one place."""
+    return (
+        packed["active_previous"]
+        & ~packed["slashed"]
+        & (
+            (packed["previous_participation"] >> np.uint8(flag_index))
+            & np.uint8(1)
+        ).astype(bool)
+    )

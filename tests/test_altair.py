@@ -227,6 +227,10 @@ def test_altair_deltas_vectorized_equals_literal_randomized():
             # pair's penalty must clamp at 0 before a later pair's reward
             # lands (sum-then-clamp diverges here — code-review r5)
             state.balances[i] = rng.choice([0, 1, 1000])
+        # pathological near-2^64 inactivity scores: both sides of the
+        # vectorized overflow guard (wraparound would silently corrupt)
+        state.inactivity_scores[3] = 2**64 - 2
+        state.inactivity_scores[4] = 2**64 - 1
         assert ah.is_in_inactivity_leak(state, ctx) == leak
 
         vec = ep._host_deltas_vectorized(
@@ -237,17 +241,25 @@ def test_altair_deltas_vectorized_equals_literal_randomized():
             for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
         ]
         lit.append(ah.get_inactivity_penalty_deltas(state, ctx))
+        u64_max = 2**64 - 1
         for comp, ((vr, vp), (lr, lp)) in enumerate(zip(vec, lit)):
             assert [int(x) for x in vr] == list(lr), f"rewards {comp} trial {trial}"
-            assert [int(x) for x in vp] == list(lp), f"penalties {comp} trial {trial}"
+            # the vectorized lane clamps pathological penalties at u64
+            # max (applied result identical: both saturate balances to 0)
+            assert [int(x) for x in vp] == [
+                min(int(x), u64_max) for x in lp
+            ], f"penalties {comp} trial {trial}"
 
         s_lit, s_vec = state.copy(), state.copy()
         old = ep._VECTORIZED_DELTAS_MIN_N
         try:
             ep._VECTORIZED_DELTAS_MIN_N = 10**9
             ep.process_rewards_and_penalties(s_lit, ctx)
+            ep.process_inactivity_updates(s_lit, ctx)
             ep._VECTORIZED_DELTAS_MIN_N = 1
             ep.process_rewards_and_penalties(s_vec, ctx)
+            ep.process_inactivity_updates(s_vec, ctx)
         finally:
             ep._VECTORIZED_DELTAS_MIN_N = old
         assert list(s_lit.balances) == list(s_vec.balances)
+        assert list(s_lit.inactivity_scores) == list(s_vec.inactivity_scores)
