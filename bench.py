@@ -843,6 +843,15 @@ def child_main() -> None:
         results[name] = out
         checkpoint()
         _note(f"config {name} done in {out['wall_s']}s")
+        # earlier configs leave multi-hundred-MB states pinned in lru
+        # caches; without freezing them out of the GC's tracked set,
+        # gen-2 collections during a later config's million-object walk
+        # cost ~10x its real time (measured: state_htr cold walk 6s
+        # standalone vs 60s late in the child)
+        import gc
+
+        gc.collect()
+        gc.freeze()
 
 
 # ---------------------------------------------------------------------------

@@ -166,13 +166,18 @@ def process_inactivity_updates(state, context) -> None:
 
         from ...ops.registry_columns import pack_registry
 
-        packed = pack_registry(
-            state, prev_epoch,
-            use_current_participation=(prev_epoch == current_epoch),
+        # extract the scores FIRST: if the overflow guard trips, the
+        # literal loop re-reads everything anyway and a full 7-column
+        # pack would be wasted work
+        scores = np.fromiter(
+            (int(s) for s in state.inactivity_scores), np.uint64, n
         )
-        scores = packed["inactivity_scores"]
         bias = int(context.inactivity_score_bias)
-        if n == 0 or int(scores.max()) < 2**64 - bias:
+        if int(scores.max()) < 2**64 - bias:
+            packed = pack_registry(
+                state, prev_epoch,
+                use_current_participation=(prev_epoch == current_epoch),
+            )
             from ...ops.registry_columns import unslashed_flag_mask
 
             participating = unslashed_flag_mask(

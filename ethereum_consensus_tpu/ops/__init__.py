@@ -36,10 +36,15 @@ def install(
     shuffle_min_n: int = DEFAULT_SHUFFLE_MIN_N,
     bls_agg_min_n: int = DEFAULT_BLS_AGG_MIN_N,
     pairing_min_sets: "int | None" = DEFAULT_PAIRING_MIN_SETS,
+    hasher_on_cpu: bool = False,
 ) -> None:
     """Install all device fast paths into the host layers:
 
-    * SHA-256 hash levels above ssz.hash.DEVICE_MIN_NODES (merkleization);
+    * SHA-256 hash levels above ssz.hash.DEVICE_MIN_NODES (merkleization)
+      — on a REAL accelerator only: with a cpu default backend the jnp
+      compression is ~30x slower than the native C++ hasher, so routing
+      is skipped unless ``hasher_on_cpu`` forces it (device-wiring tests
+      / deliberate jnp-hasher benches);
     * epoch-processing registry sweeps (altair+ flag deltas, inactivity
       updates/penalties, effective-balance hysteresis) above
       ``sweeps_min_n`` validators;
@@ -54,7 +59,7 @@ def install(
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    install_device_hasher()
+    install_device_hasher(force=hasher_on_cpu)
     _device_flags.SWEEPS_MIN_N = sweeps_min_n
     _device_flags.SHUFFLE_MIN_N = shuffle_min_n
     _device_flags.BLS_AGG_MIN_N = bls_agg_min_n

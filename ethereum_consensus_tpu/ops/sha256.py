@@ -265,8 +265,19 @@ def hash_level_bytes(nodes: bytes) -> bytes:
     return out.T.astype(">u4").tobytes()
 
 
-def install_device_hasher() -> None:
-    """Route ssz merkleization's large levels through the device backend."""
+def install_device_hasher(force: bool = False) -> None:
+    """Route ssz merkleization's large levels through the device backend.
+
+    No-op on a CPU default backend unless ``force``: the jnp compression
+    there is ~30x slower than the native C++ hasher, and a degraded
+    (chip-less) ``ops.install()`` was silently poisoning every
+    subsequent big merkle level in the process — measured 6.3s vs 0.2s
+    per 2^19-pair level, which turned the 2^20-registry cold walk from
+    6s into 59s once any config had installed device routing."""
+    import jax
+
+    if jax.default_backend() == "cpu" and not force:
+        return
     from ..ssz.hash import register_device_hasher
 
     register_device_hasher(hash_level_bytes)
