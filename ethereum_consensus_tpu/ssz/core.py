@@ -798,10 +798,21 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         and len(values) >= _BULK_ROOTS_MIN
         and values._root_cache.get(("tree", elem, limit_elems)) is None
     ):
-        # no memo yet = a cold walk (fresh deserialize / first root):
-        # every element root must be built, which the columnar bulk path
-        # does at native speed; warm walks keep the incremental path
-        chunks = _bulk_scalar_leaf_roots(elem, values)
+        # no memo yet = a cold-LIST walk: a fresh deserialize (elements
+        # cold too) or a fresh CachedRootList wrapped around
+        # ALREADY-CACHED elements (validating-constructor / fork-upgrade
+        # paths; state.copy() itself carries the memo and skips this
+        # branch entirely). The columnar bulk path rebuilds every element
+        # root at native speed — right for the cold elements, several
+        # times slower than the probing join when the elements carry
+        # their roots; sample a few elements to tell the cases apart
+        n_v = len(values)
+        step = max(1, n_v // 8)
+        if any(
+            "_htr_cache" not in values[i].__dict__
+            for i in range(0, n_v, step)
+        ):
+            chunks = _bulk_scalar_leaf_roots(elem, values)
     if chunks is None:
         if freshable:
             # warm incremental join: most elements hold a cached root

@@ -618,3 +618,37 @@ def test_two_level_tree_memo_sparse_limit_and_incremental_edits():
     # shrink back
     lst.pop()
     assert L.hash_tree_root(lst) == ground_truth()
+
+
+def test_cold_list_over_cached_elements_joins_bit_identical():
+    """A memo-less CachedRootList wrapped around ALREADY-CACHED elements
+    (fork-upgrade / constructor paths) takes the probing-join branch, not
+    the columnar rebuild — and must produce the identical root (r5
+    review: this branch was unpinned)."""
+    from ethereum_consensus_tpu.ssz import core as ssz
+    from ethereum_consensus_tpu.ssz.core import (
+        ByteVector,
+        CachedRootList,
+        Container,
+        List,
+        uint64,
+    )
+
+    class Rec(Container):
+        key: ByteVector[48]
+        tag: uint64
+
+    n = ssz._BULK_ROOTS_MIN
+    L = List[Rec, 1 << 24]
+    recs = [Rec(key=bytes([i % 251]) * 48, tag=i) for i in range(n)]
+    cold = CachedRootList(recs)
+    want = L.hash_tree_root(cold)  # bulk path: caches every element root
+    assert all("_htr_cache" in r.__dict__ for r in recs)
+    rewrapped = CachedRootList(recs)  # fresh list, warm elements, no memo
+    assert L.hash_tree_root(rewrapped) == want
+    # and a mutation hiding between sample strides is still honored
+    # (__setattr__ pops the element cache; the join recomputes it)
+    recs[7].tag = 10**9
+    want2 = L.hash_tree_root(CachedRootList([r.copy() for r in recs]))
+    assert L.hash_tree_root(CachedRootList(recs)) == want2
+    assert want2 != want
