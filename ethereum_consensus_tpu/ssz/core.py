@@ -426,7 +426,8 @@ class CachedRootList(list):
 
     __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind",
                  "_elems_fresh", "_parents_registered", "_self_ref",
-                 "_container_parents", "__weakref__")
+                 "_container_parents", "_mut_gen", "_pack_gen",
+                 "__weakref__")
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -450,6 +451,14 @@ class CachedRootList(list):
         # on big vectors (randao_mixes, block_roots, state_roots) into a
         # C-speed memcmp instead of a full tree rebuild.
         self._pack_memo: "tuple | None" = None
+        # mutation generation + the generation the pack memo was taken
+        # at: when they match AND the uniformity verdict certifies every
+        # element immutable, the memo root is served without even
+        # re-packing (the re-pack of a 131k-int balances list per state
+        # root was the hot line of epoch slot processing). Mutators bump
+        # _mut_gen; only successful packs advance _pack_gen.
+        self._mut_gen: int = 0
+        self._pack_gen: int = -1
         # uniformity verdict — ("bytes", L): every element is `bytes` of
         # exactly length L; ("int",): every element is a plain int.
         # Established by a full scan at hash time and MAINTAINED by the
@@ -475,6 +484,7 @@ def _instrument(name):
     def method(self, *args, **kwargs):
         self._root_cache.clear()
         self._elems_fresh = False
+        self._mut_gen += 1
         cps = self._container_parents
         if cps is not None:
             # containers whose instance root covers this list field
@@ -584,6 +594,9 @@ def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
     memo = values._pack_memo
     if memo is not None and memo[0] == key:
         if memo[1] == packed:
+            # byte-identical repack: refresh the generation stamp so the
+            # NEXT walk can skip the repack entirely (gen fast path)
+            values._pack_gen = values._mut_gen
             return memo[2]
         if two_level and len(memo) == 5 and len(memo[1]) == len(packed):
             _, old, _, mids, sub_chunks = memo
@@ -608,6 +621,7 @@ def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
             mids = bytes(new_mids)
             root = merkleize_chunks(mids, limit=nsub)
             values._pack_memo = (key, packed, root, mids, sub_chunks)
+            values._pack_gen = values._mut_gen
             return root
     if two_level:
         depth = count.bit_length() - 1
@@ -619,9 +633,11 @@ def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
         mids = nodes
         root = merkleize_chunks(mids, limit=count // sub_chunks)
         values._pack_memo = (key, packed, root, mids, sub_chunks)
+        values._pack_gen = values._mut_gen
         return root
     root = merkleize_chunks(packed, limit=limit)
     values._pack_memo = (key, packed, root)
+    values._pack_gen = values._mut_gen
     return root
 
 
@@ -711,8 +727,29 @@ def _bulk_scalar_leaf_roots(elem_cls, values) -> "bytes | None":
     return nodes
 
 
+def _pack_memo_gen_hit(values, key) -> bool:
+    """True when the pack memo can be served WITHOUT re-packing: nothing
+    mutated the list since the memo was stored (generation match — the
+    instrumented mutators are the only mutation channel once the
+    uniformity verdict certifies every element immutable) and the memo
+    belongs to this (descriptor, limit)."""
+    return (
+        isinstance(values, CachedRootList)
+        and values._uniform_kind is not None
+        and values._pack_gen == values._mut_gen
+        and values._pack_memo is not None
+        and values._pack_memo[0] == key
+    )
+
+
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
+        limit = (
+            limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1
+        ) // BYTES_PER_CHUNK
+        key = ("u", elem, limit)
+        if _pack_memo_gen_hit(values, key):
+            return values._pack_memo[2]
         all_int = getattr(values, "_uniform_kind", None) == ("int",)
         if not all_int and values and set(map(type, values)) == {int}:
             all_int = True  # C-speed scan; keeps serialize()'s
@@ -734,8 +771,7 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                 packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
         else:
             packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
-        limit = (limit_elems * elem.fixed_size() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
-        return _merkleize_packed_memo(values, ("u", elem, limit), packed, limit)
+        return _merkleize_packed_memo(values, key, packed, limit)
     if isinstance(elem, ByteVector) and elem.length == BYTES_PER_CHUNK:
         # a 32-byte vector's root IS its bytes — and the validation runs
         # at C speed (join rejects non-bytes with TypeError; the len-set
@@ -751,6 +787,9 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         # length rejects sized buffer objects whose len() isn't their
         # byte size (array.array('I', …)/memoryview of wider items would
         # fool the len-set alone)
+        b32_key = ("b32", elem, limit_elems)
+        if _pack_memo_gen_hit(values, b32_key):
+            return values._pack_memo[2]
         if getattr(values, "_uniform_kind", None) == ("bytes", BYTES_PER_CHUNK):
             sizes_ok = True  # full scan done once; mutators maintain it
         else:
@@ -777,7 +816,7 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                     # set after one full type scan; mutators keep it
                     values._uniform_kind = ("bytes", BYTES_PER_CHUNK)
                 return _merkleize_packed_memo(
-                    values, ("b32", elem, limit_elems), chunks, limit_elems
+                    values, b32_key, chunks, limit_elems
                 )
     freshable = (
         isinstance(values, CachedRootList)
@@ -1557,6 +1596,11 @@ def _copy_value(typ: SSZType, value: Any):
             copied._root_cache = dict(value._root_cache)
             copied._pack_memo = value._pack_memo  # immutable tuple: shared
             copied._uniform_kind = value._uniform_kind
+            # the generation pair travels too: the copy's memo is exactly
+            # as fresh as the original's was at copy time, and the copy's
+            # own instrumented mutators bump only ITS counter
+            copied._mut_gen = value._mut_gen
+            copied._pack_gen = value._pack_gen
         return copied
     return value
 
