@@ -29,28 +29,12 @@ __all__ = ["process_registry_updates", "process_epoch"]
 
 def process_registry_updates(state, context) -> None:
     """(epoch_processing.rs:11) — activations bounded by the EIP-7514
-    activation churn limit instead of the exit churn limit."""
-    current_epoch = h.get_current_epoch(state, context)
-    for index, validator in enumerate(state.validators):
-        if h.is_eligible_for_activation_queue(validator, context):
-            validator.activation_eligibility_epoch = current_epoch + 1
-        if (
-            h.is_active_validator(validator, current_epoch)
-            and validator.effective_balance <= context.ejection_balance
-        ):
-            h.initiate_validator_exit(state, index, context)
+    activation churn limit instead of the exit churn limit; the scan
+    itself is the shared (vectorized) phase0 sweep."""
+    from ..phase0.epoch_processing import registry_scan_and_queue
 
-    activation_queue = sorted(
-        (
-            index
-            for index, v in enumerate(state.validators)
-            if h.is_eligible_for_activation(state, v)
-        ),
-        key=lambda index: (
-            state.validators[index].activation_eligibility_epoch,
-            index,
-        ),
-    )
+    current_epoch = h.get_current_epoch(state, context)
+    activation_queue = registry_scan_and_queue(state, context)
     churn_limit = h.get_validator_activation_churn_limit(state, context)
     activation_epoch = h.compute_activation_exit_epoch(current_epoch, context)
     for index in activation_queue[:churn_limit]:

@@ -365,3 +365,67 @@ def test_rewards_vectorized_equals_literal_randomized():
         finally:
             ep._VECTORIZED_REWARDS_MIN_N = old_min
         assert list(s_lit.balances) == list(s_vec.balances)
+
+
+def test_registry_updates_vectorized_equals_literal_randomized():
+    """The numpy registry-updates scan must match the literal loop over
+    randomized registries: queue entries, ejections (whose exit-epoch
+    churn accumulates order-dependently), and churn-limited activations.
+    The literal path is the oracle."""
+    import random
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.models import phase0
+    from ethereum_consensus_tpu.models.phase0 import epoch_processing as ep
+    from ethereum_consensus_tpu.models.phase0.slot_processing import (
+        process_slots,
+    )
+    from ethereum_consensus_tpu.primitives import FAR_FUTURE_EPOCH
+
+    rng = random.Random(0x51C4)
+    state0, ctx = chain_utils.fresh_genesis(256, "minimal")
+    ns = phase0.build(ctx.preset)
+    state = state0.copy()
+    process_slots(state, 6 * int(ctx.SLOTS_PER_EPOCH), ctx)
+    state.finalized_checkpoint.epoch = 4
+    for i in range(256):
+        v = state.validators[i]
+        roll = rng.random()
+        if roll < 0.2:  # fresh deposit shape: queue-entry candidates
+            v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+            v.activation_epoch = FAR_FUTURE_EPOCH
+            v.effective_balance = rng.choice(
+                [int(ctx.MAX_EFFECTIVE_BALANCE), 31 * 10**9]
+            )
+        elif roll < 0.4:  # waiting for activation at varied eligibility
+            v.activation_eligibility_epoch = rng.randrange(1, 7)
+            v.activation_epoch = FAR_FUTURE_EPOCH
+        elif roll < 0.55:  # ejection candidates
+            v.effective_balance = rng.choice(
+                [int(ctx.ejection_balance), int(ctx.ejection_balance) + 10**9]
+            )
+
+    s_lit, s_vec = state.copy(), state.copy()
+    old = ep._VECTORIZED_REWARDS_MIN_N
+    try:
+        ep._VECTORIZED_REWARDS_MIN_N = 10**9
+        ep.process_registry_updates(s_lit, ctx)
+        ep._VECTORIZED_REWARDS_MIN_N = 1
+        ep.process_registry_updates(s_vec, ctx)
+    finally:
+        ep._VECTORIZED_REWARDS_MIN_N = old
+    assert ns.BeaconState.hash_tree_root(s_lit) == ns.BeaconState.hash_tree_root(
+        s_vec
+    )
+    # spot-check the interesting fields really diverged from the input
+    changed = sum(
+        1
+        for a, b in zip(state.validators, s_lit.validators)
+        if (
+            a.activation_eligibility_epoch != b.activation_eligibility_epoch
+            or a.activation_epoch != b.activation_epoch
+            or a.exit_epoch != b.exit_epoch
+        )
+    )
+    assert changed > 0
