@@ -578,6 +578,80 @@ def bench_epoch_deneb(validators: int = 1 << 17):
     }
 
 
+def bench_epoch_electra(validators: int = 1 << 17):
+    """One full electra epoch at mainnet-real scale with the EIP-7251
+    stages carrying REAL work — not empty passes: 1,024 pending balance
+    deposits, 64 ripe pending consolidations (withdrawable sources into
+    compounding targets), 128 activation-queue entrants, 128 ejection
+    candidates, plus FULL previous-epoch participation over 131,072
+    validators. The reference cannot execute electra at all
+    (executor.rs:155-172)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import chain_utils
+
+    from ethereum_consensus_tpu.models.electra import containers as ec
+    from ethereum_consensus_tpu.models.electra.slot_processing import (
+        process_slots,
+    )
+    from ethereum_consensus_tpu.primitives import FAR_FUTURE_EPOCH
+
+    ctx = chain_utils.Context.for_mainnet()
+    ns = ec.build(ctx.preset)
+    slots = int(ctx.SLOTS_PER_EPOCH)
+
+    def build():
+        state, _ = chain_utils.fast_registry_state(validators, "electra")
+        process_slots(state, slots, ctx)
+        state.previous_epoch_participation = [0b111] * validators
+        # EIP-7251 work for the boundary: pending deposit sweep...
+        for i in range(1 << 10):
+            state.pending_balance_deposits.append(
+                ns.PendingBalanceDeposit(index=i, amount=10**9)
+            )
+        # ...ripe consolidations (sources already withdrawable; targets
+        # get compounding credentials during processing)...
+        for j in range(64):
+            src = validators - 1 - j
+            v = state.validators[src]
+            v.exit_epoch = 1
+            v.withdrawable_epoch = 1
+            state.pending_consolidations.append(
+                ns.PendingConsolidation(source_index=src, target_index=j)
+            )
+        # ...and registry-scan hits: fresh-deposit-shaped entrants plus
+        # below-ejection-balance actives
+        for k in range(128):
+            v = state.validators[1024 + k]
+            v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+            v.activation_epoch = FAR_FUTURE_EPOCH
+            w = state.validators[4096 + k]
+            w.effective_balance = int(ctx.ejection_balance)
+        return state
+
+    loaded = chain_utils._disk_cached(
+        f"epochstate-electra-{chain_utils._FASTREG_VERSION}-mainnet-"
+        f"{validators}",
+        ns.BeaconState.serialize,
+        ns.BeaconState.deserialize,
+        build,
+    )
+    ns.BeaconState.hash_tree_root(loaded)  # warm the root memo
+    scratch = loaded.copy()
+    process_slots(scratch, 2 * slots, ctx)  # warm imports/caches once
+    state = loaded.copy()
+    t0 = time.perf_counter()
+    process_slots(state, 2 * slots, ctx)
+    epoch_s = time.perf_counter() - t0
+    return {
+        "validators": validators,
+        "slots": slots,
+        "fork": "electra",
+        "full_participation": True,
+        "epoch_s": epoch_s,
+        "ms_per_slot": 1e3 * epoch_s / slots,
+    }
+
+
 def bench_kzg(n_blobs: int = 4):
     """KZG/EIP-4844 suite timings (the reference's named perf artifact:
     batch KZG proof verification, crypto/kzg.rs:139 — c-kzg's C role is
@@ -792,6 +866,7 @@ CONFIGS = [
     ("process_block_electra", bench_process_block_electra),
     ("epoch_mainnet", bench_epoch_mainnet),
     ("epoch_deneb", bench_epoch_deneb),
+    ("epoch_electra", bench_epoch_electra),
     # the single heaviest cold-cache build (2^20-validator registry):
     # after the priority numbers, and self-bounding via _child_elapsed
     ("state_htr", bench_state_htr),

@@ -35,8 +35,33 @@ __all__ = [
 
 
 def process_registry_updates(state, context) -> None:
-    """(epoch_processing.rs electra process_registry_updates)"""
+    """(epoch_processing.rs electra process_registry_updates) — EIP-7251:
+    queue entry keys on MIN_ACTIVATION_BALANCE (>=, not == max) and every
+    finalized-eligible validator activates immediately (no churn queue).
+    Above the vectorized threshold the shared
+    ``vectorized_registry_scan`` runs with the 7251 queue-entry rule and
+    this fork's activation rule applied to its result; the literal loop
+    below is the oracle and small-registry path."""
     current_epoch = h.get_current_epoch(state, context)
+    n = len(state.validators)
+    from ..phase0.epoch_processing import (
+        _VECTORIZED_REWARDS_MIN_N,
+        vectorized_registry_scan,
+    )
+
+    if n >= _VECTORIZED_REWARDS_MIN_N:
+        activatable = vectorized_registry_scan(
+            state,
+            context,
+            queue_entry_ge_min_activation=True,
+            helpers=h,  # EIP-7251 balance-weighted exit churn
+        )
+        activation_epoch = h.compute_activation_exit_epoch(
+            current_epoch, context
+        )
+        for index in activatable:
+            state.validators[index].activation_epoch = activation_epoch
+        return
     for index, validator in enumerate(state.validators):
         if h.is_eligible_for_activation_queue(validator, context):
             validator.activation_eligibility_epoch = current_epoch + 1

@@ -496,69 +496,94 @@ def process_rewards_and_penalties(state, context) -> None:
 # ---------------------------------------------------------------------------
 
 
+def vectorized_registry_scan(
+    state,
+    context,
+    queue_entry_ge_min_activation: bool,
+    helpers,
+) -> list:
+    """Shared numpy registry sweep for every fork's registry updates:
+    performs the queue-entry writes and ejections, and returns the
+    ASCENDING indices of activation-eligible validators (callers apply
+    their fork's activation rule — phase0..deneb sort and churn-cap,
+    electra activates all). Fork knobs: the queue-entry balance rule
+    (``queue_entry_ge_min_activation`` — EIP-7251's
+    ``>= MIN_ACTIVATION_BALANCE`` vs phase0's
+    ``== MAX_EFFECTIVE_BALANCE``) and ``helpers``, whose
+    ``initiate_validator_exit`` performs the ejections — electra MUST
+    pass its own (balance-weighted exit churn, EIP-7251). Both are
+    REQUIRED — a helpers default of phase0 cost exactly that churn
+    divergence in testing, so the footgun is now structurally
+    impossible."""
+    import numpy as np
+
+    from ...primitives import FAR_FUTURE_EPOCH
+
+    hm = helpers
+    current_epoch = h.get_current_epoch(state, context)
+    n = len(state.validators)
+    vals = state.validators
+    eligibility = np.fromiter(
+        (v.activation_eligibility_epoch for v in vals),
+        dtype=np.uint64,
+        count=n,
+    )
+    activation = np.fromiter(
+        (v.activation_epoch for v in vals), dtype=np.uint64, count=n
+    )
+    exit_epoch = np.fromiter(
+        (v.exit_epoch for v in vals), dtype=np.uint64, count=n
+    )
+    eff = np.fromiter(
+        (v.effective_balance for v in vals), dtype=np.uint64, count=n
+    )
+    far = np.uint64(FAR_FUTURE_EPOCH)
+    if queue_entry_ge_min_activation:
+        balance_rule = eff >= np.uint64(int(context.MIN_ACTIVATION_BALANCE))
+    else:
+        balance_rule = eff == np.uint64(int(context.MAX_EFFECTIVE_BALANCE))
+    queue_entry = (eligibility == far) & balance_rule
+    for index in np.nonzero(queue_entry)[0]:
+        vals[index].activation_eligibility_epoch = current_epoch + 1
+    ejection = (
+        (activation <= current_epoch)
+        & (current_epoch < exit_epoch)
+        & (eff <= np.uint64(int(context.ejection_balance)))
+    )
+    for index in np.nonzero(ejection)[0]:
+        hm.initiate_validator_exit(state, int(index), context)
+    # re-read eligibility: the queue-entry writes above changed it
+    activatable = (
+        np.where(queue_entry, np.uint64(current_epoch + 1), eligibility)
+        <= np.uint64(int(state.finalized_checkpoint.epoch))
+    ) & (activation == far)
+    return [int(i) for i in np.nonzero(activatable)[0]]
+
+
 def registry_scan_and_queue(state, context) -> list:
     """The whole-registry scan behind phase0..deneb registry updates
     (queue entries, ejections, the sorted activation queue) — those
-    forks differ only in the churn limit that caps activations. NOT for
-    electra+: EIP-7251 changes the eligibility predicates themselves
-    (is_eligible_for_activation_queue keys on MIN_ACTIVATION_BALANCE),
-    and the vectorized branch below inlines the PHASE0 predicates — a
-    fork with different predicates must keep its own sweep (electra
-    does), or small and large registries would silently diverge.
+    forks differ only in the churn limit that caps activations.
+    electra+ applies different predicates and its own activation rule
+    through the shared ``vectorized_registry_scan``.
 
     Above the vectorized threshold the three whole-registry predicate
-    scans (activation-queue entry, ejection, activation eligibility) run
-    as numpy column masks and the per-validator Python work touches only
-    the (few) hits — the literal loop remains the semantics and the
-    small-registry path."""
-    current_epoch = h.get_current_epoch(state, context)
+    scans run as numpy column masks and the per-validator Python work
+    touches only the (few) hits — the literal loop remains the
+    semantics and the small-registry path."""
     n = len(state.validators)
     if n >= _VECTORIZED_REWARDS_MIN_N:
-        import numpy as np
-
-        from ...primitives import FAR_FUTURE_EPOCH
-
-        vals = state.validators
-        eligibility = np.fromiter(
-            (v.activation_eligibility_epoch for v in vals),
-            dtype=np.uint64,
-            count=n,
-        )
-        activation = np.fromiter(
-            (v.activation_epoch for v in vals), dtype=np.uint64, count=n
-        )
-        exit_epoch = np.fromiter(
-            (v.exit_epoch for v in vals), dtype=np.uint64, count=n
-        )
-        eff = np.fromiter(
-            (v.effective_balance for v in vals), dtype=np.uint64, count=n
-        )
-        far = np.uint64(FAR_FUTURE_EPOCH)
-        queue_entry = (eligibility == far) & (
-            eff == np.uint64(int(context.MAX_EFFECTIVE_BALANCE))
-        )
-        for index in np.nonzero(queue_entry)[0]:
-            vals[index].activation_eligibility_epoch = current_epoch + 1
-        ejection = (
-            (activation <= current_epoch)
-            & (current_epoch < exit_epoch)
-            & (eff <= np.uint64(int(context.ejection_balance)))
-        )
-        for index in np.nonzero(ejection)[0]:
-            h.initiate_validator_exit(state, int(index), context)
-        # re-read eligibility: the queue-entry writes above changed it
-        activatable = (
-            np.where(queue_entry, np.uint64(current_epoch + 1), eligibility)
-            <= np.uint64(int(state.finalized_checkpoint.epoch))
-        ) & (activation == far)
         activation_queue = sorted(
-            (int(i) for i in np.nonzero(activatable)[0]),
+            vectorized_registry_scan(
+                state, context, queue_entry_ge_min_activation=False, helpers=h
+            ),
             key=lambda index: (
                 state.validators[index].activation_eligibility_epoch,
                 index,
             ),
         )
     else:
+        current_epoch = h.get_current_epoch(state, context)
         for index, validator in enumerate(state.validators):
             if h.is_eligible_for_activation_queue(validator, context):
                 validator.activation_eligibility_epoch = current_epoch + 1

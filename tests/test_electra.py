@@ -266,3 +266,71 @@ def test_electra_chain_runs_one_epoch():
     assert any(f != 0 for f in state.previous_epoch_participation) or any(
         f != 0 for f in state.current_epoch_participation
     )
+
+
+def test_electra_registry_updates_vectorized_equals_literal():
+    """The electra numpy registry scan (EIP-7251 predicates: queue entry
+    at >= MIN_ACTIVATION_BALANCE, unqueued immediate activations) must
+    match the literal loop over a randomized registry; literal is the
+    oracle."""
+    import random
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.models.electra import containers as ec
+    from ethereum_consensus_tpu.models.electra import epoch_processing as eep
+    from ethereum_consensus_tpu.models.electra.slot_processing import (
+        process_slots,
+    )
+    from ethereum_consensus_tpu.models.phase0 import epoch_processing as pep
+    from ethereum_consensus_tpu.primitives import FAR_FUTURE_EPOCH
+
+    rng = random.Random(0xE7A)
+    state0, ctx = chain_utils.fresh_genesis_electra(256, "minimal")
+    ns = ec.build(ctx.preset)
+    state = state0.copy()
+    process_slots(state, 6 * int(ctx.SLOTS_PER_EPOCH), ctx)
+    state.finalized_checkpoint.epoch = 4
+    for i in range(256):
+        v = state.validators[i]
+        roll = rng.random()
+        if roll < 0.25:  # queue-entry candidates around the 7251 boundary
+            v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+            v.activation_epoch = FAR_FUTURE_EPOCH
+            v.effective_balance = rng.choice(
+                [
+                    int(ctx.MIN_ACTIVATION_BALANCE),
+                    int(ctx.MIN_ACTIVATION_BALANCE) - 10**9,
+                    int(ctx.MIN_ACTIVATION_BALANCE) + 10**9,
+                ]
+            )
+        elif roll < 0.45:  # waiting for (immediate) activation
+            v.activation_eligibility_epoch = rng.randrange(1, 7)
+            v.activation_epoch = FAR_FUTURE_EPOCH
+        elif roll < 0.6:  # ejection candidates
+            v.effective_balance = rng.choice(
+                [int(ctx.ejection_balance), int(ctx.ejection_balance) + 10**9]
+            )
+
+    s_lit, s_vec = state.copy(), state.copy()
+    old = pep._VECTORIZED_REWARDS_MIN_N
+    try:
+        pep._VECTORIZED_REWARDS_MIN_N = 10**9
+        eep.process_registry_updates(s_lit, ctx)
+        pep._VECTORIZED_REWARDS_MIN_N = 1
+        eep.process_registry_updates(s_vec, ctx)
+    finally:
+        pep._VECTORIZED_REWARDS_MIN_N = old
+    assert ns.BeaconState.hash_tree_root(s_lit) == ns.BeaconState.hash_tree_root(
+        s_vec
+    )
+    changed = sum(
+        1
+        for a, b in zip(state.validators, s_lit.validators)
+        if (
+            a.activation_eligibility_epoch != b.activation_eligibility_epoch
+            or a.activation_epoch != b.activation_epoch
+            or a.exit_epoch != b.exit_epoch
+        )
+    )
+    assert changed > 0
