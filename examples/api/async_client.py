@@ -1,0 +1,53 @@
+"""Concurrent Beacon-API queries + typed SSE over the async client
+(reference examples/api.rs, which is async end-to-end via reqwest/tokio).
+
+Usage: python examples/api/async_client.py [endpoint]
+Default endpoint: http://localhost:5052
+"""
+
+import asyncio
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from ethereum_consensus_tpu.api import AsyncClient, FinalizedCheckpointTopic, HeadTopic
+from ethereum_consensus_tpu.utils.trace import basic_setup
+
+
+async def main() -> int:
+    basic_setup()
+    endpoint = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:5052"
+    async with AsyncClient(endpoint) as client:
+        # the point of the async transport: these four round-trips are
+        # in flight together on one connection pool
+        try:
+            genesis, root, duties_root_and_list, version = await asyncio.gather(
+                client.get_genesis_details(),
+                client.get_state_root("head"),
+                client.get_proposer_duties(0),
+                client.get_node_version(),
+            )
+        except Exception as exc:  # noqa: BLE001 — example: report and exit
+            print(f"request failed ({exc}); is a beacon node at {endpoint}?")
+            return 1
+        print(f"node {version}")
+        print(f"genesis time {genesis.genesis_time}")
+        print(f"head state root 0x{root.hex()}")
+        dependent_root, duties = duties_root_and_list
+        print(f"epoch-0 proposer duties: {len(duties)} "
+              f"(dependent root 0x{dependent_root.hex()[:16]}...)")
+
+        # typed SSE: events arrive as HeadEvent / FinalizedCheckpointEvent
+        print("streaming head + finalized_checkpoint events (ctrl-c to stop)")
+        stream = await client.get_events([HeadTopic, FinalizedCheckpointTopic])
+        async for name, event in stream:
+            print(f"[{name}] {event}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(asyncio.run(main()))
+    except KeyboardInterrupt:
+        raise SystemExit(0)

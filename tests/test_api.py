@@ -115,6 +115,10 @@ class Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(chunks)
             return
+        if path.startswith("/eth/v1/validator/duties/proposer/"):
+            # any epoch: the canned duties (mirrors the POST handler)
+            self._respond(200, ROUTES["/eth/v1/validator/duties/proposer/3"])
+            return
         if path in ROUTES:
             self._respond(200, ROUTES[path])
         else:

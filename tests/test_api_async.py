@@ -148,3 +148,24 @@ def test_sync_typed_events(server):  # noqa: F811
     assert [name for name, _ in events] == ["head", "finalized_checkpoint"]
     assert isinstance(events[0][1], HeadEvent)
     assert events[0][1].slot == 5
+
+def test_async_example_runs_against_mock(server):  # noqa: F811
+    """examples/api/async_client.py's query phase must run end-to-end
+    against the mock server (the SSE tail is cut by the mock's short
+    canned stream)."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    example = (
+        Path(__file__).resolve().parents[1] / "examples" / "api" / "async_client.py"
+    )
+    proc = subprocess.run(
+        [_sys.executable, str(example), server],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "genesis time 1606824023" in proc.stdout
+    assert "[head]" in proc.stdout
