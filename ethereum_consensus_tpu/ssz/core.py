@@ -756,17 +756,29 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             # bool/float rejections out of the numpy path
             if isinstance(values, CachedRootList):
                 values._uniform_kind = ("int",)  # mutators maintain it
-        if isinstance(elem, _UintType) and elem.byte_length == 8 and all_int:
-            # vectorized u64 packing (balances/inactivity lists dominate);
-            # the explicit little-endian dtype matches serialize(), and
-            # numpy's OverflowError fires exactly where serialize
-            # would raise for out-of-range ints
+        if (
+            isinstance(elem, _UintType)
+            and elem.byte_length in (1, 2, 4, 8)
+            and all_int
+        ):
+            # vectorized uint packing (u64 balances/inactivity lists and
+            # the u8 participation flags dominate — the per-element
+            # serialize of a 131k-flag list was the hot line of altair+
+            # block walks). Convert through u64 FIRST and range-check the
+            # width explicitly: a direct sub-word asarray silently WRAPS
+            # out-of-range ints on numpy<2 (the same hazard the columnar
+            # bulk path guards with its shift check), whereas u64
+            # conversion raises OverflowError for >=2^64 on every numpy
+            # and the shift catches everything else; the little-endian
+            # astype matches serialize().
             try:
                 import numpy as _np
 
-                packed = pack_bytes(
-                    _np.asarray(values, dtype="<u8").tobytes()
-                )
+                col = _np.asarray(values, dtype="<u8")
+                size = elem.byte_length
+                if size < 8 and bool((col >> (8 * size)).any()):
+                    raise OverflowError  # out of range for the width
+                packed = pack_bytes(col.astype("<u%d" % size).tobytes())
             except (OverflowError, TypeError, ValueError):
                 packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
         else:

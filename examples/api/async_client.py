@@ -21,16 +21,26 @@ async def main() -> int:
     async with AsyncClient(endpoint) as client:
         # the point of the async transport: these four round-trips are
         # in flight together on one connection pool
+        failure = None
         try:
-            genesis, root, duties_root_and_list, version = await asyncio.gather(
-                client.get_genesis_details(),
-                client.get_state_root("head"),
-                client.get_proposer_duties(0),
-                client.get_node_version(),
-            )
-        except Exception as exc:  # noqa: BLE001 — example: report and exit
-            print(f"request failed ({exc}); is a beacon node at {endpoint}?")
+            # TaskGroup cancels the in-flight siblings when one fails, so
+            # closing the session on the error path below is quiet
+            async with asyncio.TaskGroup() as tg:
+                t_genesis = tg.create_task(client.get_genesis_details())
+                t_root = tg.create_task(client.get_state_root("head"))
+                t_duties = tg.create_task(client.get_proposer_duties(0))
+                t_version = tg.create_task(client.get_node_version())
+        except* Exception as group:  # noqa: BLE001 — example: report, exit
+            failure = group.exceptions[0]
+        if failure is not None:
+            print(f"request failed ({failure}); is a beacon node at {endpoint}?")
             return 1
+        genesis, root, duties_root_and_list, version = (
+            t_genesis.result(),
+            t_root.result(),
+            t_duties.result(),
+            t_version.result(),
+        )
         print(f"node {version}")
         print(f"genesis time {genesis.genesis_time}")
         print(f"head state root 0x{root.hex()}")
