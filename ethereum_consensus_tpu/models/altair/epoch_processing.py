@@ -194,8 +194,16 @@ def process_inactivity_updates(state, context) -> None:
                     np.uint64(int(context.inactivity_score_recovery_rate)),
                     new[eligible],
                 )
-            # one instrumented slice write instead of up to 2n setitems
-            state.inactivity_scores[:] = new.tolist()
+            from ...ssz.core import bulk_store
+
+            # dirty-range bulk write (one C-speed splice instead of up to
+            # 2n setitems): only the groups whose scores changed
+            # re-merkleize on the next state root
+            bulk_store(
+                state.inactivity_scores,
+                new.tolist(),
+                np.nonzero(new != scores)[0],
+            )
             return
         # pathological near-2^64 scores: exact literal loop below
     eligible = h.get_eligible_validator_indices(state, context)
@@ -266,6 +274,7 @@ def process_rewards_and_penalties(
         # before a later-pair reward lands (spec order, and the literal
         # loop below)
         balances = np.fromiter(state.balances, dtype=np.uint64, count=n)
+        orig_balances = balances
         overflowed = False
         for rewards, penalties in deltas:
             raised = balances + rewards
@@ -274,8 +283,16 @@ def process_rewards_and_penalties(
                 break
             balances = np.where(raised >= penalties, raised - penalties, 0)
         if not overflowed:
-            # one instrumented slice write instead of 8n __setitem__ calls
-            state.balances[:] = balances.tolist()
+            from ...ssz.core import bulk_store
+
+            # dirty-range bulk write (one C-speed splice instead of 8n
+            # __setitem__ calls): only the groups whose balances changed
+            # re-merkleize on the next state root
+            bulk_store(
+                state.balances,
+                balances.tolist(),
+                np.nonzero(balances != orig_balances)[0],
+            )
             return
         # u64 overflow (unreachable for real balances): literal fallback
         # raises the structured checked_add error at the exact index

@@ -150,7 +150,12 @@ def load():
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_build_dir())
             os.close(fd)
             subprocess.run(
-                ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SOURCE, "-o", tmp],
+                # -std=c++17 makes operator new honor over-aligned types
+                # (the AVX-512 x8 structs); older toolchains default to
+                # gnu++14 where a heap MillerPairX8 is only 16-byte
+                # aligned and the first vmovdqa64 GP-faults
+                ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                 "-fPIC", _SOURCE, "-o", tmp],
                 check=True,
                 capture_output=True,
                 timeout=300,

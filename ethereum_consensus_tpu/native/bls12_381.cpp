@@ -3453,7 +3453,13 @@ EC_FP8_TARGET static void multi_miller_loop_x8_impl(Fp12& f_out,
                                                     MillerPair* pairs,
                                                     size_t m) {
   const size_t K = (m + 7) / 8;           // slots; pair i -> slot i/8, lane i%8
-  MillerPairX8* slots = new MillerPairX8[K];
+  // MillerPairX8 holds __m512i members (alignof 64). Plain new[] only
+  // honors that from C++17's aligned-new on; under a C++14 toolchain the
+  // 16-byte-aligned heap block GP-faults the first vmovdqa64. Align by
+  // hand so the build is safe regardless of -std level.
+  char* slots_raw = new char[K * sizeof(MillerPairX8) + 64];
+  MillerPairX8* slots = reinterpret_cast<MillerPairX8*>(
+      (reinterpret_cast<uintptr_t>(slots_raw) + 63) & ~uintptr_t(63));
   int acts[64];  // K <= 64 enforced by caller? no — heap-size acts
   int* act = (K > 64) ? new int[K] : acts;
   for (size_t k = 0; k < K; k++) {
@@ -3529,7 +3535,7 @@ EC_FP8_TARGET static void multi_miller_loop_x8_impl(Fp12& f_out,
   for (int g = 1; g < 8; g++) fp12_mul(total, total, lanes[g]);
   f_out = total;
   if (act != acts) delete[] act;
-  delete[] slots;
+  delete[] slots_raw;
 }
 
 // Batched cofactor clearing over n Jacobian sums (the hash-to-G2 tail):

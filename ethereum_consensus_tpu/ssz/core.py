@@ -21,6 +21,7 @@ from typing import Any
 from .hash import hash_level
 from .merkle import (
     BYTES_PER_CHUNK,
+    IncrementalPaddedTree,
     merkleize_chunks,
     mix_in_length,
     next_pow_of_two,
@@ -48,6 +49,7 @@ __all__ = [
     "serialize",
     "deserialize",
     "hash_tree_root",
+    "bulk_store",
     "get_generalized_index",
     "prove",
     "compute_subtree_root",
@@ -427,11 +429,33 @@ class CachedRootList(list):
     __slots__ = ("_root_cache", "_pack_memo", "_uniform_kind",
                  "_elems_fresh", "_parents_registered", "_self_ref",
                  "_container_parents", "_mut_gen", "_pack_gen",
-                 "__weakref__")
+                 "_dirty_groups", "_tree_memo", "_pack_tree",
+                 "_memos_owned", "__weakref__")
 
     def __init__(self, *args):
         super().__init__(*args)
         self._root_cache: dict = {}
+        # --- mutation-propagated dirty tracking (docs/INCREMENTAL_HTR.md)
+        # Set of dirty 4096-element group indices accumulated since the
+        # last serviced walk; None = tracking inactive (small list, never
+        # walked, or an untrackable mutation lost the index map). Marked
+        # by the instrumented list mutators and, for scalar-leaf container
+        # elements, by Container.__setattr__ through the weak-parent chain
+        # using the element's stamped index.
+        self._dirty_groups: "set | None" = None
+        # (key, chunks bytearray, IncrementalPaddedTree, root) for lists of
+        # scalar-leaf containers: chunks = the joined element roots, tree =
+        # the 4096-chunk group mids. Survives mutation (dirty groups name
+        # exactly what to re-merkleize); shared structurally with copies
+        # under _memos_owned copy-on-write.
+        self._tree_memo: "list | None" = None
+        # same shape for packed basic/bytes32 collections: (key, packed
+        # bytearray, IncrementalPaddedTree, root)
+        self._pack_tree: "list | None" = None
+        # False after a copy shares _tree_memo/_pack_tree with a sibling:
+        # the next splice clones before mutating (staleness therefore
+        # costs one buffer copy, never a wrong root)
+        self._memos_owned: bool = True
         # weakrefs to Containers whose instance root cache covers this
         # list as a field (the nested-root scheme): every mutation fires
         # their _ssz_root_dirty. None until a parent registers.
@@ -475,6 +499,48 @@ class CachedRootList(list):
         return (type(self), (list(self),))
 
 
+# Dirty-group granularity: 4096 elements per group — one group of a
+# scalar-leaf container list spans exactly one 4096-leaf merkle subtree
+# (one chunk per element root). Module globals so the property tests can
+# shrink the geometry and exercise many groups on small collections.
+_DIRTY_GROUP_SHIFT = 12
+# Track only collections whose merkle layer clears one group — below
+# that a full re-merkleization is a single cheap native call anyway.
+_DIRTY_TRACK_MIN_CHUNKS = 1 << 12
+
+
+def _mutation_groups(name, args, pre_len, post_len):
+    """Dirty element-index groups touched by an instrumented list mutation,
+    or None when the mutation shifts surviving indices (tracking lost)."""
+    gs = _DIRTY_GROUP_SHIFT
+    if name == "__setitem__":
+        i = args[0]
+        if type(i) is int:
+            if i < 0:
+                i += pre_len
+            return (i >> gs,)
+        if type(i) is slice and post_len == pre_len:
+            start, stop, step = i.indices(pre_len)
+            if step == 1:
+                if stop <= start:
+                    return ()
+                return range(start >> gs, ((stop - 1) >> gs) + 1)
+        return None
+    if name == "append":
+        return (pre_len >> gs,)
+    if name in ("extend", "__iadd__"):
+        if post_len == pre_len:
+            return ()
+        return range(pre_len >> gs, ((post_len - 1) >> gs) + 1)
+    if name == "pop":
+        # only an end-pop preserves the surviving indices
+        if not args or args[0] == -1 or args[0] == pre_len - 1:
+            return (post_len >> gs,)
+        return None
+    # insert/remove/sort/reverse/__delitem__/__imul__/clear: index map gone
+    return None
+
+
 def _instrument(name):
     base = getattr(list, name)
     # single-element writers can keep the uniform-bytes verdict alive
@@ -508,26 +574,63 @@ def _instrument(name):
                 self._uniform_kind = None
         pre_len = len(self)
         result = base(self, *args, **kwargs)
+        dg = self._dirty_groups
+        if dg is not None:
+            marks = _mutation_groups(name, args, pre_len, len(self))
+            if marks is None:
+                self._dirty_groups = None
+            else:
+                dg.update(marks)
         if self._parents_registered:
-            # keep newly added container elements wired to this list so
-            # the freshness scheme keeps seeing their mutations (read
-            # back from the list itself: extend/slice payloads may be
-            # one-shot iterables the base call consumed)
+            # keep newly added container elements wired to this list (and
+            # stamped with their index, so their mutations mark the right
+            # dirty group) — the freshness scheme keeps seeing their
+            # mutations (read back from the list itself: extend/slice
+            # payloads may be one-shot iterables the base call consumed)
             if value_pos is not None and len(args) > value_pos:
                 if name == "__setitem__" and type(args[0]) is not int:
-                    added = list.__getitem__(self, args[0])
-                else:
+                    sl = args[0]
+                    added = list.__getitem__(self, sl)
+                    idxs = range(*sl.indices(len(self)))
+                elif name == "__setitem__":
+                    i = args[0]
+                    if i < 0:
+                        i += len(self)
+                    added = (args[1],)
+                    idxs = (i,)
+                elif name == "insert":
+                    i = args[0]
+                    if i < 0:
+                        i = max(0, i + pre_len)
+                    added = (args[1],)
+                    idxs = (min(i, pre_len),)
+                else:  # append
                     added = (args[value_pos],)
+                    idxs = (pre_len,)
             elif name in ("extend", "__iadd__"):
                 added = list.__getitem__(self, slice(pre_len, len(self)))
+                idxs = range(pre_len, len(self))
             else:
                 added = ()
+                idxs = ()
             ref = self._self_ref
-            for v in added:
+            for i, v in zip(idxs, added):
                 if isinstance(v, Container):
-                    ps = v.__dict__.get("_ssz_parents")
+                    d = v.__dict__
+                    old = d.get("_ssz_idx")
+                    if (
+                        old is not None
+                        and old != i
+                        and old < len(self)
+                        and list.__getitem__(self, old) is v
+                    ):
+                        # the same object now sits at two indices of THIS
+                        # list: per-index dirty marking can't cover both
+                        self._dirty_groups = None
+                    d["_ssz_idx"] = i
+                    ps = d.get("_ssz_parents")
                     if ps is None:
-                        v.__dict__["_ssz_parents"] = [ref]
+                        d["_ssz_parents"] = [ref]
                     elif ps[-1] is not ref:
                         ps.append(ref)
         return result
@@ -575,19 +678,149 @@ def _cacheable_values(elem: SSZType, values: list) -> bool:
     return True
 
 
-def _merkleize_packed_memo(values, key, packed: bytes, limit: int) -> bytes:
+def _group_mids(chunks: bytes) -> bytes:
+    """Roots of consecutive ``2**_DIRTY_GROUP_SHIFT``-chunk groups in one
+    set of hash_level passes. Sound because every group except the last is
+    full and aligned, so the global per-level zero padding IS the last
+    (partial) group's padding."""
+    nodes = chunks
+    for lvl in range(_DIRTY_GROUP_SHIFT):
+        if (len(nodes) // 32) % 2:
+            nodes += zero_hash(lvl)
+        nodes = hash_level(nodes)
+    return nodes
+
+
+def _pack_tree_eligible(values, limit_chunks: int, count_chunks: int) -> bool:
+    return (
+        count_chunks > _DIRTY_TRACK_MIN_CHUNKS
+        and limit_chunks % (1 << _DIRTY_GROUP_SHIFT) == 0
+        and values._uniform_kind is not None
+    )
+
+
+def _packed_splice(elem, values, key, limit_chunks: int) -> "bytes | None":
+    """Dirty-group incremental root for a packed basic/bytes32 collection:
+    re-serialize ONLY the dirty 4096-element groups into the retained raw
+    buffer, re-merkleize their 4096-chunk groups, and let the stored-level
+    tree recompute the log-depth paths. Returns None whenever the memo,
+    the tracking state, or the values don't support it (callers fall back
+    to the full pack, which raises the structured errors)."""
+    pt = values._pack_tree
+    dg = values._dirty_groups
+    if pt is None or dg is None or pt[0] != key:
+        return None
+    kind = values._uniform_kind
+    if kind is None:
+        return None
+    if isinstance(elem, _UintType):
+        if kind[0] != "int" or elem.byte_length > 8:
+            return None
+        esize = elem.byte_length
+    elif isinstance(elem, ByteVector) and elem.length == BYTES_PER_CHUNK:
+        if kind[0] != "bytes" or kind[1] != BYTES_PER_CHUNK:
+            return None
+        esize = BYTES_PER_CHUNK
+    else:
+        return None
+    n = len(values)
+    raw, tree, root = pt[1], pt[2], pt[3]
+    if not dg:
+        return root if len(raw) == n * esize else None
+    gs = _DIRTY_GROUP_SHIFT
+    gsize = 1 << gs
+    # serialize every dirty range BEFORE touching the memo, with the same
+    # strictness as serialize(): a non-conforming value sends the whole
+    # walk to the fallback path and its structured errors
+    segs = []
+    try:
+        for g in sorted(dg):
+            start = g << gs
+            if start >= n:
+                continue
+            stop = min(n, start + gsize)
+            seg_vals = list.__getitem__(values, slice(start, stop))
+            if esize == BYTES_PER_CHUNK:
+                seg = b"".join(seg_vals)
+                if len(seg) != BYTES_PER_CHUNK * (stop - start):
+                    return None
+            else:
+                import numpy as _np
+
+                col = _np.asarray(seg_vals, dtype="<u8")
+                if esize < 8 and bool((col >> (8 * esize)).any()):
+                    return None
+                seg = col.astype("<u%d" % esize).tobytes()
+            segs.append((start, stop, seg))
+    except (OverflowError, TypeError, ValueError):
+        return None
+    if not values._memos_owned:
+        raw = bytearray(raw)
+        tree = tree.clone()
+        pt = [key, raw, tree, root]
+        values._pack_tree = pt
+        values._memos_owned = True
+    if n * esize < len(raw):
+        del raw[n * esize :]
+    for start, stop, seg in segs:
+        raw[start * esize : stop * esize] = seg
+    # element-group -> chunk-group: one group spans gsize*esize bytes,
+    # i.e. gsize*esize/32 chunks, so cg = g >> log2(32//esize). EVERY
+    # dirty group names its chunk-group — including ranges now beyond the
+    # shrunk length, whose chunk-group content changed by truncation alone
+    pcl = 5 - (esize.bit_length() - 1)
+    cbytes = BYTES_PER_CHUNK << gs
+    total_chunks = (len(raw) + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+    n_cgs = (total_chunks + (1 << gs) - 1) >> gs
+    tree.truncate(n_cgs)
+    for cg in sorted({g >> pcl for g in dg}):
+        if cg >= n_cgs:
+            continue
+        seg = bytes(raw[cg * cbytes : (cg + 1) * cbytes])
+        if not seg:
+            continue
+        tree.set_node(cg, merkleize_chunks(pack_bytes(seg), limit=1 << gs))
+    root = tree.root()
+    pt[3] = root
+    values._dirty_groups = set()
+    return root
+
+
+def _merkleize_packed_memo(
+    values, key, packed: bytes, limit: int, raw: "bytes | None" = None
+) -> bytes:
     """merkleize_chunks with a mutation-surviving memo on CachedRootList
     inputs: reuse requires the exact same packed bytes (C-speed compare),
     so staleness can only cost a miss, never a wrong root.
 
-    FULL power-of-two vectors (randao_mixes, block_roots, state_roots —
-    always fully populated, count == limit) additionally keep the mid
-    level of the tree: on a byte-diff miss, only the subtrees whose
-    bytes changed re-hash plus the top tree, so the one-mix-per-block
-    write pattern costs ~sqrt(n) hashes instead of n."""
+    Collections big enough for dirty-group tracking instead build the
+    retained raw buffer + stored-level group tree that _packed_splice
+    services on later walks (mutators mark groups; only those re-pack and
+    re-hash). FULL power-of-two vectors (randao_mixes, block_roots,
+    state_roots — always fully populated, count == limit) below the
+    tracking threshold keep the legacy mid-level memo: on a byte-diff
+    miss, only the subtrees whose bytes changed re-hash plus the top
+    tree."""
     if not isinstance(values, CachedRootList):
         return merkleize_chunks(packed, limit=limit)
     count = len(packed) // BYTES_PER_CHUNK
+    if _pack_tree_eligible(values, limit, count):
+        gs = _DIRTY_GROUP_SHIFT
+        tree = IncrementalPaddedTree(
+            _group_mids(packed), limit >> gs, level_offset=gs
+        )
+        root = tree.root()
+        values._pack_tree = [
+            key,
+            bytearray(packed if raw is None else raw),
+            tree,
+            root,
+        ]
+        values._memos_owned = True
+        values._dirty_groups = set()
+        values._pack_memo = None
+        values._pack_gen = -1
+        return root
     two_level = (
         count == limit and count >= 4096 and (count & (count - 1)) == 0
     )
@@ -742,6 +975,231 @@ def _pack_memo_gen_hit(values, key) -> bool:
     )
 
 
+def _tree_splice(elem, values, tkey) -> "bytes | None":
+    """Dirty-group incremental root for a list of scalar-leaf containers:
+    re-join the element roots of ONLY the dirty 4096-element groups (the
+    untouched elements in those groups serve their instance caches), re-
+    merkleize those groups, and let the stored-level tree walk the
+    log-depth paths. Returns None when the memo or tracking state can't
+    support it — the caller falls back to the discovery walk."""
+    tm = values._tree_memo
+    dg = values._dirty_groups
+    if tm is None or dg is None or tm[0] != tkey or tm[2] is None:
+        return None
+    chunks, tree, root = tm[1], tm[2], tm[3]
+    n = len(values)
+    if not dg:
+        return root if len(chunks) == 32 * n else None
+    if not values._memos_owned:
+        chunks = bytearray(chunks)
+        tree = tree.clone()
+        tm = [tkey, chunks, tree, root]
+        values._tree_memo = tm
+        values._memos_owned = True
+    gs = _DIRTY_GROUP_SHIFT
+    gsize = 1 << gs
+    if 32 * n < len(chunks):
+        del chunks[32 * n :]
+    htr = elem.hash_tree_root
+    sticky = set()
+    for g in sorted(dg):
+        start = g << gs
+        if start >= n:
+            continue
+        stop = min(n, start + gsize)
+        parts = []
+        clean = True
+        for v in list.__getitem__(values, slice(start, stop)):
+            r = v.__dict__.get("_htr_cache")
+            if r is None:
+                r = htr(v)
+                if "_htr_cache" not in v.__dict__:
+                    # element refused caching (a mutable field value can
+                    # change without notifying): its group must recompute
+                    # on every walk until the value is replaced
+                    clean = False
+            parts.append(r)
+        if not clean:
+            sticky.add(g)
+        seg = b"".join(parts)
+        chunks[32 * start : 32 * stop] = seg
+        tree.set_node(g, merkleize_chunks(seg, limit=gsize))
+    tree.truncate((n + gsize - 1) >> gs)
+    root = tree.root()
+    tm[3] = root
+    values._dirty_groups = sticky
+    values._elems_fresh = not sticky
+    return root
+
+
+def _finish_container_walk(values, tkey, chunks, limit_elems, tm) -> bytes:
+    """Full-walk tail for a scalar-leaf container list: serve the exact
+    chunks-compare memo, group-diff against the retained chunks when a
+    tree exists (the discovery path, now only reached after untracked
+    mutations), or build the dirty-group tree for future splices."""
+    gs = _DIRTY_GROUP_SHIFT
+    gsize = 1 << gs
+    if tm is not None and tm[1] == chunks:
+        return tm[3]
+    n_chunks = len(chunks) // BYTES_PER_CHUNK
+    eligible = n_chunks > _DIRTY_TRACK_MIN_CHUNKS and limit_elems % gsize == 0
+    bs = BYTES_PER_CHUNK << gs
+    if tm is not None and tm[2] is not None and eligible:
+        old = tm[1]
+        tree = tm[2] if values._memos_owned else tm[2].clone()
+        n_groups = (n_chunks + gsize - 1) >> gs
+        tree.truncate(n_groups)
+        for g in range(n_groups):
+            seg = chunks[g * bs : (g + 1) * bs]
+            if bytes(old[g * bs : (g + 1) * bs]) != seg:
+                tree.set_node(g, merkleize_chunks(seg, limit=gsize))
+        root = tree.root()
+        values._tree_memo = [tkey, bytearray(chunks), tree, root]
+        values._memos_owned = True
+        return root
+    if eligible:
+        tree = IncrementalPaddedTree(
+            _group_mids(chunks), limit_elems >> gs, level_offset=gs
+        )
+        root = tree.root()
+        values._tree_memo = [tkey, bytearray(chunks), tree, root]
+        values._memos_owned = True
+        return root
+    root = merkleize_chunks(chunks, limit=limit_elems)
+    values._tree_memo = [tkey, chunks, None, root]
+    values._memos_owned = True
+    return root
+
+
+def _register_and_activate(elem, values, tkey) -> None:
+    """Post-full-walk bookkeeping for a scalar-leaf container list: wire
+    every element to this list (weak parent + index stamp) and, when the
+    walk left a group tree and every element carries its root cache, arm
+    dirty-group tracking (an empty set) so the NEXT walk is a splice.
+    Intra-list aliasing (the same element object at two indices) defeats
+    per-index marking, so registration refuses to arm in that case."""
+    stamped = None
+    if not values._parents_registered:
+        import weakref
+
+        ref = values._self_ref
+        if ref is None:
+            ref = weakref.ref(values)
+            values._self_ref = ref
+        stamped = True
+        n_v = len(values)
+        for i, v in enumerate(values):
+            d = v.__dict__
+            old_i = d.get("_ssz_idx")
+            if (
+                old_i is not None
+                and old_i != i
+                and old_i < n_v
+                and list.__getitem__(values, old_i) is v
+            ):
+                stamped = False  # duplicate object within THIS list
+            d["_ssz_idx"] = i
+            parents = d.get("_ssz_parents")
+            if parents is None:
+                d["_ssz_parents"] = [ref]
+            elif not any(p is ref for p in parents):
+                # identity, not ==: weakref.ref.__eq__ compares live
+                # referents by VALUE, and these lists compare field-wise —
+                # a distinct but value-equal sibling list (state copy
+                # sharing elements) would be mistaken for self
+                if len(parents) > 16:  # prune dead lineages
+                    parents[:] = [p for p in parents if p() is not None]
+                parents.append(ref)
+        values._parents_registered = True
+    # Freshness is only sound if every element's sole mutation channel
+    # really is __setattr__: an element holding a mutable buffer
+    # (bytearray in a ByteVector slot) can change in place without
+    # notifying. elem.hash_tree_root() just ran on every element and set
+    # _htr_cache iff all field values were immutable (int|bool|bytes), so
+    # cache presence IS that proof — for the freshness flag AND for
+    # arming dirty-group tracking.
+    all_cached = all("_htr_cache" in v.__dict__ for v in values)
+    values._elems_fresh = all_cached
+    tm = values._tree_memo
+    if not (all_cached and tm is not None and tm[0] == tkey and tm[2] is not None):
+        values._dirty_groups = None
+        return
+    if values._dirty_groups is None and stamped is None:
+        # reactivation after an untracked mutation: stamps may be stale —
+        # rewrite them, refusing on intra-list duplicates
+        stamped = True
+        n_v = len(values)
+        for i, v in enumerate(values):
+            d = v.__dict__
+            old_i = d.get("_ssz_idx")
+            if (
+                old_i is not None
+                and old_i != i
+                and old_i < n_v
+                and list.__getitem__(values, old_i) is v
+            ):
+                stamped = False
+                break
+            d["_ssz_idx"] = i
+    values._dirty_groups = set() if stamped in (None, True) else None
+
+
+def bulk_store(values, new_values, changed_indices=None) -> None:
+    """Same-length full-content overwrite with an explicit dirty contract:
+    the caller certifies that every position whose value differs from the
+    current content appears in ``changed_indices`` (element indices; None
+    = unknown, every group goes dirty). This is the bulk-mutator entry
+    the fork models' vectorized epoch sweeps use instead of
+    ``values[:] = new`` — a whole-registry balance write that really
+    changed a few thousand entries re-merkleizes a few groups, not the
+    whole collection (docs/INCREMENTAL_HTR.md)."""
+    n = len(values)
+    if (
+        values.__class__ is not CachedRootList
+        or len(new_values) != n
+        or (new_values and isinstance(new_values[0], Container))
+    ):
+        values[:] = new_values
+        return
+    list.__setitem__(values, slice(0, n), new_values)
+    values._root_cache.clear()
+    values._elems_fresh = False
+    values._mut_gen += 1
+    # re-certify uniformity NOW (one C-speed pass): the dirty-group splice
+    # only engages on a certified collection, and deferring the scan to
+    # the next walk would demote every bulk_store to a full re-pack —
+    # exactly the cost this entry point exists to avoid
+    kinds = set(map(type, new_values))
+    if kinds == {int}:
+        values._uniform_kind = ("int",)
+    elif kinds == {bytes} and len(set(map(len, new_values))) == 1:
+        values._uniform_kind = ("bytes", len(new_values[0]))
+    else:
+        values._uniform_kind = None
+    cps = values._container_parents
+    if cps is not None:
+        for ref in cps:
+            p = ref()
+            if p is not None:
+                p._ssz_root_dirty()
+    dg = values._dirty_groups
+    if dg is None:
+        return
+    gs = _DIRTY_GROUP_SHIFT
+    if changed_indices is None:
+        if n:
+            dg.update(range(((n - 1) >> gs) + 1))
+        return
+    try:
+        import numpy as _np
+
+        arr = _np.asarray(changed_indices, dtype=_np.int64)
+        if arr.size:
+            dg.update(_np.unique(arr >> gs).tolist())
+    except (TypeError, ValueError):
+        dg.update({int(i) >> gs for i in changed_indices})
+
+
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
     if _is_basic(elem):
         limit = (
@@ -750,6 +1208,10 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         key = ("u", elem, limit)
         if _pack_memo_gen_hit(values, key):
             return values._pack_memo[2]
+        if isinstance(values, CachedRootList):
+            hit = _packed_splice(elem, values, key, limit)
+            if hit is not None:
+                return hit
         all_int = getattr(values, "_uniform_kind", None) == ("int",)
         if not all_int and values and set(map(type, values)) == {int}:
             all_int = True  # C-speed scan; keeps serialize()'s
@@ -778,12 +1240,12 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                 size = elem.byte_length
                 if size < 8 and bool((col >> (8 * size)).any()):
                     raise OverflowError  # out of range for the width
-                packed = pack_bytes(col.astype("<u%d" % size).tobytes())
+                raw = col.astype("<u%d" % size).tobytes()
             except (OverflowError, TypeError, ValueError):
-                packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
+                raw = b"".join(elem.serialize(v) for v in values)
         else:
-            packed = pack_bytes(b"".join(elem.serialize(v) for v in values))
-        return _merkleize_packed_memo(values, key, packed, limit)
+            raw = b"".join(elem.serialize(v) for v in values)
+        return _merkleize_packed_memo(values, key, pack_bytes(raw), limit, raw=raw)
     if isinstance(elem, ByteVector) and elem.length == BYTES_PER_CHUNK:
         # a 32-byte vector's root IS its bytes — and the validation runs
         # at C speed (join rejects non-bytes with TypeError; the len-set
@@ -802,6 +1264,10 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         b32_key = ("b32", elem, limit_elems)
         if _pack_memo_gen_hit(values, b32_key):
             return values._pack_memo[2]
+        if isinstance(values, CachedRootList):
+            hit = _packed_splice(elem, values, b32_key, limit_elems)
+            if hit is not None:
+                return hit
         if getattr(values, "_uniform_kind", None) == ("bytes", BYTES_PER_CHUNK):
             sizes_ok = True  # full scan done once; mutators maintain it
         else:
@@ -828,27 +1294,37 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                     # set after one full type scan; mutators keep it
                     values._uniform_kind = ("bytes", BYTES_PER_CHUNK)
                 return _merkleize_packed_memo(
-                    values, b32_key, chunks, limit_elems
+                    values, b32_key, chunks, limit_elems, raw=chunks
                 )
     freshable = (
         isinstance(values, CachedRootList)
         and isinstance(elem, type)
         and getattr(elem, "__ssz_scalar_leaf__", False)
     )
-    if freshable and values._elems_fresh:
-        # SCALAR-LEAF container elements (the validator registry) notify
-        # this list through weakref parents on any field write, so a set
-        # freshness flag proves no element changed since the last walk —
-        # the O(n) per-element cache walk collapses to a dict hit.
-        memo = values._root_cache.get(("tree", elem, limit_elems))
-        if memo is not None:
-            return memo[1]
+    tkey = ("tree", elem, limit_elems)
+    tm = None
+    if freshable:
+        # dirty-group splice: the mutators and the element setattr chain
+        # have named exactly which 4096-leaf groups changed — re-merkleize
+        # those plus the log-depth path, no registry walk
+        hit = _tree_splice(elem, values, tkey)
+        if hit is not None:
+            return hit
+        tm = values._tree_memo
+        if tm is not None and tm[0] != tkey:
+            tm = None
+        if (
+            values._elems_fresh
+            and tm is not None
+            and len(tm[1]) == 32 * len(values)
+        ):
+            # SCALAR-LEAF container elements (the validator registry)
+            # notify this list through weakref parents on any field
+            # write, so a set freshness flag proves no element changed
+            # since the last walk — the memoized root stands.
+            return tm[3]
     chunks = None
-    if (
-        freshable
-        and len(values) >= _BULK_ROOTS_MIN
-        and values._root_cache.get(("tree", elem, limit_elems)) is None
-    ):
+    if freshable and len(values) >= _BULK_ROOTS_MIN and tm is None:
         # no memo yet = a cold-LIST walk: a fresh deserialize (elements
         # cold too) or a fresh CachedRootList wrapped around
         # ALREADY-CACHED elements (validating-constructor / fork-upgrade
@@ -876,6 +1352,10 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
             )
         else:
             chunks = b"".join(elem.hash_tree_root(v) for v in values)
+    if freshable:
+        root = _finish_container_walk(values, tkey, chunks, limit_elems, tm)
+        _register_and_activate(elem, values, tkey)
+        return root
     if isinstance(values, CachedRootList):
         # container-element lists (the validator registry) can't cache a
         # root blindly — an element can mutate without touching the list
@@ -935,44 +1415,6 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         else:
             root = merkleize_chunks(chunks, limit=limit_elems)
             values._root_cache[("tree", elem, limit_elems)] = (chunks, root)
-        if freshable:
-            if not values._parents_registered:
-                # one-time: register this list as a weak parent of every
-                # element (only scalar-leaf containers: their ONLY
-                # mutation channel is __setattr__, which notifies;
-                # nested-mutable elements like PendingAttestation never
-                # take this path). The instrumented mutators keep later
-                # additions wired, so walks never rescan.
-                import weakref
-
-                ref = values._self_ref
-                if ref is None:
-                    ref = weakref.ref(values)
-                    values._self_ref = ref
-                for v in values:
-                    parents = v.__dict__.get("_ssz_parents")
-                    if parents is None:
-                        v.__dict__["_ssz_parents"] = [ref]
-                    elif not any(p is ref for p in parents):
-                        # identity, not ==: weakref.ref.__eq__ compares
-                        # live referents by VALUE, and these lists
-                        # compare field-wise — a distinct but value-equal
-                        # sibling list (state copy sharing elements)
-                        # would be mistaken for self, skipping
-                        # registration while still claiming freshness
-                        if len(parents) > 16:  # prune dead lineages
-                            parents[:] = [p for p in parents if p() is not None]
-                        parents.append(ref)
-                values._parents_registered = True
-            # Freshness is only sound if every element's sole mutation
-            # channel really is __setattr__: an element holding a mutable
-            # buffer (bytearray in a ByteVector slot) can change in place
-            # without notifying. elem.hash_tree_root() just ran on every
-            # element and set _htr_cache iff all field values were
-            # immutable (int|bool|bytes), so cache presence IS that proof.
-            values._elems_fresh = all(
-                "_htr_cache" in v.__dict__ for v in values
-            )
         return root
     return merkleize_chunks(chunks, limit=limit_elems)
 
@@ -1343,12 +1785,29 @@ class Container(metaclass=_ContainerMeta):
         had = d.pop("_htr_cache", None) is not None
         parents = d.get("_ssz_parents")
         if parents is not None:
+            idx = d.get("_ssz_idx")
             for ref in parents:
                 p = ref()
                 if p is None:
                     continue
                 if p.__class__ is CachedRootList:
                     p._elems_fresh = False
+                    dg = p._dirty_groups
+                    if dg is not None:
+                        # the stamped index is trusted only when it still
+                        # points at THIS object in THAT list (stamps are
+                        # per-element, and a structural mutation or a
+                        # different-position alias can stale them); any
+                        # mismatch downgrades the list to the discovery
+                        # walk rather than risking a missed group
+                        if (
+                            idx is not None
+                            and idx < list.__len__(p)
+                            and list.__getitem__(p, idx) is self
+                        ):
+                            dg.add(idx >> _DIRTY_GROUP_SHIFT)
+                        else:
+                            p._dirty_groups = None
                 elif had:
                     p._ssz_root_dirty()
         if type(value) is list:
@@ -1366,12 +1825,23 @@ class Container(metaclass=_ContainerMeta):
             return
         parents = d.get("_ssz_parents")
         if parents is not None:
+            idx = d.get("_ssz_idx")
             for ref in parents:
                 p = ref()
                 if p is None:
                     continue
                 if p.__class__ is CachedRootList:
                     p._elems_fresh = False
+                    dg = p._dirty_groups
+                    if dg is not None:
+                        if (
+                            idx is not None
+                            and idx < list.__len__(p)
+                            and list.__getitem__(p, idx) is self
+                        ):
+                            dg.add(idx >> _DIRTY_GROUP_SHIFT)
+                        else:
+                            p._dirty_groups = None
                 else:
                     p._ssz_root_dirty()
 
@@ -1588,19 +2058,69 @@ class Container(metaclass=_ContainerMeta):
         return type(self).hash_tree_root(self)
 
 
+def _copy_scalar_leaf_list(value: "CachedRootList") -> "CachedRootList":
+    """Specialized copy for lists of scalar-leaf containers (the validator
+    registry): element dicts are duplicated raw (their field values are
+    immutable and the root cache travels), and every copy is wired to the
+    NEW list up front — parent weakref + index stamp — so the copied
+    state's dirty-group tracking continues seamlessly instead of paying a
+    full re-registration walk on its first root."""
+    import weakref
+
+    copied = CachedRootList()
+    ref = weakref.ref(copied)
+    copied._self_ref = ref
+    append = list.append
+    for i, v in enumerate(value):
+        cls = v.__class__
+        nv = cls.__new__(cls)
+        d = nv.__dict__
+        d.update(v.__dict__)
+        d["_ssz_parents"] = [ref]
+        d["_ssz_idx"] = i
+        d.pop("_ssz_self_ref", None)
+        append(copied, nv)
+    copied._parents_registered = True
+    copied._elems_fresh = value._elems_fresh
+    return copied
+
+
 def _copy_value(typ: SSZType, value: Any):
     if isinstance(value, Container):
         return value.copy()
     if isinstance(value, list):
         elem = getattr(typ, "elem", None)
+        shared_memos = False
         if elem is not None and not _is_basic(elem):
             # SSZ lists are homogeneous: one dispatch covers every element
-            if value and isinstance(value[0], Container):
+            if (
+                isinstance(value, CachedRootList)
+                and isinstance(elem, type)
+                and getattr(elem, "__ssz_scalar_leaf__", False)
+            ):
+                copied = _copy_scalar_leaf_list(value)
+                if value._tree_memo is not None:
+                    # structural share of the chunks/tree memo: BOTH sides
+                    # drop ownership, so whichever splices first clones —
+                    # staleness costs one buffer copy, never a wrong root
+                    copied._tree_memo = value._tree_memo
+                    value._memos_owned = False
+                    shared_memos = True
+                dg = value._dirty_groups
+                copied._dirty_groups = set(dg) if dg is not None else None
+            elif value and isinstance(value[0], Container):
                 copied = CachedRootList(v.copy() for v in value)
             else:
                 copied = CachedRootList(_copy_value(elem, v) for v in value)
         else:
             copied = CachedRootList(value)
+            if isinstance(value, CachedRootList):
+                if value._pack_tree is not None:
+                    copied._pack_tree = value._pack_tree
+                    value._memos_owned = False
+                    shared_memos = True
+                dg = value._dirty_groups
+                copied._dirty_groups = set(dg) if dg is not None else None
         # identical values ⇒ identical roots: the cache (only ever
         # populated for immutable-element collections) travels with the
         # copy; mutations on either side clear their own
@@ -1613,6 +2133,8 @@ def _copy_value(typ: SSZType, value: Any):
             # own instrumented mutators bump only ITS counter
             copied._mut_gen = value._mut_gen
             copied._pack_gen = value._pack_gen
+            if shared_memos:
+                copied._memos_owned = False
         return copied
     return value
 

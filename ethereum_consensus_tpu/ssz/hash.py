@@ -20,18 +20,47 @@ __all__ = [
     "register_device_hasher",
     "register_native_hasher",
     "hash_level",
+    "digest_count",
+    "add_digests",
     "DEVICE_MIN_NODES",
     "NATIVE_MIN_NODES",
 ]
 
 
+# -- instrumentation ---------------------------------------------------------
+
+# Monotonic count of SHA-256 compressions performed through this module
+# (host, native, and device alike — whole-tree native reductions report
+# their exact level-sum via add_digests). Tests and the bench read deltas
+# to assert WORK DONE, not just wall time: the incremental-HTR regression
+# test pins "one validator edit == one 4096-leaf group + the log-depth
+# path", which wall-clock alone can't prove.
+_digest_count = 0
+
+
+def digest_count() -> int:
+    """Total digests computed so far (read a delta around the op under test)."""
+    return _digest_count
+
+
+def add_digests(n: int) -> None:
+    """Record ``n`` digests computed outside the per-call wrappers (native
+    whole-tree reductions, device dispatches)."""
+    global _digest_count
+    _digest_count += n
+
+
 def hash_bytes(data: bytes) -> bytes:
     """SHA-256 of arbitrary bytes (host)."""
+    global _digest_count
+    _digest_count += 1
     return hashlib.sha256(data).digest()
 
 
 def hash_pair(left: bytes, right: bytes) -> bytes:
     """SHA-256 of the 64-byte concatenation of two 32-byte nodes."""
+    global _digest_count
+    _digest_count += 1
     return hashlib.sha256(left + right).digest()
 
 
@@ -85,8 +114,9 @@ _native_attempted = False
 def hash_level(nodes: bytes) -> bytes:
     """Hash one merkle level, routing to the fastest registered backend:
     device for huge levels, native C++ for medium, hashlib otherwise."""
-    global _native_attempted
+    global _native_attempted, _digest_count
     n = len(nodes) // 64
+    _digest_count += n
     if _device_hasher is not None and n >= DEVICE_MIN_NODES:
         return _device_hasher(nodes)
     if (

@@ -28,6 +28,7 @@ __all__ = [
     "compute_merkle_proof",
     "is_valid_merkle_branch",
     "is_valid_merkle_branch_for_generalized_index",
+    "IncrementalPaddedTree",
 ]
 
 BYTES_PER_CHUNK = 32
@@ -127,6 +128,14 @@ def _native_tree_root(chunks: bytes, depth: int) -> "bytes | None":
     if zh is None:
         zh = b"".join(zero_hash(level) for level in range(depth + 1))
         _ZH_JOINED[depth] = zh
+    # exact level-sum digest count (zero-pad siblings come from the
+    # precomputed table, so each level costs ceil(n/2) compressions)
+    n = len(chunks) // BYTES_PER_CHUNK
+    total = 0
+    for _ in range(depth):
+        n = (n + 1) // 2
+        total += n
+    _hash_mod.add_digests(total)
     return native.merkle_root_native(chunks, depth, zh)
 
 
@@ -201,15 +210,17 @@ class Tree:
         count = len(chunks)
         width = next_pow_of_two(count if limit is None else limit)
         self.depth = (width - 1).bit_length()
-        # Only materialize the populated region; zero-subtree roots fill the rest.
+        # Only materialize the populated region; zero-subtree roots fill the
+        # rest. Each level hashes as ONE hash_level call so proof
+        # construction rides the native/device backends instead of a
+        # per-pair Python loop.
         level = list(chunks)
         self.levels: list[list[bytes]] = [level]
         for d in range(self.depth):
-            nxt = []
             if len(level) % 2 == 1:
                 level = level + [zero_hash(d)]
-            for i in range(0, len(level), 2):
-                nxt.append(hash_pair(level[i], level[i + 1]))
+            joined = hash_level(b"".join(level))
+            nxt = [joined[i : i + 32] for i in range(0, len(joined), 32)]
             self.levels.append(nxt)
             level = nxt
 
@@ -237,3 +248,106 @@ class Tree:
 
 def compute_merkle_proof(chunks: list[bytes], leaf_index: int, limit: int | None = None) -> list[bytes]:
     return Tree(chunks, limit).proof(leaf_index)
+
+
+# -- incremental padded tree (the dirty-group memo substrate) ----------------
+
+
+class IncrementalPaddedTree:
+    """Stored-levels binary merkle tree over a dynamic array of nodes, each
+    node the root of a depth-``level_offset`` subtree, zero-padded to a
+    virtual width of ``limit`` nodes.
+
+    This is the TOP HALF of the two-level incremental hash_tree_root
+    scheme (ssz/core.py): level-0 nodes are 4096-leaf group roots, and a
+    single-group edit costs exactly the log-depth path to the root —
+    ``set_node`` marks, ``root()`` recomputes only marked paths. Levels
+    store the populated region only; sparse padding uses the zero-subtree
+    table, so a List[..., 2**40] bound adds ~28 cheap path hashes, never
+    width.
+    """
+
+    __slots__ = ("depth", "level_offset", "levels", "_dirty", "_root")
+
+    def __init__(self, nodes: bytes, limit: int, level_offset: int = 0):
+        width = next_pow_of_two(limit)
+        self.depth = (width - 1).bit_length()
+        self.level_offset = level_offset
+        self.levels: list[bytearray] = [bytearray(nodes)]
+        self._dirty: set[int] | None = None  # None => full (re)build pending
+        self._root: bytes | None = None
+
+    def clone(self) -> "IncrementalPaddedTree":
+        new = IncrementalPaddedTree.__new__(IncrementalPaddedTree)
+        new.depth = self.depth
+        new.level_offset = self.level_offset
+        new.levels = [bytearray(level) for level in self.levels]
+        new._dirty = set(self._dirty) if self._dirty is not None else None
+        new._root = self._root
+        return new
+
+    def node_count(self) -> int:
+        return len(self.levels[0]) // 32
+
+    def set_node(self, index: int, node: bytes) -> None:
+        """Replace (or append at ``node_count()``) one level-0 node."""
+        level0 = self.levels[0]
+        n = len(level0) // 32
+        if index == n:
+            level0 += node
+        elif index < n:
+            level0[32 * index : 32 * (index + 1)] = node
+        else:
+            raise IndexError(f"node {index} beyond populated width {n}")
+        if self._dirty is not None:
+            self._dirty.add(index)
+
+    def truncate(self, count: int) -> None:
+        """Drop level-0 nodes beyond ``count`` (shrink is rare enough that
+        it schedules a full level rebuild rather than path surgery)."""
+        level0 = self.levels[0]
+        if len(level0) // 32 > count:
+            del level0[32 * count :]
+            self._dirty = None
+
+    def root(self) -> bytes:
+        if self._dirty is None:
+            self._rebuild()
+        elif self._dirty:
+            self._update_paths()
+        self._dirty = set()
+        return self._root  # type: ignore[return-value]
+
+    def _rebuild(self) -> None:
+        self.levels = self.levels[:1]
+        cur = self.levels[0]
+        for d in range(self.depth):
+            data = bytes(cur)
+            if (len(data) // 32) % 2 == 1:
+                data += zero_hash(self.level_offset + d)
+            cur = bytearray(hash_level(data)) if data else bytearray()
+            self.levels.append(cur)
+        self._root = (
+            bytes(cur[:32]) if cur else zero_hash(self.level_offset + self.depth)
+        )
+
+    def _update_paths(self) -> None:
+        indices = self._dirty
+        for d in range(self.depth):
+            cur = self.levels[d]
+            n = len(cur) // 32
+            nxt = self.levels[d + 1]
+            parents = {i >> 1 for i in indices}
+            for j in sorted(parents):
+                left = bytes(cur[64 * j : 64 * j + 32])
+                if 2 * j + 1 < n:
+                    right = bytes(cur[64 * j + 32 : 64 * j + 64])
+                else:
+                    right = zero_hash(self.level_offset + d)
+                parent = hash_pair(left, right)
+                if 32 * j == len(nxt):
+                    nxt += parent
+                else:
+                    nxt[32 * j : 32 * (j + 1)] = parent
+            indices = parents
+        self._root = bytes(self.levels[-1][:32])

@@ -1,0 +1,375 @@
+"""Incremental hash_tree_root: dirty-group tracking regression tests.
+
+Two layers of evidence (docs/INCREMENTAL_HTR.md):
+
+* WORK-DONE regression — the digest-count instrumentation (ssz/hash.py)
+  proves a single-element edit re-merkleizes one 4096-leaf group plus the
+  log-depth path, not the whole collection. Wall-clock can't prove that
+  on shared CI hardware; a hash count can (the CPU proxy for the
+  ``one_validator_edit_s`` acceptance number in ISSUE 1).
+* BIT-IDENTITY property — randomized mutation sequences (store / append /
+  pop / nested-field writes / slice stores / bulk_store sweeps / index-
+  shifting fallbacks) keep the incremental root equal to an independent
+  naive hashlib merkleizer on small geometry, and equal to a cold
+  deserialize-then-rehash on real BeaconStates across all six forks.
+"""
+
+import hashlib
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ethereum_consensus_tpu.ssz import core as ssz_core
+from ethereum_consensus_tpu.ssz import hash as ssz_hash
+from ethereum_consensus_tpu.ssz.core import (
+    ByteVector,
+    CachedRootList,
+    Container,
+    List,
+    bulk_store,
+    uint64,
+)
+
+
+def _h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _naive_merkleize(chunks: list, limit: int) -> bytes:
+    """Independent reference: full zero-padded tree, plain hashlib."""
+    width = 1
+    while width < limit:
+        width *= 2
+    nodes = list(chunks) + [b"\x00" * 32] * (width - len(chunks))
+    while len(nodes) > 1:
+        nodes = [_h(nodes[i] + nodes[i + 1]) for i in range(0, len(nodes), 2)]
+    return nodes[0]
+
+
+class Val(Container):
+    a: uint64
+    b: ByteVector[32]
+
+
+def _naive_val_root(v) -> bytes:
+    return _h(int(v.a).to_bytes(8, "little").ljust(32, b"\x00") + bytes(v.b))
+
+
+def _naive_list_root(values, limit: int) -> bytes:
+    root = _naive_merkleize([_naive_val_root(v) for v in values], limit)
+    return _h(root + len(values).to_bytes(32, "little"))
+
+
+def _naive_u64_list_root(values, limit: int) -> bytes:
+    packed = b"".join(int(v).to_bytes(8, "little") for v in values)
+    if len(packed) % 32:
+        packed += b"\x00" * (32 - len(packed) % 32)
+    chunks = [packed[i : i + 32] for i in range(0, len(packed), 32)]
+    root = _naive_merkleize(chunks, (limit * 8 + 31) // 32)
+    return _h(root + len(values).to_bytes(32, "little"))
+
+
+@pytest.fixture
+def small_groups():
+    """Shrink the dirty-group geometry so small collections exercise many
+    groups (the module globals exist for exactly this)."""
+    saved = (
+        ssz_core._DIRTY_GROUP_SHIFT,
+        ssz_core._DIRTY_TRACK_MIN_CHUNKS,
+        ssz_core._BULK_ROOTS_MIN,
+    )
+    ssz_core._DIRTY_GROUP_SHIFT = 2
+    ssz_core._DIRTY_TRACK_MIN_CHUNKS = 1 << 2
+    ssz_core._BULK_ROOTS_MIN = 4
+    try:
+        yield
+    finally:
+        (
+            ssz_core._DIRTY_GROUP_SHIFT,
+            ssz_core._DIRTY_TRACK_MIN_CHUNKS,
+            ssz_core._BULK_ROOTS_MIN,
+        ) = saved
+
+
+# ---------------------------------------------------------------------------
+# work-done regression (real 4096-leaf geometry)
+# ---------------------------------------------------------------------------
+
+
+def test_digest_count_single_container_edit():
+    """One field write on one element of an 8192-element scalar-leaf
+    container list re-merkleizes ≤ one 4096-leaf group + the log-depth
+    path — never the whole collection (the registry-walk bound)."""
+    LT = List[Val, 1 << 40]
+    values = CachedRootList(
+        Val(a=i, b=i.to_bytes(4, "little") * 8) for i in range(8192)
+    )
+    LT.hash_tree_root(values)
+    assert values._dirty_groups == set(), "tracking must be armed"
+
+    # warm re-walk: zero tree work (root served from the group tree)
+    before = ssz_hash.digest_count()
+    LT.hash_tree_root(values)
+    assert ssz_hash.digest_count() - before <= 2  # length mix-in only
+
+    before = ssz_hash.digest_count()
+    values[5000].a = 10**15
+    root = LT.hash_tree_root(values)
+    delta = ssz_hash.digest_count() - before
+    # one 4096-leaf group (4095) + tree path (28 for limit 2^40) + the
+    # element's own root + the length mix-in
+    assert delta <= 4096 + 40, f"single edit cost {delta} digests"
+
+    # bit-identity of the spliced root vs a cold rebuild
+    cold = CachedRootList(Val(a=v.a, b=v.b) for v in values)
+    assert LT.hash_tree_root(cold) == root
+
+
+def test_digest_count_single_packed_edit():
+    """One store into a 2^20-element uint64 list re-merkleizes ≤ one
+    4096-chunk group + the log-depth path."""
+    LT = List[uint64, 1 << 24]
+    values = CachedRootList(range(1 << 20))
+    LT.hash_tree_root(values)
+    assert values._dirty_groups == set(), "tracking must be armed"
+
+    before = ssz_hash.digest_count()
+    values[777_777] = 31 * 10**9
+    root = LT.hash_tree_root(values)
+    delta = ssz_hash.digest_count() - before
+    # group (4095) + path (limit 2^22 chunks -> 2^10 groups: depth 10)
+    assert delta <= 4096 + 24, f"single edit cost {delta} digests"
+
+    cold = CachedRootList(values)
+    assert LT.hash_tree_root(cold) == root
+
+
+def test_digest_count_bulk_store_few_groups():
+    """A bulk_store that certifies a handful of changed indices costs a
+    few groups, not a full re-merkleization."""
+    LT = List[uint64, 1 << 24]
+    values = CachedRootList(range(1 << 20))
+    LT.hash_tree_root(values)
+
+    new = list(values)
+    for i in (3, 500_000, 1_000_000):
+        new[i] += 1
+    before = ssz_hash.digest_count()
+    bulk_store(values, new, [3, 500_000, 1_000_000])
+    root = LT.hash_tree_root(values)
+    delta = ssz_hash.digest_count() - before
+    assert delta <= 3 * 4096 + 64, f"3-element bulk edit cost {delta} digests"
+    assert root == LT.hash_tree_root(CachedRootList(new))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity property (shrunk geometry, independent naive reference)
+# ---------------------------------------------------------------------------
+
+
+def test_property_container_list_random_mutations(small_groups):
+    LIMIT = 4096
+    LT = List[Val, LIMIT]
+    rng = random.Random(1234)
+    values = CachedRootList(
+        Val(a=i, b=bytes([i % 256]) * 32) for i in range(24)
+    )
+    shadow = [(int(v.a), bytes(v.b)) for v in values]
+
+    def check():
+        got = LT.hash_tree_root(values)
+        want = _naive_list_root(
+            [Val(a=a, b=b) for a, b in shadow], LIMIT
+        )
+        assert got == want
+
+    check()
+    for step in range(300):
+        op = rng.randrange(8)
+        n = len(values)
+        if op == 0 and n:  # store a fresh element
+            i = rng.randrange(n)
+            v = Val(a=rng.getrandbits(60), b=rng.randbytes(32))
+            values[i] = v
+            shadow[i] = (int(v.a), bytes(v.b))
+        elif op == 1:  # append
+            v = Val(a=rng.getrandbits(60), b=rng.randbytes(32))
+            values.append(v)
+            shadow.append((int(v.a), bytes(v.b)))
+        elif op == 2 and n > 4:  # end pop (tracked)
+            values.pop()
+            shadow.pop()
+        elif op == 3 and n:  # nested field write through the parent chain
+            i = rng.randrange(n)
+            values[i].a = rng.getrandbits(60)
+            shadow[i] = (int(values[i].a), shadow[i][1])
+        elif op == 4 and n:  # second field
+            i = rng.randrange(n)
+            values[i].b = rng.randbytes(32)
+            shadow[i] = (shadow[i][0], bytes(values[i].b))
+        elif op == 5 and n > 2:  # contiguous slice store
+            i = rng.randrange(n - 2)
+            repl = [
+                Val(a=rng.getrandbits(60), b=rng.randbytes(32))
+                for _ in range(2)
+            ]
+            values[i : i + 2] = repl
+            shadow[i : i + 2] = [(int(v.a), bytes(v.b)) for v in repl]
+        elif op == 6 and n:  # index-shifting mutation: tracking must drop
+            i = rng.randrange(n)
+            v = Val(a=rng.getrandbits(60), b=rng.randbytes(32))
+            values.insert(i, v)
+            shadow.insert(i, (int(v.a), bytes(v.b)))
+        elif op == 7 and n > 8:  # interior delete: tracking must drop
+            i = rng.randrange(n - 1)
+            del values[i]
+            del shadow[i]
+        if step % 17 == 0:
+            check()
+    check()
+
+
+def test_property_packed_list_random_mutations(small_groups):
+    LIMIT = 1 << 16
+    LT = List[uint64, LIMIT]
+    rng = random.Random(4321)
+    values = CachedRootList(range(40))
+    shadow = list(range(40))
+
+    def check():
+        assert LT.hash_tree_root(values) == _naive_u64_list_root(
+            shadow, LIMIT
+        )
+
+    check()
+    for step in range(300):
+        op = rng.randrange(6)
+        n = len(values)
+        if op == 0 and n:
+            i = rng.randrange(n)
+            values[i] = shadow[i] = rng.getrandbits(64)
+        elif op == 1:
+            v = rng.getrandbits(64)
+            values.append(v)
+            shadow.append(v)
+        elif op == 2 and n > 4:
+            values.pop()
+            shadow.pop()
+        elif op == 3 and n > 4:  # bulk sweep with certified indices
+            new = list(shadow)
+            idxs = sorted(rng.sample(range(n), max(1, n // 4)))
+            for i in idxs:
+                new[i] = rng.getrandbits(63)
+            bulk_store(values, new, idxs)
+            shadow = new
+        elif op == 4 and n > 2:  # bulk sweep, unknown indices
+            new = [v ^ 0xFF for v in shadow]
+            bulk_store(values, new)
+            shadow = new
+        elif op == 5 and n > 8:  # index-shifting mutation
+            i = rng.randrange(n - 1)
+            del values[i]
+            del shadow[i]
+        if step % 13 == 0:
+            check()
+    check()
+
+
+def test_property_copies_diverge_independently(small_groups):
+    """state.copy() shares memos copy-on-write: mutate original and copy
+    in interleaved sequence; both must keep exact roots."""
+    LIMIT = 4096
+    LT = List[Val, LIMIT]
+    rng = random.Random(99)
+    a = CachedRootList(Val(a=i, b=bytes([i]) * 32) for i in range(30))
+    LT.hash_tree_root(a)  # arm tracking before copying
+    b = ssz_core._copy_value(LT, a)
+    sa = [(int(v.a), bytes(v.b)) for v in a]
+    sb = list(sa)
+    for _ in range(120):
+        which = rng.randrange(2)
+        vals, shadow = (a, sa) if which == 0 else (b, sb)
+        op = rng.randrange(3)
+        n = len(vals)
+        if op == 0 and n:
+            i = rng.randrange(n)
+            vals[i].a = rng.getrandbits(50)
+            shadow[i] = (int(vals[i].a), shadow[i][1])
+        elif op == 1:
+            v = Val(a=rng.getrandbits(50), b=rng.randbytes(32))
+            vals.append(v)
+            shadow.append((int(v.a), bytes(v.b)))
+        elif op == 2 and n > 4:
+            vals.pop()
+            shadow.pop()
+        if rng.randrange(4) == 0:
+            got_a = LT.hash_tree_root(a)
+            got_b = LT.hash_tree_root(b)
+            assert got_a == _naive_list_root(
+                [Val(a=x, b=y) for x, y in sa], LIMIT
+            )
+            assert got_b == _naive_list_root(
+                [Val(a=x, b=y) for x, y in sb], LIMIT
+            )
+
+
+# ---------------------------------------------------------------------------
+# six-fork state-level bit-identity (incremental vs cold deserialize)
+# ---------------------------------------------------------------------------
+
+FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+@pytest.mark.parametrize("fork", FORKS)
+def test_state_roots_match_cold_recompute(fork, small_groups):
+    """Randomized state mutations (balances stores, bulk sweeps, registry
+    field writes, appends, randao writes, participation sweeps) keep the
+    incremental root bit-identical to a cold serialize->deserialize->
+    rehash on a fresh object graph."""
+    import chain_utils
+
+    state, ctx = chain_utils.fresh_genesis_fork(fork, 64, "minimal")
+    state_type = type(state)
+    # decouple from the module-level genesis cache: memos built under the
+    # shrunk geometry must never leak into other tests' copies
+    state = state_type.deserialize(state_type.serialize(state))
+    rng = random.Random(hash(fork) & 0xFFFF)
+
+    def cold_root():
+        fresh = state_type.deserialize(state_type.serialize(state))
+        return state_type.hash_tree_root(fresh)
+
+    assert state_type.hash_tree_root(state) == cold_root()
+    n = len(state.validators)
+    for step in range(40):
+        op = rng.randrange(6)
+        if op == 0:
+            state.balances[rng.randrange(n)] = rng.getrandbits(40)
+        elif op == 1:
+            new = [v + rng.randrange(3) for v in state.balances]
+            changed = [i for i, (x, y) in enumerate(zip(new, state.balances)) if x != y]
+            bulk_store(state.balances, new, changed)
+        elif op == 2:
+            v = state.validators[rng.randrange(n)]
+            v.effective_balance = rng.getrandbits(40)
+        elif op == 3:
+            src = state.validators[rng.randrange(n)]
+            state.validators.append(src.copy())
+            state.balances.append(32 * 10**9)
+            n += 1
+        elif op == 4:
+            mixes = state.randao_mixes
+            mixes[rng.randrange(len(mixes))] = rng.randbytes(32)
+        elif op == 5 and fork != "phase0":
+            part = state.previous_epoch_participation
+            if len(part):
+                part[rng.randrange(len(part))] = rng.randrange(8)
+        if step % 8 == 0:
+            assert state_type.hash_tree_root(state) == cold_root(), (
+                f"{fork}: divergence at step {step}"
+            )
+    assert state_type.hash_tree_root(state) == cold_root()
