@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import os
 import secrets
+import threading
 
 from .. import _device_flags
 from ..error import (
@@ -57,6 +58,9 @@ __all__ = [
     "eth_fast_aggregate_verify",
     "verify_signature",
     "verify_signature_sets",
+    "verify_signature_sets_async",
+    "warm_pubkey_cache",
+    "warm_raw_keys",
     "backend_name",
     "SECRET_KEY_SIZE",
     "PUBLIC_KEY_SIZE",
@@ -154,12 +158,21 @@ class SecretKey:
 # identity aggregates) reads but never writes it. ~15MB at capacity.
 _RAW_PK_CACHE: "dict[bytes, bytes]" = {}
 _RAW_PK_CACHE_MAX = 1 << 16
+# inserts/evictions serialize: the chain pipeline fills this cache from
+# the background verifier thread while the application thread reads and
+# fills it too, and an unlocked FIFO evict (pop of the first iter key)
+# races into KeyError. Reads stay lock-free — dict get is atomic.
+_PK_CACHE_LOCK = threading.Lock()
 
 
 def _pk_cache_put(data: bytes, raw: bytes) -> None:
-    if len(_RAW_PK_CACHE) >= _RAW_PK_CACHE_MAX:
-        _RAW_PK_CACHE.pop(next(iter(_RAW_PK_CACHE)))
-    _RAW_PK_CACHE[data] = raw
+    with _PK_CACHE_LOCK:
+        while len(_RAW_PK_CACHE) >= _RAW_PK_CACHE_MAX:
+            try:
+                _RAW_PK_CACHE.pop(next(iter(_RAW_PK_CACHE)))
+            except (KeyError, StopIteration):  # pragma: no cover - defensive
+                break
+        _RAW_PK_CACHE[data] = raw
 
 
 def warm_pubkey_cache(keys) -> None:
@@ -235,6 +248,34 @@ class PublicKey:
             # results are legitimately reachable here), so its entries
             # must never satisfy from_bytes' validation
         return self._raw
+
+    @classmethod
+    def from_validated_bytes(cls, data: bytes) -> "PublicKey":
+        """Trusted parse for keys from a source that only admits valid
+        keys — the beacon registry: a deposit whose pubkey is not a
+        valid subgroup point cannot carry a valid deposit signature, so
+        it never joins, and validator pubkeys are immutable afterwards.
+
+        Skips the eager native decompression ``from_bytes`` pays; the
+        affine form materializes lazily at verification time
+        (``raw_uncompressed`` — stage B of the chain pipeline), where
+        uncached keys go through the eight-wide bulk decompression
+        (``warm_raw_keys``) instead of a per-key sqrt at collection
+        time. Length and the infinity encoding are still rejected here
+        (flag-byte check), so a corrupted registry fails loudly at the
+        call site."""
+        data = bytes(data)
+        if len(data) != PUBLIC_KEY_SIZE:
+            raise InvalidPublicKeyError(
+                f"public key must be {PUBLIC_KEY_SIZE} bytes, got {len(data)}"
+            )
+        if data[0] & _INFINITY_FLAG:
+            raise InvalidPublicKeyError("public key cannot be the identity")
+        if not _native():
+            return cls.from_bytes(data)  # no lazy raw path in the oracle
+        self = cls._from_valid_bytes(data)
+        self._raw = _RAW_PK_CACHE.get(data)
+        return self
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "PublicKey":
@@ -384,6 +425,44 @@ class Signature:
 # ---------------------------------------------------------------------------
 
 
+def warm_raw_keys(public_keys) -> None:
+    """Eight-wide bulk decompression for any keys whose affine form is
+    not yet materialized — the verification-time complement of the
+    deferred ``from_validated_bytes`` parse.
+
+    Deliberately does NOT route through the process-wide cache: in the
+    replay workload each attester key verifies once per epoch, so at
+    registry scale the FIFO cache evicts a block's keys before they are
+    ever reused — pure churn. The results land directly on the
+    ``PublicKey`` instances instead. The subgroup check is skipped under
+    the same contract as ``raw_uncompressed`` (these keys' membership is
+    established by their source — the registry's deposit rule, or an
+    earlier subgroup-checked parse); a key the batch cannot decompress is
+    simply left cold, and the per-key path raises its precise error."""
+    if not _native():
+        return
+    todo: "dict[bytes, list[PublicKey]]" = {}
+    for pk in public_keys:
+        if pk._raw is not None or pk._bytes is None:
+            continue
+        hit = _RAW_PK_CACHE.get(pk._bytes)
+        if hit is not None:
+            pk._raw = hit
+            continue
+        todo.setdefault(pk._bytes, []).append(pk)
+    if len(todo) < 8:  # below the lane width there is nothing to win
+        return
+    keys = list(todo)
+    for rc_raw_inf, key in zip(
+        native_bls.g1_decompress_batch(keys, check_subgroup=False), keys
+    ):
+        rc, raw, is_inf = rc_raw_inf
+        if rc == 0:
+            raw = b"\x00" * 96 if is_inf else raw
+            for pk in todo[key]:
+                pk._raw = raw
+
+
 def verify_signature(
     public_key: PublicKey, message: bytes, signature: Signature, dst: bytes = ETH_DST
 ) -> bool:
@@ -477,7 +556,10 @@ def fast_aggregate_verify(
         if any(pk.is_infinity() for pk in public_keys):
             return False
         # cached raw affine keys skip the per-key decompression sqrt
-        # (subgroup membership was established at parse time)
+        # (subgroup membership was established at parse time); deferred
+        # registry parses bulk-decompress eight-wide here instead of
+        # one-by-one below
+        warm_raw_keys(public_keys)
         rc = native_bls.fast_aggregate_verify_raw(
             [pk.raw_uncompressed() for pk in public_keys], message,
             signature.to_bytes(), dst,
@@ -571,6 +653,10 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
     keys, every set's pubkey aggregation runs as ONE segmented device fold
     (ops/g1.py) and the native multi-pairing sees single-key sets — the
     device owns the O(total keys) work, the host the O(sets) pairings."""
+    # deferred registry parses (from_validated_bytes) materialize here,
+    # through the eight-wide bulk path — in the chain pipeline this is
+    # stage B, off the block-application critical path
+    warm_raw_keys(pk for s in sets for pk in s.public_keys)
     total_keys = sum(len(s.public_keys) for s in sets)
     if _device_flags.bls_agg_enabled(total_keys):
         try:
@@ -692,3 +778,54 @@ def verify_signature_sets(
     if _native() and len(sets) > 1 and _batch_all_valid(sets, dst):
         return [True] * len(sets)
     return [s.verify(dst) for s in sets]
+
+
+# ---------------------------------------------------------------------------
+# Async dispatch (the chain pipeline's stage-B hook, pipeline/scheduler.py)
+# ---------------------------------------------------------------------------
+
+_VERIFY_POOL = None
+
+
+def _verify_pool():
+    """One process-wide single-thread verifier. ONE worker on purpose:
+    dispatches complete FIFO (the pipeline needs windows settled in chain
+    order), and the pairing engines underneath (native ctypes — which
+    releases the GIL for the whole multi-pairing — or the device route)
+    each already own their parallelism; stacking a second in-flight batch
+    on the same engine would only fight it for cores/chip."""
+    global _VERIFY_POOL
+    if _VERIFY_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _VERIFY_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="bls-verify"
+        )
+    return _VERIFY_POOL
+
+
+def verify_signature_sets_async(
+    sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None
+):
+    """Dispatch one batched verification to the background verifier thread;
+    returns a ``concurrent.futures.Future[list[bool]]``.
+
+    The host thread keeps mutating state (SSZ writes, incremental HTR)
+    while the multi-pairing runs: the native batch call is a single ctypes
+    entry that releases the GIL for its whole duration, so the overlap is
+    real CPU parallelism, not just interleaving. ``timer``, if given, is
+    called on the worker with the verification's duration in seconds —
+    the pipeline's stage-occupancy probe."""
+    sets = list(sets)
+
+    def run() -> list[bool]:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            return verify_signature_sets(sets, dst)
+        finally:
+            if timer is not None:
+                timer(_time.perf_counter() - t0)
+
+    return _verify_pool().submit(run)

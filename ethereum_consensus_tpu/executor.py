@@ -7,6 +7,11 @@ then upgrade_to_X, executor.rs:210-302), including the corner where the
 block sits exactly on the upgrade slot (state_transition_block_in_slot,
 executor.rs:215-224). Unlike the reference (phase0..deneb,
 executor.rs:155-172), electra is supported.
+
+Beyond the reference: ``stream`` replays an iterable of blocks through
+the chain pipeline (pipeline/engine.py) — speculative host application
+overlapped with windowed cross-block signature verification — with
+observable semantics identical to an ``apply_block`` loop.
 """
 
 from __future__ import annotations
@@ -84,3 +89,28 @@ class Executor:
             )
 
         self.state = BeaconState.from_fork(destination, state)
+
+    def stream(
+        self,
+        signed_blocks,
+        policy=None,
+        validation: Validation = Validation.ENABLED,
+        stats=None,
+    ):
+        """Apply an iterable of signed blocks through the chain pipeline
+        (``pipeline.ChainPipeline``): speculative host application
+        overlapped with windowed cross-block signature verification on a
+        background worker. Returns the run's ``PipelineStats``.
+
+        Observable semantics match a loop of ``apply_block``: the same
+        final state bit-for-bit on success; on an invalid block, the same
+        structured error raises and ``self.state`` is the last state
+        whose signatures fully verified (not mid-block garbage)."""
+        from .pipeline import ChainPipeline
+
+        pipeline = ChainPipeline(
+            self, policy=policy, validation=validation, stats=stats
+        )
+        for signed_block in signed_blocks:
+            pipeline.submit(signed_block)
+        return pipeline.close()

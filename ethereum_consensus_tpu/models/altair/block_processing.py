@@ -265,9 +265,13 @@ def process_sync_aggregate(state, sync_aggregate, context) -> None:
     error = InvalidSyncAggregate("invalid sync committee aggregate signature")
     try:
         sig = bls.Signature.from_bytes(sync_aggregate.sync_committee_signature)
-        # cold sync committees decompress eight keys per sqrt chain
-        bls.warm_pubkey_cache(bytes(pk) for pk in participant_keys)
-        keys = [bls.PublicKey.from_bytes(bytes(pk)) for pk in participant_keys]
+        # committee members are registry keys (valid by the deposit
+        # rule): decompression defers to verification — the pipeline's
+        # stage B — where uncached keys go eight-wide per sqrt chain
+        keys = [
+            bls.PublicKey.from_validated_bytes(bytes(pk))
+            for pk in participant_keys
+        ]
     except Exception as exc:
         raise InvalidSyncAggregate(str(exc)) from exc
     if not keys:

@@ -9,8 +9,14 @@ from ..transition import (
 )
 from .block_processing import process_block
 from .epoch_processing import process_epoch
+from .slot_processing import process_slots
 
-__all__ = ["Validation", "state_transition", "state_transition_block_in_slot"]
+__all__ = [
+    "Validation",
+    "process_slots",
+    "state_transition",
+    "state_transition_block_in_slot",
+]
 
 
 def state_transition_block_in_slot(state, signed_block, validation, context) -> None:

@@ -602,12 +602,14 @@ def is_valid_indexed_attestation(state, indexed_attestation, context, error=None
         raise InvalidIndexedAttestation("attesting indices not sorted/unique")
     if any(i >= len(state.validators) for i in indices):
         raise InvalidIndexedAttestation("attesting index out of range")
-    # eight-wide bulk decompression for any attesters not yet in the
-    # process-wide pubkey cache (a cold committee costs one batched call
-    # instead of per-key sqrt + subgroup chains)
-    bls.warm_pubkey_cache(state.validators[i].public_key for i in indices)
+    # registry keys are valid by the deposit rule, so the native
+    # decompression defers to VERIFICATION time (bls.warm_raw_keys runs
+    # the eight-wide bulk path there) — in the chain pipeline that is
+    # stage B, overlapped with the next block's application instead of
+    # serialized into this one's
     public_keys = [
-        bls.PublicKey.from_bytes(state.validators[i].public_key) for i in indices
+        bls.PublicKey.from_validated_bytes(state.validators[i].public_key)
+        for i in indices
     ]
     domain = get_domain(
         state,

@@ -1,45 +1,45 @@
-"""phase0 state transition.
+"""phase0 state transition (generic skeleton + phase0 block/epoch).
 
 Reference parity: ethereum-consensus/src/phase0/state_transition.rs:15-106
 (state_transition_block_in_slot, state_transition, Validation toggle).
+
+Historically this module carried its OWN ``Validation`` enum and a
+hand-rolled skeleton predating ``models/transition.py``. The duplicate
+enum was a live bug: the ``Executor`` passes the shared
+``models.transition.Validation.ENABLED``, whose ``is`` check against the
+private enum's member was always False — so phase0 blocks applied
+through the Executor silently skipped proposer-signature AND state-root
+validation (direct calls passing this module's enum were unaffected,
+which is why the phase0 suites never caught it). Sharing the generic
+skeleton, like every other fork, closes the hole.
 """
 
 from __future__ import annotations
 
-from enum import Enum
-
-from ...error import InvalidStateRoot
-from ..signature_batch import collect_signatures
+from ..transition import (
+    Validation,
+    state_transition_block_in_slot_generic,
+    state_transition_generic,
+)
 from .block_processing import process_block
-from .helpers import verify_block_signature
+from .epoch_processing import process_epoch
 from .slot_processing import process_slots
 
-__all__ = ["Validation", "state_transition", "state_transition_block_in_slot"]
-
-
-class Validation(Enum):
-    ENABLED = "enabled"
-    DISABLED = "disabled"
+__all__ = [
+    "Validation",
+    "process_slots",
+    "state_transition",
+    "state_transition_block_in_slot",
+]
 
 
 def state_transition_block_in_slot(state, signed_block, validation, context) -> None:
-    """Apply a block to a state already advanced to the block's slot
-    (state_transition.rs:15). All of the block's signature sets are
-    collected and verified as one batch before the state-root check (see
-    models/signature_batch.py)."""
-    block = signed_block.message
-    with collect_signatures() as batch:
-        if validation is Validation.ENABLED:
-            verify_block_signature(state, signed_block, context)
-        process_block(state, block, context)
-        batch.flush()
-    if validation is Validation.ENABLED:
-        state_root = type(state).hash_tree_root(state)
-        if block.state_root != state_root:
-            raise InvalidStateRoot(block.state_root, state_root)
+    state_transition_block_in_slot_generic(
+        state, signed_block, validation, context, process_block
+    )
 
 
 def state_transition(state, signed_block, context, validation=Validation.ENABLED) -> None:
-    """(state_transition.rs:67)"""
-    process_slots(state, signed_block.message.slot, context)
-    state_transition_block_in_slot(state, signed_block, validation, context)
+    state_transition_generic(
+        state, signed_block, context, process_epoch, process_block, validation
+    )

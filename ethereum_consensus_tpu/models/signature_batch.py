@@ -20,14 +20,20 @@ Semantics are preserved exactly:
 * Each deferred set carries the structured error its call site would have
   raised; ``flush`` raises the error of the FIRST failing set in
   insertion (i.e. spec) order, so error attribution still names the
-  specific invalid operation. Caveat: that ordering holds *among
-  signature errors only*. Because verification is deferred to the flush,
-  a structurally invalid operation later in the block (e.g. a malformed
-  exit) raises at its call site BEFORE an earlier operation's bad
-  signature is ever checked — the sequential path would have surfaced
-  the signature error first. Either way the transition aborts with a
-  structured framework error and the state is discarded, so only the
-  error *type* differs in that cross case, never validity.
+  specific invalid operation. The historical cross case — a structurally
+  invalid operation later in the block raising at its call site before an
+  earlier operation's bad signature was ever checked — is closed: when
+  block processing aborts with a structured error, the transition first
+  re-checks the sets already collected (``raise_if_any_invalid``) and
+  raises the earliest failing one instead, restoring strict call-site
+  order between signature and structural errors.
+* Cross-BLOCK windowing (the chain pipeline, ``pipeline/``): inside a
+  ``defer_flushes(sink)`` scope a batch's ``flush`` hands its sets to the
+  sink instead of verifying, so K blocks' claims coalesce into ONE
+  multi-pairing (N+K Miller loops, one shared final exponentiation)
+  dispatched later. ``merge``/``split`` are the window algebra: merge
+  preserves insertion order across blocks, split recovers the per-block
+  boundaries for failure attribution.
 * A failed flush aborts the whole transition — identical observable
   behavior to the sequential path, because an invalid block discards the
   state either way (the reference's Executor does the same;
@@ -48,11 +54,20 @@ __all__ = [
     "SignatureBatch",
     "collect_signatures",
     "current_batch",
+    "defer_flushes",
+    "flush_sink",
     "verify_or_defer",
 ]
 
 _CURRENT: contextvars.ContextVar["SignatureBatch | None"] = contextvars.ContextVar(
     "signature_batch", default=None
+)
+
+# cross-block flush sink (the pipeline's coalescing window): when set, a
+# batch's flush() merges into the sink instead of verifying, so the
+# verification moment moves from "end of each block" to "window dispatch"
+_FLUSH_SINK: contextvars.ContextVar["SignatureBatch | None"] = contextvars.ContextVar(
+    "signature_flush_sink", default=None
 )
 
 
@@ -78,9 +93,57 @@ class SignatureBatch:
         self._sets.append(bls.SignatureSet(public_keys, message, signature))
         self._errors.append(error)
 
+    @property
+    def sets(self) -> "list[bls.SignatureSet]":
+        """The accumulated sets, insertion (call-site) order. Read-only by
+        convention — mutate only through defer/merge/split/flush."""
+        return self._sets
+
+    @property
+    def errors(self) -> "list[Exception]":
+        """The structured error each set raises on failure, aligned with
+        ``sets``."""
+        return self._errors
+
+    def merge(self, other: "SignatureBatch") -> None:
+        """Append ``other``'s sets after this batch's (call-site order across
+        the concatenation = block order, then in-block order). ``other`` is
+        left intact, so a pipeline window can keep per-block batches for
+        failure attribution while flushing one merged copy."""
+        self._sets.extend(other._sets)
+        self._errors.extend(other._errors)
+
+    def split(self, sizes: "list[int]") -> "list[SignatureBatch]":
+        """Partition into consecutive sub-batches of the given sizes (the
+        inverse of ``merge`` given the per-block set counts). The sizes
+        must sum to ``len(self)``."""
+        if sum(sizes) != len(self._sets):
+            raise ValueError(
+                f"split sizes sum to {sum(sizes)}, batch holds {len(self._sets)}"
+            )
+        parts: list[SignatureBatch] = []
+        at = 0
+        for n in sizes:
+            part = SignatureBatch()
+            part._sets = self._sets[at : at + n]
+            part._errors = self._errors[at : at + n]
+            parts.append(part)
+            at += n
+        return parts
+
     def flush(self) -> None:
-        """One batched verification; raises the first failing set's error."""
+        """One batched verification; raises the first failing set's error.
+
+        Inside a ``defer_flushes`` scope the sets are handed to the sink
+        instead (drained from this batch) and no verification happens —
+        the pipeline window verifies them later as one coalesced
+        multi-pairing."""
         if not self._sets:
+            return
+        sink = _FLUSH_SINK.get()
+        if sink is not None and sink is not self:
+            sink.merge(self)
+            self._sets, self._errors = [], []
             return
         sets, errors = self._sets, self._errors
         self._sets, self._errors = [], []
@@ -88,9 +151,43 @@ class SignatureBatch:
             if not ok:
                 raise error
 
+    def raise_if_any_invalid(self) -> None:
+        """Verify the accumulated sets NOW (ignoring any flush sink) and
+        raise the first failing set's error, else return with the batch
+        intact. The error-path probe behind strict call-site-order
+        attribution: when block processing aborts structurally, any
+        already-collected bad signature from an earlier call site must
+        win over the later structural error."""
+        if not self._sets:
+            return
+        for ok, error in zip(bls.verify_signature_sets(self._sets), self._errors):
+            if not ok:
+                raise error
+
 
 def current_batch() -> SignatureBatch | None:
     return _CURRENT.get()
+
+
+def flush_sink() -> SignatureBatch | None:
+    return _FLUSH_SINK.get()
+
+
+@contextmanager
+def defer_flushes(sink: SignatureBatch):
+    """Scope within which any batch's ``flush`` coalesces into ``sink``
+    instead of verifying — the cross-block window of the chain pipeline
+    (``pipeline/engine.py``). Scopes nest (inner sink wins), and the sink
+    itself still verifies when IT flushes outside the scope.
+
+    Structural validation is unaffected: only the signature-verification
+    moment moves. ``raise_if_any_invalid`` deliberately bypasses the sink
+    so error-path attribution stays synchronous."""
+    token = _FLUSH_SINK.set(sink)
+    try:
+        yield sink
+    finally:
+        _FLUSH_SINK.reset(token)
 
 
 @contextmanager

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from enum import Enum
 
-from ..error import InvalidStateRoot, StateTransitionError, checked_add
+from ..error import Error, InvalidStateRoot, StateTransitionError, checked_add
 from .phase0.containers import BeaconBlockHeader
 from .phase0.helpers import verify_block_signature
 from .signature_batch import collect_signatures
@@ -65,12 +65,22 @@ def state_transition_block_in_slot_generic(
     while processing and verified as ONE batch (signature_batch module)
     before the state-root check. An invalid signature aborts the
     transition with the same structured error the sequential path raises,
-    attributed to the first failing operation in spec order."""
+    attributed to the first failing operation in spec order. When block
+    processing aborts structurally mid-collection, the sets already
+    deferred (all from earlier call sites) are verified first, so a bad
+    signature earlier in the block preempts the later structural error —
+    exactly the order the sequential path surfaces them in."""
     block = signed_block.message
     with collect_signatures() as batch:
-        if validation is Validation.ENABLED:
-            verify_block_signature(state, signed_block, context)
-        process_block(state, block, context)
+        try:
+            if validation is Validation.ENABLED:
+                verify_block_signature(state, signed_block, context)
+            process_block(state, block, context)
+        except Error:
+            # any structured abort (invalid operation, crypto parse,
+            # arithmetic guard): earlier call sites' signatures first
+            batch.raise_if_any_invalid()
+            raise
         batch.flush()
     if validation is Validation.ENABLED:
         state_root = type(state).hash_tree_root(state)

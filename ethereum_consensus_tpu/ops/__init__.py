@@ -6,6 +6,8 @@ never import it directly — device acceleration is installed explicitly via
 ``install()``.
 """
 
+import os
+
 from .. import _device_flags
 from .._jax_cache import enable as _enable_jax_cache
 
@@ -20,15 +22,39 @@ from .sha256 import install_device_hasher, sha256_64b_pallas, sha256_64b_xla
 DEFAULT_SWEEPS_MIN_N = 1 << 17
 DEFAULT_SHUFFLE_MIN_N = 1 << 15
 DEFAULT_BLS_AGG_MIN_N = 1 << 12
-# Device RLC multi-pairing (ops/pairing.py): disabled by default. The
-# kernel is bit-identical to the native backend and fully routed, but on
-# chips without native wide-integer multiply (v5e: u64 lane products are
-# emulated) the measured Miller throughput loses to the ADX C++ path
-# (~3.2ms vs ~0.55ms per pair at 4k batch). Opt in via install(
-# pairing_min_sets=N) where the fleet's chips do better — the planned
-# int8 MXU product kernel (schoolbook columns as an int8 matmul against
-# a constant anti-diagonal matrix) is the path to flipping the default.
-DEFAULT_PAIRING_MIN_SETS = None
+# Device RLC multi-pairing (ops/pairing.py): auto-thresholded. The kernel
+# is bit-identical to the native backend and fully routed, but a SINGLE
+# chip without native wide-integer multiply (v5e: u64 lane products are
+# emulated) loses to the host IFMA engine (~119µs/pair) at block-sized
+# batches, so small flushes must stay host. What changed with the chain
+# pipeline (pipeline/engine.py): cross-block windowed flushes now reach
+# hundreds of sets per call, the scale where the set axis shards over the
+# mesh (parallel/pairing.py — N chips buy ~N× batch throughput) and the
+# mont7 int8-MXU multiplier amortizes its launch cost. The auto default
+# therefore routes only those large coalesced flushes to the device;
+# everything below the threshold keeps the host engine. Override with
+# ECT_PAIRING_MIN_SETS=<n> (fleet chips measured better/worse) or
+# ECT_PAIRING_MIN_SETS=off to pin the host engine unconditionally; any
+# device trouble still falls back to host without changing verdicts
+# (crypto/bls.py _batch_device_pairing).
+_AUTO_PAIRING_MIN_SETS = 512
+
+
+def _pairing_min_sets_default() -> "int | None":
+    env = os.environ.get("ECT_PAIRING_MIN_SETS")
+    if env is None:
+        return _AUTO_PAIRING_MIN_SETS
+    env = env.strip().lower()
+    if env in ("", "off", "none", "host"):
+        return None
+    try:
+        n = int(env)
+    except ValueError:
+        return _AUTO_PAIRING_MIN_SETS
+    return n if n > 0 else None
+
+
+DEFAULT_PAIRING_MIN_SETS = _pairing_min_sets_default()
 
 
 def install(
@@ -78,6 +104,7 @@ def uninstall() -> None:
 
 
 __all__ = [
+    "DEFAULT_PAIRING_MIN_SETS",
     "DEFAULT_SHUFFLE_MIN_N",
     "DEFAULT_SWEEPS_MIN_N",
     "install",

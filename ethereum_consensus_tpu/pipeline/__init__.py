@@ -1,0 +1,30 @@
+"""Chain pipeline: async block application with cross-block signature
+batching.
+
+``ChainPipeline`` (engine.py) turns the one-shot ``Executor`` into a
+streaming engine: stage A speculatively applies each block on the host
+(state mutation + incremental HTR, signatures collected, not verified);
+stage B proves up to ``FlushPolicy.window_size`` consecutive blocks'
+merged signature sets in one coalesced multi-pairing on a background
+verifier, with a bounded in-flight queue (backpressure), rollback to the
+last committed state on a failed flush, and exact structured-error
+attribution. ``PipelineStats`` is the counter surface; ``python -m
+ethereum_consensus_tpu.pipeline --selfcheck`` is the smoke entry point.
+
+Host-only by construction: importing this package never imports jax —
+the device pairing route engages underneath ``crypto.bls`` exactly when
+``ops.install()`` has routed it.
+"""
+
+from .engine import ChainPipeline, PipelineBrokenError
+from .scheduler import FlushPolicy, VerifyScheduler, Window
+from .stats import PipelineStats
+
+__all__ = [
+    "ChainPipeline",
+    "FlushPolicy",
+    "PipelineBrokenError",
+    "PipelineStats",
+    "VerifyScheduler",
+    "Window",
+]

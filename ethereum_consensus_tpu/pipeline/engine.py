@@ -1,0 +1,346 @@
+"""ChainPipeline — the streaming block-application engine.
+
+The one-shot ``Executor`` (executor.rs:113 parity) applies a block and
+verifies its signatures synchronously, one block at a time. Serving
+heavy sync/replay traffic wants the shape every inference-serving stack
+uses instead: a bounded two-stage pipeline that keeps the pairing engine
+busy while the host mutates state.
+
+Stage A (the submitting thread) runs the full state transition for each
+block — slot advance, operation processing, incremental hash-tree-root,
+state-root check — but with every signature claim *collected*, not
+verified: the transition's per-block batch flushes into a cross-block
+window (``signature_batch.defer_flushes``) instead of pairing. The state
+mutation is therefore **speculative**: structurally validated, signatures
+pending. Deferred registry-key parses (``PublicKey.from_validated_bytes``)
+keep the G1 decompression off this stage too.
+
+Stage B (the background verifier, ``scheduler.py``) receives windows of
+up to K consecutive blocks' merged sets and proves each window in ONE
+random-linear-combination multi-pairing — N+K Miller loops, one shared
+final exponentiation — preceded by the eight-wide bulk decompression of
+any cold keys, on the native IFMA engine (ctypes releases the GIL for
+the whole call, so the overlap is real parallelism) or, above the
+``ops`` pairing threshold, the device/mesh pairing route.
+
+Commit protocol: a full state snapshot is the only O(registry) cost the
+pipeline adds to the success path, so snapshots are **checkpoints**,
+taken on every ``checkpoint_interval``-th window (at dispatch, when the
+live state IS the post-window state; root memos travel with the copy —
+docs/INCREMENTAL_HTR.md — so a checkpoint costs an object-graph walk,
+never a rehash). Between checkpoints the committed position is
+represented as "newest checkpoint + the proven blocks since", which a
+(rare, terminal) failure re-derives by deterministic replay.
+
+Rollback: when a window's verdicts come back dirty, the verifier's
+per-set fallback has already re-verified the window sequentially in
+call-site order, naming the first failing set and therefore the failing
+block and operation. The engine discards the speculative state, rebuilds
+the committed position, re-applies the proven prefix of the failed
+window (signatures already proven, so no re-pairing), and raises the
+failing set's structured error — the same exception the sequential path
+raises. Semantics match the sequential Executor observably: identical
+final state bit-for-bit on success, the same structured error and a
+coherent last-committed state on failure.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..error import Error
+from ..models.signature_batch import SignatureBatch, defer_flushes
+from ..models.transition import Validation
+from ..utils import trace
+from .scheduler import FlushPolicy, VerifyScheduler, Window
+from .stats import PipelineStats
+
+__all__ = ["ChainPipeline", "PipelineBrokenError"]
+
+
+class PipelineBrokenError(RuntimeError):
+    """The pipeline already failed (the structured error was raised at the
+    failure point) or was aborted; it accepts no further blocks."""
+
+
+class _Entry:
+    """One speculatively applied block: the block itself (kept for the
+    rollback re-application) and its collected signature batch."""
+
+    __slots__ = ("signed_block", "slot", "batch")
+
+    def __init__(self, signed_block, slot: int, batch: SignatureBatch):
+        self.signed_block = signed_block
+        self.slot = slot
+        self.batch = batch
+
+
+class ChainPipeline:
+    """Streaming chain engine over an ``Executor``.
+
+    Usage::
+
+        pipe = ChainPipeline(executor, policy=FlushPolicy(window_size=8))
+        for signed_block in blocks:
+            pipe.submit(signed_block)
+        stats = pipe.close()          # settles every in-flight window
+
+    or as a context manager (``close`` on clean exit, ``abort`` — which
+    restores the last committed state — when the body raises). After a
+    failed block the structured error has been raised, ``executor.state``
+    is the last committed state, and the pipeline is broken (further
+    ``submit`` raises ``PipelineBrokenError``).
+    """
+
+    def __init__(
+        self,
+        executor,
+        policy: FlushPolicy | None = None,
+        validation: Validation = Validation.ENABLED,
+        stats: PipelineStats | None = None,
+    ):
+        self._executor = executor
+        self.policy = policy or FlushPolicy()
+        self._validation = validation
+        self.stats = stats or PipelineStats()
+        self._sched = VerifyScheduler(self.policy, self.stats)
+        self._pending: list[_Entry] = []
+        # committed position = checkpoint + proven blocks since it
+        self._checkpoint = executor.state.copy()
+        self._since_checkpoint: list = []
+        self._dispatched_since_checkpoint = 0
+        self._seq = 0
+        self._broken: Exception | None = None
+        self._closed = False
+
+    # -- public surface ------------------------------------------------------
+    @property
+    def state(self):
+        """The executor's (possibly speculative) head state."""
+        return self._executor.state
+
+    @property
+    def committed_state(self):
+        """The last signature-verified state. Free when the pipeline is
+        settled (nothing speculative: the head IS committed); otherwise
+        rebuilt on a scratch executor from the newest checkpoint by
+        replaying the proven blocks since."""
+        if not self._pending and self._sched.idle:
+            return self._executor.state
+        scratch = type(self._executor)(
+            self._checkpoint.copy(), self._executor.context
+        )
+        throwaway = SignatureBatch()
+        with defer_flushes(throwaway):
+            for block in self._since_checkpoint:
+                scratch.apply_block_with_validation(block, self._validation)
+        return scratch.state
+
+    def submit(self, signed_block) -> None:
+        """Speculatively apply one block (stage A) and queue its signature
+        sets for windowed verification (stage B). Raises the block's
+        structured error — or an earlier queued block's, settled first —
+        exactly as the sequential path would, leaving ``state`` at the
+        last committed position."""
+        self._check_usable()
+        self.stats.start()
+        t0 = time.perf_counter()
+        sink = SignatureBatch()
+        slot = int(signed_block.message.slot)
+        try:
+            with trace.span("pipeline.stage_a", slot=slot):
+                with defer_flushes(sink):
+                    self._executor.apply_block_with_validation(
+                        signed_block, self._validation
+                    )
+        except Error as exc:
+            self.stats.block_submitted(time.perf_counter() - t0)
+            self._fail_structural(exc)  # never returns
+        self._pending.append(_Entry(signed_block, slot, sink))
+        self.stats.block_submitted(time.perf_counter() - t0)
+        if len(self._pending) >= self.policy.window_size:
+            self._dispatch_pending()
+
+    def close(self) -> PipelineStats:
+        """Flush the partial window, settle every in-flight flush, and
+        return the run's stats. Idempotent; a no-op (stats only) once the
+        pipeline is broken — the failure was already raised."""
+        if not self._closed and self._broken is None:
+            try:
+                if self._pending:
+                    self._dispatch_pending()
+                while not self._sched.idle:
+                    self._settle_oldest()
+            finally:
+                self._closed = True
+                self.stats.stop()
+        return self.stats
+
+    def abort(self) -> None:
+        """Discard all speculative work and restore the last committed
+        state (the context-manager exit path when the body raised)."""
+        if self._closed:
+            return
+        self._sched.drop_all()
+        self._pending.clear()
+        self._materialize_committed()
+        if self._broken is None:
+            self._broken = PipelineBrokenError("pipeline aborted")
+        self._closed = True
+        self.stats.stop()
+
+    def __enter__(self) -> "ChainPipeline":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
+
+    # -- internals -----------------------------------------------------------
+    def _check_usable(self) -> None:
+        if self._broken is not None:
+            raise PipelineBrokenError(
+                f"pipeline is broken ({self._broken!r}); the state is at "
+                "the last committed position"
+            ) from self._broken
+        if self._closed:
+            raise PipelineBrokenError("pipeline is closed")
+
+    def _dispatch_pending(self) -> None:
+        entries, self._pending = self._pending, []
+        merged = SignatureBatch()
+        for entry in entries:
+            merged.merge(entry.batch)
+        # checkpoint-due windows snapshot the live state, which right now
+        # IS the post-window state (nothing later has been applied yet)
+        self._dispatched_since_checkpoint += 1
+        candidate = None
+        if self._dispatched_since_checkpoint >= self.policy.checkpoint_interval:
+            candidate = self._executor.state.copy()
+            self._dispatched_since_checkpoint = 0
+            self.stats.checkpoint()
+        if not len(merged) and not self.policy.flush_empty:
+            # a window that deferred zero sets has nothing to prove
+            self._commit(entries, candidate)
+            return
+        window = Window(entries, merged, candidate, self._seq)
+        self._seq += 1
+        # backpressure: the bounded queue admits a new window only after
+        # the oldest one settles — this wait is where an over-eager
+        # producer blocks instead of piling unverified state in memory
+        while self._sched.full:
+            self._settle_oldest()
+        self._sched.dispatch(window)
+
+    def _settle_oldest(self) -> None:
+        window, verdicts = self._sched.settle_oldest()
+        if all(verdicts):
+            self._commit(window.entries, window.post_state)
+            return
+        self._rollback(window, verdicts)  # raises
+
+    def _commit(self, entries, checkpoint) -> None:
+        if checkpoint is not None:
+            self._checkpoint = checkpoint
+            self._since_checkpoint = []
+        else:
+            self._since_checkpoint.extend(e.signed_block for e in entries)
+        self.stats.blocks_were_committed(len(entries))
+        trace.event(
+            "pipeline.commit",
+            blocks=len(entries),
+            checkpoint=checkpoint is not None,
+        )
+
+    def _materialize_committed(self) -> None:
+        """Point the executor at the last committed state: the newest
+        checkpoint plus a deterministic replay of the proven blocks since
+        (signatures already proven, so the throwaway sink skips the
+        re-pairing). Failure paths only."""
+        self._executor.state = self._checkpoint.copy()
+        if self._since_checkpoint:
+            throwaway = SignatureBatch()
+            with defer_flushes(throwaway):
+                for block in self._since_checkpoint:
+                    self._executor.apply_block_with_validation(
+                        block, self._validation
+                    )
+
+    def _rollback(self, window: Window, verdicts: "list[bool]") -> None:
+        """A window failed: the verifier's per-set fallback
+        (crypto/bls.verify_signature_sets) has already re-verified the
+        window's sets sequentially, so the verdicts are exact and the
+        first False in call-site order names the failing block and
+        operation. Discard the speculative state, rebuild the committed
+        position, re-apply the proven prefix to land exactly at the
+        failure boundary, and raise the failing set's structured error."""
+        self.stats.rollback()
+        self.stats.sequential_reverify()
+        fail_idx = verdicts.index(False)
+        at = 0
+        fail_block = 0
+        local_idx = fail_idx
+        for i, entry in enumerate(window.entries):
+            n = len(entry.batch)
+            if fail_idx < at + n:
+                fail_block, local_idx = i, fail_idx - at
+                break
+            at += n
+        error = window.entries[fail_block].batch.errors[local_idx]
+        trace.event(
+            "pipeline.rollback",
+            seq=window.seq,
+            failed_slot=window.entries[fail_block].slot,
+            committed_blocks=fail_block,
+            error=type(error).__name__,
+        )
+        self._sched.drop_all()
+        self._pending.clear()
+        self._materialize_committed()
+        if fail_block > 0:
+            proven = window.entries[:fail_block]
+            throwaway = SignatureBatch()
+            with defer_flushes(throwaway):
+                for entry in proven:
+                    self._executor.apply_block_with_validation(
+                        entry.signed_block, self._validation
+                    )
+            self._since_checkpoint.extend(e.signed_block for e in proven)
+            self.stats.blocks_were_committed(fail_block)
+        self._broken = error
+        self.stats.stop()
+        raise error
+
+    def _fail_structural(self, exc: Exception) -> None:
+        """Stage A aborted structurally mid-block: the live state is a
+        discarded partial mutation. Earlier queued blocks must settle
+        FIRST — an earlier block's bad signature preempts this later
+        block's error, matching sequential order. In-flight windows
+        settle through their normal paths; still-pending blocks re-apply
+        sequentially with INLINE verification (the terminal sequential
+        re-verify). Then the structural error propagates with the state
+        at the last committed position."""
+        pending, self._pending = self._pending, []
+        try:
+            while not self._sched.idle:
+                self._settle_oldest()  # an earlier window failure raises
+            self._materialize_committed()
+            if pending:
+                self.stats.sequential_reverify()
+                for entry in pending:
+                    self._executor.apply_block_with_validation(
+                        entry.signed_block, self._validation
+                    )
+                    self._since_checkpoint.append(entry.signed_block)
+                    self.stats.blocks_were_committed(1)
+        except Error as earlier:
+            if self._broken is None:  # a pending inline re-apply failed
+                self._materialize_committed()
+                self._broken = earlier
+                self.stats.stop()
+            raise earlier
+        self._broken = exc
+        self.stats.stop()
+        raise exc

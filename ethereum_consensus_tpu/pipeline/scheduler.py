@@ -1,0 +1,155 @@
+"""Stage-B scheduling: bounded async dispatch of coalesced flushes.
+
+The scheduler owns the pipeline's work queue discipline — and nothing
+else. It knows signature batches and futures; it does NOT know states,
+forks, or rollback (engine.py's job). Three rules:
+
+* **Coalesce**: one dispatched window carries the merged signature sets
+  of up to ``FlushPolicy.window_size`` consecutive blocks; the verifier
+  proves them in ONE random-linear-combination multi-pairing (N+K Miller
+  loops, one shared final exponentiation) via
+  ``crypto.bls.verify_signature_sets`` — which itself routes to the
+  native IFMA engine or, above the ``ops`` pairing threshold, the
+  device/mesh pairing kernels.
+* **Bound**: at most ``FlushPolicy.max_in_flight`` windows may be queued
+  or running at once. ``dispatch`` on a full scheduler is a programming
+  error (the engine settles the oldest window first — that blocking wait
+  IS the backpressure, so an unbounded block stream cannot pile
+  unverified speculative state in memory).
+* **Order**: windows settle strictly FIFO (the verifier pool is a single
+  worker), so chain order and commit order agree by construction.
+"""
+
+from __future__ import annotations
+
+from ..crypto import bls
+from ..models.signature_batch import SignatureBatch
+from ..utils import trace
+from .stats import PipelineStats
+
+__all__ = ["FlushPolicy", "VerifyScheduler", "Window"]
+
+
+class FlushPolicy:
+    """When to cut a window and how many may be in flight.
+
+    * ``window_size`` — blocks coalesced per flush. 1 = per-block flushes
+      (the sequential batching PR 0 shipped, just asynchronous); larger
+      windows amortize the final exponentiation and per-call overheads
+      across blocks, at the cost of a coarser rollback granule.
+    * ``max_in_flight`` — the bounded verify queue's cap (backpressure).
+    * ``checkpoint_interval`` — every Nth dispatched window carries a
+      full state snapshot for the commit bookkeeping. A snapshot is the
+      only O(registry) cost the pipeline adds to the success path (the
+      object-graph copy; root memos travel), so it is amortized: between
+      checkpoints the committed position is represented as "newest
+      checkpoint + proven blocks", and a rollback (rare, terminal)
+      re-derives it by deterministic replay.
+    * ``flush_empty`` — whether windows whose blocks deferred zero sets
+      (Validation.DISABLED replay) still pass through the scheduler; off
+      by default, they commit immediately.
+    """
+
+    __slots__ = (
+        "window_size", "max_in_flight", "checkpoint_interval", "flush_empty"
+    )
+
+    def __init__(self, window_size: int = 8, max_in_flight: int = 2,
+                 checkpoint_interval: int = 8, flush_empty: bool = False):
+        if window_size < 1:
+            raise ValueError("window_size must be >= 1")
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1")
+        if checkpoint_interval < 1:
+            raise ValueError("checkpoint_interval must be >= 1")
+        self.window_size = window_size
+        self.max_in_flight = max_in_flight
+        self.checkpoint_interval = checkpoint_interval
+        self.flush_empty = flush_empty
+
+    def __repr__(self) -> str:
+        return (
+            f"FlushPolicy(window_size={self.window_size}, "
+            f"max_in_flight={self.max_in_flight}, "
+            f"checkpoint_interval={self.checkpoint_interval})"
+        )
+
+
+class Window:
+    """One dispatched flush: consecutive block entries, their merged
+    signature batch, and — on checkpoint-carrying windows — the
+    post-window state snapshot the engine installs as the new checkpoint
+    when the verdicts come back clean (``post_state`` is None
+    otherwise; the committed position is then checkpoint + blocks)."""
+
+    __slots__ = ("entries", "batch", "post_state", "future", "seq")
+
+    def __init__(self, entries, batch: SignatureBatch, post_state, seq: int):
+        self.entries = entries
+        self.batch = batch
+        self.post_state = post_state
+        self.future = None
+        self.seq = seq
+
+
+class VerifyScheduler:
+    """Bounded FIFO dispatch onto the shared background verifier."""
+
+    def __init__(self, policy: FlushPolicy, stats: PipelineStats):
+        self.policy = policy
+        self.stats = stats
+        self._in_flight: list[Window] = []
+
+    # -- queue state ---------------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return len(self._in_flight)
+
+    @property
+    def full(self) -> bool:
+        return len(self._in_flight) >= self.policy.max_in_flight
+
+    @property
+    def idle(self) -> bool:
+        return not self._in_flight
+
+    # -- dispatch / settle ---------------------------------------------------
+    def dispatch(self, window: Window) -> None:
+        """Queue one window onto the verifier. The caller must have made
+        room (``not full``) by settling the oldest window first."""
+        if self.full:
+            raise RuntimeError(
+                "VerifyScheduler.dispatch on a full queue — settle the "
+                "oldest window first (the engine's backpressure wait)"
+            )
+        n_sets = len(window.batch)
+        trace.event(
+            "pipeline.flush.dispatch",
+            seq=window.seq,
+            blocks=len(window.entries),
+            sets=n_sets,
+            in_flight=len(self._in_flight) + 1,
+        )
+        window.future = bls.verify_signature_sets_async(
+            window.batch.sets, timer=self.stats.stage_b_busy
+        )
+        self._in_flight.append(window)
+        self.stats.flush_dispatched(n_sets)
+        self.stats.queue_depth(len(self._in_flight))
+
+    def settle_oldest(self) -> "tuple[Window, list[bool]]":
+        """Block until the oldest in-flight window's verdicts are in;
+        returns (window, per-set verdicts in call-site order)."""
+        if not self._in_flight:
+            raise RuntimeError("settle_oldest with nothing in flight")
+        window = self._in_flight.pop(0)
+        with trace.span("pipeline.flush.settle", seq=window.seq):
+            verdicts = window.future.result()
+        return window, verdicts
+
+    def drop_all(self) -> None:
+        """Abandon every in-flight window (rollback path): the futures
+        run to completion on the worker — the single-thread pool keeps
+        FIFO order, and a later submit would queue behind them anyway —
+        but their verdicts are no longer consulted."""
+        self._in_flight.clear()

@@ -1,0 +1,151 @@
+"""Pipeline counter surface.
+
+One ``PipelineStats`` instance rides a ``ChainPipeline`` run and is safe
+to read from any thread at any time (every mutation holds one lock; the
+snapshot is taken under the same lock). The counters are the operational
+story of a run:
+
+* throughput — blocks submitted/committed, wall seconds;
+* flush shape — how many windowed flushes, how many sets each coalesced
+  (the multi-pairing amortization the pipeline exists for);
+* failure handling — rollbacks and sequential re-verifications;
+* occupancy — how busy each stage was. Stage A is the host (state
+  mutation + incremental HTR + signature collection, on the submitting
+  thread); stage B is the verifier (the coalesced multi-pairings, on the
+  background worker). Occupancies near 1.0 on BOTH stages mean the
+  overlap is real; a stage near 0 is the bottleneck's complement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["PipelineStats"]
+
+
+class PipelineStats:
+    """Counters for one pipeline run; all methods thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.blocks_submitted = 0
+        self.blocks_committed = 0
+        self.flushes = 0
+        self.sets_flushed = 0
+        self.flush_sizes: list[int] = []
+        self.rollbacks = 0
+        self.sequential_reverifies = 0
+        self.checkpoints = 0
+        self.stage_a_s = 0.0
+        self.stage_b_s = 0.0
+        self.queue_high_watermark = 0
+        self._t_start: float | None = None
+        self._t_end: float | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._t_start is None:
+                self._t_start = time.perf_counter()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._t_end = time.perf_counter()
+
+    @property
+    def wall_s(self) -> float:
+        with self._lock:
+            if self._t_start is None:
+                return 0.0
+            end = self._t_end if self._t_end is not None else time.perf_counter()
+            return end - self._t_start
+
+    # -- mutation ------------------------------------------------------------
+    def block_submitted(self, stage_a_s: float) -> None:
+        with self._lock:
+            self.blocks_submitted += 1
+            self.stage_a_s += stage_a_s
+
+    def blocks_were_committed(self, n: int) -> None:
+        with self._lock:
+            self.blocks_committed += n
+
+    def flush_dispatched(self, n_sets: int) -> None:
+        with self._lock:
+            self.flushes += 1
+            self.sets_flushed += n_sets
+            self.flush_sizes.append(n_sets)
+
+    def stage_b_busy(self, seconds: float) -> None:
+        with self._lock:
+            self.stage_b_s += seconds
+
+    def rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
+    def checkpoint(self) -> None:
+        with self._lock:
+            self.checkpoints += 1
+
+    def sequential_reverify(self) -> None:
+        with self._lock:
+            self.sequential_reverifies += 1
+
+    def queue_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.queue_high_watermark:
+                self.queue_high_watermark = depth
+
+    # -- reading -------------------------------------------------------------
+    def occupancy(self) -> dict:
+        """Per-stage busy fraction of the run's wall clock."""
+        wall = self.wall_s
+        with self._lock:
+            if wall <= 0.0:
+                return {"stage_a": 0.0, "stage_b": 0.0}
+            return {
+                "stage_a": min(1.0, self.stage_a_s / wall),
+                "stage_b": min(1.0, self.stage_b_s / wall),
+            }
+
+    def snapshot(self) -> dict:
+        """A plain-dict copy (JSON-ready) of every counter."""
+        wall = self.wall_s
+        with self._lock:
+            sizes = list(self.flush_sizes)
+            return {
+                "blocks_submitted": self.blocks_submitted,
+                "blocks_committed": self.blocks_committed,
+                "flushes": self.flushes,
+                "sets_flushed": self.sets_flushed,
+                "flush_sizes": sizes,
+                "max_flush_size": max(sizes) if sizes else 0,
+                "mean_flush_size": (
+                    sum(sizes) / len(sizes) if sizes else 0.0
+                ),
+                "rollbacks": self.rollbacks,
+                "sequential_reverifies": self.sequential_reverifies,
+                "checkpoints": self.checkpoints,
+                "stage_a_s": self.stage_a_s,
+                "stage_b_s": self.stage_b_s,
+                "wall_s": wall,
+                "stage_a_occupancy": (
+                    min(1.0, self.stage_a_s / wall) if wall > 0 else 0.0
+                ),
+                "stage_b_occupancy": (
+                    min(1.0, self.stage_b_s / wall) if wall > 0 else 0.0
+                ),
+                "queue_high_watermark": self.queue_high_watermark,
+            }
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        return (
+            f"PipelineStats(blocks={s['blocks_committed']}/"
+            f"{s['blocks_submitted']}, flushes={s['flushes']}, "
+            f"rollbacks={s['rollbacks']}, "
+            f"occ_a={s['stage_a_occupancy']:.2f}, "
+            f"occ_b={s['stage_b_occupancy']:.2f})"
+        )

@@ -2110,6 +2110,13 @@ def _copy_value(typ: SSZType, value: Any):
                 copied._dirty_groups = set(dg) if dg is not None else None
             elif value and isinstance(value[0], Container):
                 copied = CachedRootList(v.copy() for v in value)
+            elif value and value[0].__class__ is bytes:
+                # immutable leaf elements (the Bytes32/Bytes48 vectors:
+                # randao mixes, block/state root histories, committee
+                # pubkeys): the per-element copy is the identity, so the
+                # element walk — ~83k calls per state copy, a third of
+                # its cost — collapses to one shallow list copy
+                copied = CachedRootList(value)
             else:
                 copied = CachedRootList(_copy_value(elem, v) for v in value)
         else:

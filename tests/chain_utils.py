@@ -641,6 +641,177 @@ def make_attestation_electra(state, slot: int, context, participation=1.0):
 
 
 # ---------------------------------------------------------------------------
+# chains (pipeline/stream scaffolding): lists of consecutive signed blocks
+# ---------------------------------------------------------------------------
+
+
+def produce_chain(state, context, n_blocks: int, fork_name: str = "phase0",
+                  atts_per_block: int = 1, start_slot: int | None = None):
+    """``n_blocks`` consecutive valid signed blocks built on ``state``
+    (which is NOT mutated), each carrying up to ``atts_per_block``
+    attestations over the previous slot's committees. Returns the block
+    list; replaying them in order from ``state`` is valid."""
+    scratch = state.copy()
+    first = int(scratch.slot) + 1 if start_slot is None else start_slot
+    blocks = []
+    pending_atts: list = []
+    for slot in range(first, first + n_blocks):
+        if fork_name == "phase0":
+            block = produce_block(scratch, slot, context,
+                                  attestations=pending_atts)
+            p0t = _fork_module("phase0").state_transition
+            p0t.state_transition_block_in_slot(
+                scratch, block, p0t.Validation.ENABLED, context
+            )
+        else:
+            block = produce_block_fork(fork_name, scratch, slot, context,
+                                       attestations=pending_atts)
+            stm = _fork_module(fork_name).state_transition
+            stm.state_transition_block_in_slot(
+                scratch, block, stm.Validation.ENABLED, context
+            )
+        per_slot = h.get_committee_count_per_slot(
+            scratch, slot // context.SLOTS_PER_EPOCH, context
+        )
+        pending_atts = [
+            make_attestation(scratch, slot, index, context)
+            for index in range(min(atts_per_block, per_slot))
+        ]
+        blocks.append(block)
+    return blocks
+
+
+def produce_multi_fork_chain(validator_count: int = 64):
+    """(genesis_state, context, blocks): a toy chain crossing the
+    phase0→altair boundary — epoch 0 under phase0 rules, then altair
+    blocks from the upgrade slot on (the first lands EXACTLY on it, the
+    executor.rs:215-224 corner). Exercises the Executor's inline upgrade
+    chain under streaming replay."""
+    state, _ = fresh_genesis(validator_count, "minimal")
+    context = Context.for_minimal()
+    context.altair_fork_epoch = 1
+
+    from ethereum_consensus_tpu.models.altair import upgrade_to_altair
+    from ethereum_consensus_tpu.models.phase0.slot_processing import (
+        process_slots,
+    )
+
+    scratch = state.copy()
+    blocks = list(
+        produce_chain(scratch, context, int(context.SLOTS_PER_EPOCH) - 1)
+    )
+    p0t = _fork_module("phase0").state_transition
+    for block in blocks:
+        p0t.state_transition(scratch, block, context)
+    fork_slot = int(context.SLOTS_PER_EPOCH)
+    process_slots(scratch, fork_slot, context)
+    upgraded = upgrade_to_altair(scratch, context)
+    at = _fork_module("altair").state_transition
+    for slot in range(fork_slot, fork_slot + 3):
+        block = produce_block_altair(upgraded, slot, context)
+        at.state_transition_block_in_slot(
+            upgraded, block, at.Validation.ENABLED, context
+        )
+        blocks.append(block)
+    return state, context, blocks
+
+
+def mainnet_chain_bundle(fork_name: str, validator_count: int,
+                         n_blocks: int, atts: int):
+    """(pre_state, context, signed_blocks): ``n_blocks`` consecutive
+    valid blocks at mainnet committee structure on a ``validator_count``
+    registry, each carrying up to ``atts`` aggregate attestations plus a
+    full sync aggregate / execution payload on altair+/bellatrix+ —
+    the replay stream the pipeline bench drives. Disk-cached (the
+    signing cost at 2^20 is minutes; the bench pays one deserialize)."""
+    context = Context.for_mainnet()
+    mod = _fork_module(fork_name)
+    ns = mod.build(context.preset)
+
+    def build():
+        state, ctx = fast_registry_state(validator_count, fork_name)
+        start = int(state.slot) + 2
+        # realize every key that will sign anywhere in the chain BEFORE
+        # any root is computed: committee shuffling and proposer sampling
+        # read seeds and effective balances, never pubkey bytes, and the
+        # chain stays within epochs whose seeds come from pre-genesis
+        # randao mixes — so index selection on a throwaway blockless
+        # advance matches the real replay
+        needed = set()
+        probe = state.copy()
+        for slot in range(start, start + n_blocks):
+            mod.slot_processing.process_slots(probe, slot, ctx)
+            needed.add(h.get_beacon_proposer_index(probe, ctx))
+        for slot in range(max(0, start - 2), start + n_blocks):
+            per_slot = h.get_committee_count_per_slot(
+                probe, slot // ctx.SLOTS_PER_EPOCH, ctx
+            )
+            for index in range(min(atts, per_slot)):
+                needed.update(h.get_beacon_committee(probe, slot, index, ctx))
+        del probe
+        realize_validator_keys(state, needed)
+        scratch = state.copy()
+        blocks = []
+        pending: list = []
+        for slot in range(start, start + n_blocks):
+            block = produce_block_fork(
+                fork_name, scratch, slot, ctx, attestations=pending
+            )
+            stm = mod.state_transition
+            stm.state_transition_block_in_slot(
+                scratch, block, stm.Validation.ENABLED, ctx
+            )
+            per_slot = h.get_committee_count_per_slot(
+                scratch, slot // ctx.SLOTS_PER_EPOCH, ctx
+            )
+            pending = [
+                make_attestation(scratch, slot, index, ctx)
+                for index in range(min(atts, per_slot))
+            ]
+            blocks.append(block)
+        return state, blocks
+
+    def serialize(value):
+        state, blocks = value
+        sb = type(state).serialize(state)
+        out = [len(blocks).to_bytes(4, "little"),
+               len(sb).to_bytes(8, "little"), sb]
+        for block in blocks:
+            bb = ns.SignedBeaconBlock.serialize(block)
+            out.append(len(bb).to_bytes(8, "little"))
+            out.append(bb)
+        return b"".join(out)
+
+    def deserialize(data):
+        n = int.from_bytes(data[:4], "little")
+        at = 4
+        ln = int.from_bytes(data[at : at + 8], "little")
+        at += 8
+        state = ns.BeaconState.deserialize(data[at : at + ln])
+        at += ln
+        blocks = []
+        for _ in range(n):
+            ln = int.from_bytes(data[at : at + 8], "little")
+            at += 8
+            blocks.append(ns.SignedBeaconBlock.deserialize(data[at : at + ln]))
+            at += ln
+        return state, blocks
+
+    state, blocks = _disk_cached(
+        f"chainbundle-{_FASTREG_VERSION}-{fork_name}-mainnet-"
+        f"{validator_count}-{n_blocks}x{atts}",
+        serialize,
+        deserialize,
+        build,
+    )
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)  # warm the root memo
+    _strip_spec_caches(state)
+    return state.copy(), context, blocks
+
+
+# ---------------------------------------------------------------------------
 # mainnet-scale direct registry construction (bench + scale-test scaffolding)
 #
 # Deposit-crypto genesis is O(n) signatures + O(n) pairings — minutes at
