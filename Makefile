@@ -1,0 +1,32 @@
+# Dev entry points (the justfile-equivalent). `make help` lists targets.
+
+PY ?= python
+
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench pipeline-selfcheck
+
+help:  ## list targets
+	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
+
+test:  ## tier-1 suite (hermetic CPU, slow tests deselected)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+test-all:  ## full suite including slow bench-shaped tests
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
+
+speclint:  ## static analysis: fork drift, SSZ mutation purity, concurrency
+	$(PY) -m tools.speclint
+
+speclint-json:  ## same, JSON report on stdout
+	$(PY) -m tools.speclint --format json
+
+speclint-all:  ## include allowlisted findings in the listing
+	$(PY) -m tools.speclint --all
+
+forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
+	$(PY) -m tools.speclint --write-forkdiff
+
+bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
+	$(PY) bench.py
+
+pipeline-selfcheck:  ## pipeline smoke: seq-vs-pipelined bit identity
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck

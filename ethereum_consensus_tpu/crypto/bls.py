@@ -74,19 +74,25 @@ SIGNATURE_SIZE = 96
 _INFINITY_FLAG = 0x40
 
 _BACKEND: str | None = None
+# guards the one-time backend resolution: the chain pipeline's stage A
+# and the background verifier can both hit a cold _native() first; the
+# computation is idempotent but the double-checked lock keeps the
+# resolve-once contract explicit (and speclint-clean). Reads stay
+# lock-free — after the first store the value never changes.
+_BACKEND_LOCK = threading.Lock()
 
 
 def backend_name() -> str:
     """Active backend: "native" or "python" (EC_BLS_BACKEND to override)."""
     global _BACKEND
     if _BACKEND is None:
-        mode = os.environ.get("EC_BLS_BACKEND", "auto")
-        if mode == "python":
-            _BACKEND = "python"
-        elif mode == "native":
-            _BACKEND = "native" if native_bls.available() else "python"
-        else:
-            _BACKEND = "native" if native_bls.available() else "python"
+        with _BACKEND_LOCK:
+            if _BACKEND is None:
+                mode = os.environ.get("EC_BLS_BACKEND", "auto")
+                if mode == "python":
+                    _BACKEND = "python"
+                else:
+                    _BACKEND = "native" if native_bls.available() else "python"
     return _BACKEND
 
 
@@ -785,6 +791,10 @@ def verify_signature_sets(
 # ---------------------------------------------------------------------------
 
 _VERIFY_POOL = None
+# double-checked creation: two racing first-dispatchers would otherwise
+# build TWO single-thread pools — and the pipeline's windows-settle-FIFO
+# guarantee only holds when every dispatch queues behind the SAME worker
+_VERIFY_POOL_LOCK = threading.Lock()
 
 
 def _verify_pool():
@@ -796,11 +806,13 @@ def _verify_pool():
     on the same engine would only fight it for cores/chip."""
     global _VERIFY_POOL
     if _VERIFY_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
+        with _VERIFY_POOL_LOCK:
+            if _VERIFY_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-        _VERIFY_POOL = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="bls-verify"
-        )
+                _VERIFY_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="bls-verify"
+                )
     return _VERIFY_POOL
 
 

@@ -20,6 +20,17 @@ from ..phase0.epoch_processing import (  # noqa: F401 — fork-diff re-exports
     process_slashings_reset,
     weigh_justification_and_finalization,
 )
+
+# phase0's epoch_processing exported these too; altair relocated them to
+# helpers (get_base_reward with the altair formula, the rest fork-neutral
+# pass-throughs). Re-exported so the module surface chains without a hole
+# (speclint forkdiff/missing-reexport).
+from .helpers import (  # noqa: F401 — fork-diff re-exports
+    get_base_reward,
+    get_eligible_validator_indices,
+    get_finality_delay,
+    is_in_inactivity_leak,
+)
 from . import helpers as h
 from .constants import PARTICIPATION_FLAG_WEIGHTS, TIMELY_TARGET_FLAG_INDEX
 

@@ -36,6 +36,7 @@ from ..phase0.helpers import (  # noqa: F401 — fork-diff re-exports
     get_beacon_proposer_index,
     get_block_root,
     get_block_root_at_slot,
+    get_committee_count_at_slot,
     get_committee_count_per_slot,
     get_current_epoch,
     get_domain,

@@ -2,14 +2,11 @@
 
 from __future__ import annotations
 
-from ..transition import process_slot_generic, process_slots_generic
+from ..transition import process_slots_generic
+from ..altair.slot_processing import process_slot  # noqa: F401 — fork-diff re-export
 from .epoch_processing import process_epoch
 
 __all__ = ["process_slot", "process_slots"]
-
-
-def process_slot(state, context) -> None:
-    process_slot_generic(state, context)
 
 
 def process_slots(state, slot: int, context) -> None:

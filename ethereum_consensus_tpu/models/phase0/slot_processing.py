@@ -8,9 +8,7 @@ route through the device backend when ops.install() has run.
 
 from __future__ import annotations
 
-from ...error import StateTransitionError, checked_add
-from . import helpers as h
-from .containers import BeaconBlockHeader
+from ..transition import process_slot_generic, process_slots_generic
 from .epoch_processing import process_epoch
 
 __all__ = ["process_slot", "process_slots"]
@@ -18,27 +16,9 @@ __all__ = ["process_slot", "process_slots"]
 
 def process_slot(state, context) -> None:
     """(slot_processing.rs:45)"""
-    previous_state_root = type(state).hash_tree_root(state)
-    limit = len(state.state_roots)
-    state.state_roots[state.slot % limit] = previous_state_root
-
-    if state.latest_block_header.state_root == b"\x00" * 32:
-        state.latest_block_header.state_root = previous_state_root
-
-    previous_block_root = BeaconBlockHeader.hash_tree_root(
-        state.latest_block_header
-    )
-    state.block_roots[state.slot % limit] = previous_block_root
+    process_slot_generic(state, context)
 
 
 def process_slots(state, slot: int, context) -> None:
     """(slot_processing.rs:9)"""
-    if state.slot >= slot:
-        raise StateTransitionError(
-            f"cannot process slots backwards: state at {state.slot}, target {slot}"
-        )
-    while state.slot < slot:
-        process_slot(state, context)
-        if (state.slot + 1) % context.SLOTS_PER_EPOCH == 0:
-            process_epoch(state, context)
-        state.slot = checked_add(state.slot, 1)
+    process_slots_generic(state, slot, context, process_epoch)

@@ -7,6 +7,7 @@ ethereum-consensus/src/ssz/mod.rs:1-8). ``prelude`` mirrors
 
 from . import core, hash, merkle
 from .core import (
+    INSTRUMENTED_LIST_MUTATORS,
     Bitlist,
     Bitvector,
     ByteList,
@@ -21,6 +22,7 @@ from .core import (
     deserialize,
     get_generalized_index,
     hash_tree_root,
+    instrumented_surface,
     prove,
     compute_subtree_root,
     serialize,
@@ -47,6 +49,8 @@ __all__ = [
     "core",
     "hash",
     "merkle",
+    "INSTRUMENTED_LIST_MUTATORS",
+    "instrumented_surface",
     "Bitlist",
     "Bitvector",
     "ByteList",

@@ -50,6 +50,8 @@ __all__ = [
     "deserialize",
     "hash_tree_root",
     "bulk_store",
+    "INSTRUMENTED_LIST_MUTATORS",
+    "instrumented_surface",
     "get_generalized_index",
     "prove",
     "compute_subtree_root",
@@ -639,7 +641,14 @@ def _instrument(name):
     return method
 
 
-for _name in (
+# The instrumented-mutator surface: every channel through which an SSZ
+# value may legally mutate while keeping dirty tracking and the cache
+# hierarchy sound. This tuple is the single source of truth — the loop
+# below installs exactly these wrappers, ``instrumented_surface()``
+# publishes them to tooling, and any list method NOT named here bypasses
+# invalidation (which is why tools/speclint's mutation-purity analyzer
+# flags raw ``list.<method>(...)`` calls outside this module).
+INSTRUMENTED_LIST_MUTATORS = (
     "__setitem__",
     "__delitem__",
     "__iadd__",
@@ -652,9 +661,44 @@ for _name in (
     "clear",
     "sort",
     "reverse",
-):
+)
+
+for _name in INSTRUMENTED_LIST_MUTATORS:
     setattr(CachedRootList, _name, _instrument(_name))
 del _name
+
+
+def instrumented_surface() -> dict:
+    """Machine-readable manifest of the instrumented mutation surface.
+
+    Consumed by ``tools/speclint`` (the static mutation-purity analyzer
+    derives its rule set from this instead of hard-coding names) and by
+    ``tests/test_ssz_incremental.py`` (the runtime property test drives
+    every public mutator listed here and asserts the incremental root
+    matches a cold recompute), so the manifest, the analyzer, and the
+    runtime invariants stay in lockstep.
+
+    * ``list_mutators`` — every instrumented ``CachedRootList`` method;
+      mutating an SSZ collection through anything else (e.g. a raw
+      ``list.append(values, v)``) leaves dirty tracking stale.
+    * ``public_list_mutators`` — the non-dunder subset, reachable as
+      ordinary method calls from spec code.
+    * ``container_field_write`` — attribute assignment on a Container
+      routes through ``Container.__setattr__`` (the weak-parent chain);
+      ``object.__setattr__`` / ``__dict__`` stores on SSZ *field* names
+      bypass it.
+    * ``bulk_mutators`` — module-level bulk entry points with an explicit
+      changed-indices dirty contract.
+    """
+    return {
+        "list_type": "CachedRootList",
+        "list_mutators": INSTRUMENTED_LIST_MUTATORS,
+        "public_list_mutators": tuple(
+            n for n in INSTRUMENTED_LIST_MUTATORS if not n.startswith("__")
+        ),
+        "container_field_write": "Container.__setattr__",
+        "bulk_mutators": ("bulk_store",),
+    }
 
 
 def _cacheable_elem(elem: SSZType) -> bool:

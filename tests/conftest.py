@@ -70,17 +70,13 @@ def _hermetic_env() -> "dict | None":
 
 
 def pytest_configure(config) -> None:
-    """Register markers, then re-exec the whole pytest run hermetically
-    (see module docstring).
+    """Re-exec the whole pytest run hermetically (see module docstring).
+    Markers are registered centrally in pytest.ini (with
+    ``--strict-markers``), not here.
 
     The re-exec runs here — not at conftest import — so pytest's global
     fd capture can be torn down first: an execve under active capture
     inherits the redirected fds and the child's entire output vanishes."""
-    config.addinivalue_line(
-        "markers",
-        "slow: bench-shaped tests (mainnet-scale chains/states); tier-1 "
-        "deselects via -m 'not slow' to stay inside its wall budget",
-    )
     env = _hermetic_env()
     if env is None:
         return

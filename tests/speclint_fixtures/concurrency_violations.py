@@ -1,0 +1,34 @@
+"""Concurrency fixture: one seeded violation per rule + locked twins
+that must NOT flag. Parsed, never imported."""
+
+import threading
+
+_CACHE = {}
+_LOCK = threading.Lock()
+_EVENT = threading.Event()  # seeded: concurrency/bare-threading-primitive
+
+
+def bad_unlocked_write(key, value):
+    _CACHE[key] = value  # seeded: concurrency/unlocked-global-write
+
+
+def ok_locked_write(key, value):
+    with _LOCK:
+        _CACHE[key] = value  # sanctioned: lock dominates the write
+
+
+def ok_lockfree_read(key):
+    return _CACHE.get(key)  # sanctioned: reads are lock-free by design
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # sanctioned: __init__ happens-before publication
+
+    def bad_bump(self):
+        self.count += 1  # seeded: concurrency/unlocked-instance-write
+
+    def ok_bump(self):
+        with self._lock:
+            self.count += 1  # sanctioned

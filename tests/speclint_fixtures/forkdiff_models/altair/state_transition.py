@@ -1,0 +1,15 @@
+"""Fixture altair: seeds a drifted copy, a signature divergence, and a
+missing re-export ('Validation' is dropped from the chained surface)."""
+
+from ..phase0.state_transition import state_transition  # noqa: F401
+
+__all__ = ["state_transition", "process_slots", "helper"]
+
+
+def process_slots(state, slot, context):  # seeded: forkdiff/drifted-copy
+    while state.slot < slot:
+        state.slot += 1
+
+
+def helper(state, ctx):  # seeded: forkdiff/signature-divergence
+    return state.slot

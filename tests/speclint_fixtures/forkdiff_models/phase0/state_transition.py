@@ -1,0 +1,25 @@
+"""Fixture phase0: reproduces the PR 2 Validation-enum bug — a private
+copy of the shared skeleton's enum, so `validation is Validation.ENABLED`
+checks against the shared member are always False."""
+
+from enum import Enum
+
+__all__ = ["Validation", "process_slots", "state_transition", "helper"]
+
+
+class Validation(Enum):  # seeded: forkdiff/shadowed-duplicate (the PR 2 bug)
+    ENABLED = "enabled"
+    DISABLED = "disabled"
+
+
+def process_slots(state, slot, context):
+    while state.slot < slot:
+        state.slot += 1
+
+
+def state_transition(state, signed_block, context):
+    process_slots(state, signed_block.slot, context)
+
+
+def helper(state, context):
+    return state.slot

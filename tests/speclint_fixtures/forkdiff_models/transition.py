@@ -1,0 +1,14 @@
+"""Fixture shared skeleton (the models/transition.py stand-in)."""
+
+from enum import Enum
+
+__all__ = ["Validation", "process_slot_generic"]
+
+
+class Validation(Enum):
+    ENABLED = "enabled"
+    DISABLED = "disabled"
+
+
+def process_slot_generic(state, context):
+    state.slot += 1

@@ -1,0 +1,31 @@
+"""Mutation-purity fixture: one seeded violation per rule + a sanctioned
+underscore-memo write that must NOT flag. Parsed, never imported."""
+
+import copy
+
+
+def bad_raw_list_call(state, validator):
+    list.append(state.validators, validator)  # seeded: mutation/raw-list-call
+
+
+def bad_setattr_bypass(validator):
+    object.__setattr__(validator, "slashed", True)  # seeded: mutation/setattr-bypass
+
+
+def bad_dict_write(validator):
+    validator.__dict__["slashed"] = True  # seeded: mutation/dict-bypass
+
+
+def bad_dict_update(validator):
+    validator.__dict__.update(slashed=True)  # seeded: mutation/dict-bypass
+
+
+def bad_deepcopy(state):
+    return copy.deepcopy(state)  # seeded: mutation/deepcopy
+
+
+def ok_memo_write(state):
+    # sanctioned: underscore-prefixed memo keys live OUTSIDE the SSZ
+    # surface (the _active_idx_cache idiom) — must not flag
+    state.__dict__["_memo_cache"] = (1, 2)
+    state.__dict__.pop("_memo_cache", None)

@@ -1,0 +1,303 @@
+"""speclint: the repo-wide gate plus the linter's own self-tests.
+
+Three layers:
+
+* THE GATE — ``test_repo_has_no_open_findings`` runs the full suite over
+  the package and fails on any non-allowlisted finding. On failure the
+  JSON report is written as an artifact (``SPECLINT_ARTIFACT_DIR``,
+  default the system temp dir) so findings are readable without
+  re-running locally.
+* SELF-TESTS — every rule must catch its seeded violation in
+  ``tests/speclint_fixtures/`` (and must NOT flag the sanctioned twins),
+  so the linter cannot rot into a no-op. The fork-diff fixture
+  reproduces the PR 2 ``Validation``-enum bug verbatim — the regression
+  guard for that bug class.
+* LOCKSTEP — the static manifest the mutation analyzer consumes
+  (``ssz/core.py``'s ``INSTRUMENTED_LIST_MUTATORS``) must match the
+  methods actually instrumented on ``CachedRootList`` at runtime.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import speclint
+from tools.speclint import concurrency, forkdiff, mutation
+from tools.speclint.allowlist import Allowlist, AllowlistError
+
+REPO_ROOT = speclint.REPO_ROOT
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "speclint_fixtures")
+CORE_PATH = os.path.join(REPO_ROOT, "ethereum_consensus_tpu", "ssz", "core.py")
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_repo_has_no_open_findings():
+    findings = speclint.run()
+    open_findings = [f for f in findings if not f.allowlisted]
+    if open_findings:
+        artifact_dir = os.environ.get("SPECLINT_ARTIFACT_DIR", tempfile.gettempdir())
+        os.makedirs(artifact_dir, exist_ok=True)
+        artifact = os.path.join(artifact_dir, "speclint_report.json")
+        with open(artifact, "w", encoding="utf-8") as f:
+            json.dump([x.to_dict() for x in findings], f, indent=2)
+        listing = "\n".join(x.format_text() for x in open_findings)
+        pytest.fail(
+            f"{len(open_findings)} open speclint finding(s) — fix or "
+            f"allowlist with justification (full JSON report: {artifact}):\n"
+            f"{listing}"
+        )
+
+
+def test_cli_exits_zero_on_clean_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.speclint", "--format", "json"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["open"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fork-diff self-tests (fixture seeds one violation per rule)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def forkdiff_findings():
+    return forkdiff.analyze_models(
+        os.path.join(FIXTURES, "forkdiff_models"), REPO_ROOT
+    )
+
+
+def _rules_by_symbol(findings):
+    return {(f.rule, f.symbol) for f in findings}
+
+
+def test_forkdiff_redetects_the_pr2_validation_bug(forkdiff_findings):
+    """The acceptance regression guard: a fork module carrying a private
+    duplicate of the shared skeleton's Validation enum must flag."""
+    hits = [
+        f
+        for f in forkdiff_findings
+        if f.rule == "forkdiff/shadowed-duplicate"
+        and f.symbol == "phase0/state_transition.Validation"
+    ]
+    assert len(hits) == 1, forkdiff_findings
+    assert "Validation" in hits[0].message
+    assert hits[0].path.endswith("phase0/state_transition.py")
+    assert hits[0].line > 0
+
+
+def test_forkdiff_catches_drifted_copy(forkdiff_findings):
+    assert (
+        "forkdiff/drifted-copy",
+        "altair/state_transition.process_slots",
+    ) in _rules_by_symbol(forkdiff_findings)
+
+
+def test_forkdiff_catches_missing_reexport(forkdiff_findings):
+    assert (
+        "forkdiff/missing-reexport",
+        "altair/state_transition.Validation",
+    ) in _rules_by_symbol(forkdiff_findings)
+
+
+def test_forkdiff_catches_signature_divergence(forkdiff_findings):
+    assert (
+        "forkdiff/signature-divergence",
+        "altair/state_transition.helper",
+    ) in _rules_by_symbol(forkdiff_findings)
+
+
+def test_forkdiff_no_false_positive_on_reexport(forkdiff_findings):
+    """state_transition is imported (re-exported) by fixture altair —
+    must not flag as missing or drifted."""
+    assert not any(
+        f.symbol == "altair/state_transition.state_transition"
+        for f in forkdiff_findings
+    )
+
+
+def test_forkdiff_real_models_late_binding_not_flagged():
+    """The repo's own process_slots (identical text per fork, but calling
+    each fork's OWN process_epoch) is deliberate late-binding — the
+    binding-key guard must keep it out of drifted-copy."""
+    models_dir = os.path.join(REPO_ROOT, "ethereum_consensus_tpu", "models")
+    findings = forkdiff.analyze_models(models_dir, REPO_ROOT)
+    assert not any(
+        f.rule == "forkdiff/drifted-copy" and f.symbol.endswith(".process_slots")
+        for f in findings
+    )
+
+
+def test_render_forkdiff_report():
+    models_dir = os.path.join(REPO_ROOT, "ethereum_consensus_tpu", "models")
+    report = forkdiff.render_forkdiff(models_dir, REPO_ROOT)
+    assert "phase0" in report and "electra" in report
+    assert "## state_transition" in report
+
+
+# ---------------------------------------------------------------------------
+# mutation-purity self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mutation_findings():
+    return mutation.analyze(
+        [os.path.join(FIXTURES, "mutation_violations.py")], REPO_ROOT, CORE_PATH
+    )
+
+
+@pytest.mark.parametrize(
+    "rule,symbol",
+    [
+        ("mutation/raw-list-call", "bad_raw_list_call"),
+        ("mutation/setattr-bypass", "bad_setattr_bypass"),
+        ("mutation/dict-bypass", "bad_dict_write"),
+        ("mutation/dict-bypass", "bad_dict_update"),
+        ("mutation/deepcopy", "bad_deepcopy"),
+    ],
+)
+def test_mutation_catches_seeded_violation(mutation_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(mutation_findings)
+
+
+def test_mutation_memo_writes_not_flagged(mutation_findings):
+    assert not any(f.symbol == "ok_memo_write" for f in mutation_findings)
+
+
+def test_mutation_rules_derive_from_manifest():
+    """The analyzer reads the instrumented surface out of ssz/core.py's
+    AST; the static read must agree with the runtime manifest."""
+    from ethereum_consensus_tpu.ssz import core as ssz_core
+
+    static = mutation.load_manifest(CORE_PATH)
+    assert static["list_mutators"] == ssz_core.INSTRUMENTED_LIST_MUTATORS
+    assert (
+        static["bulk_mutators"]
+        == ssz_core.instrumented_surface()["bulk_mutators"]
+    )
+
+
+def test_manifest_matches_instrumented_runtime_methods():
+    """Every name in the manifest is actually a wrapped (non-list-base)
+    method on CachedRootList, and no other base list mutator slipped in
+    uninstrumented — the manifest, the analyzer, and the runtime agree."""
+    from ethereum_consensus_tpu.ssz.core import (
+        INSTRUMENTED_LIST_MUTATORS,
+        CachedRootList,
+        instrumented_surface,
+    )
+
+    for name in INSTRUMENTED_LIST_MUTATORS:
+        assert getattr(CachedRootList, name) is not getattr(list, name), name
+    surface = instrumented_surface()
+    assert surface["list_mutators"] == INSTRUMENTED_LIST_MUTATORS
+    assert set(surface["public_list_mutators"]) == {
+        n for n in INSTRUMENTED_LIST_MUTATORS if not n.startswith("__")
+    }
+
+
+# ---------------------------------------------------------------------------
+# concurrency self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def concurrency_findings():
+    return concurrency.analyze(
+        [os.path.join(FIXTURES, "concurrency_violations.py")], REPO_ROOT
+    )
+
+
+def test_concurrency_catches_unlocked_global_write(concurrency_findings):
+    assert (
+        "concurrency/unlocked-global-write",
+        "bad_unlocked_write/_CACHE",
+    ) in _rules_by_symbol(concurrency_findings)
+
+
+def test_concurrency_catches_unlocked_instance_write(concurrency_findings):
+    assert (
+        "concurrency/unlocked-instance-write",
+        "SharedCounter.bad_bump/count",
+    ) in _rules_by_symbol(concurrency_findings)
+
+
+def test_concurrency_catches_bare_primitive(concurrency_findings):
+    assert any(
+        f.rule == "concurrency/bare-threading-primitive"
+        and "Event" in f.symbol
+        for f in concurrency_findings
+    )
+
+
+def test_concurrency_locked_twins_not_flagged(concurrency_findings):
+    for sym in ("ok_locked_write", "ok_lockfree_read", "SharedCounter.ok_bump"):
+        assert not any(f.symbol.startswith(sym) for f in concurrency_findings), sym
+    assert not any(
+        f.symbol.startswith("SharedCounter.__init__")
+        for f in concurrency_findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# allowlist contract
+# ---------------------------------------------------------------------------
+
+
+def test_allowlist_requires_justification():
+    with pytest.raises(AllowlistError, match="justification"):
+        Allowlist(
+            [{"rule": "r", "path": "p", "symbol": "s", "justification": "  "}]
+        )
+
+
+def test_allowlist_marks_and_reports_stale():
+    entries = [
+        {
+            "rule": "mutation/deepcopy",
+            "path": "x.py",
+            "symbol": "f",
+            "justification": "because",
+        },
+        {
+            "rule": "mutation/deepcopy",
+            "path": "gone.py",
+            "symbol": "g",
+            "justification": "stale",
+        },
+    ]
+    allow = Allowlist(entries)
+    finding = speclint.Finding(
+        rule="mutation/deepcopy", path="x.py", line=3, symbol="f", message="m"
+    )
+    allow.apply([finding])
+    assert finding.allowlisted and finding.justification == "because"
+    stale = allow.stale_entries()
+    assert len(stale) == 1 and stale[0].symbol == "g"
+    assert stale[0].rule == "speclint/stale-allowlist"
+
+
+def test_checked_in_allowlist_is_wellformed():
+    allow = Allowlist.load()
+    for entry in allow.entries:
+        assert len(entry["justification"].strip()) >= 20, (
+            "justifications must actually explain the exception: "
+            f"{entry['symbol']}"
+        )

@@ -318,6 +318,89 @@ def test_property_copies_diverge_independently(small_groups):
 
 
 # ---------------------------------------------------------------------------
+# manifest lockstep: every instrumented mutator keeps the incremental root
+# ---------------------------------------------------------------------------
+
+
+def test_every_manifest_mutator_keeps_incremental_root(small_groups):
+    """Runtime counterpart of tools/speclint's mutation-purity analyzer:
+    drive every mutator named in ssz/core.py's instrumented-surface
+    manifest against an armed (dirty-group-tracked) list and assert the
+    incremental root stays bit-identical to a cold recompute. The
+    coverage assertion fails the moment a new mutator enters the
+    manifest without a script here — manifest, analyzer, and runtime
+    stay in lockstep."""
+    surface = ssz_core.instrumented_surface()
+    rng = random.Random(20260804)
+
+    def setitem(xs):
+        xs[rng.randrange(len(xs))] = rng.getrandbits(60)
+
+    def setitem_slice(xs):
+        xs[1:3] = [rng.getrandbits(60), rng.getrandbits(60)]
+
+    def delitem(xs):
+        del xs[rng.randrange(len(xs))]
+
+    def iadd(xs):
+        ys = xs
+        ys += [rng.getrandbits(60) for _ in range(3)]
+
+    def imul(xs):
+        ys = xs
+        ys *= 2
+
+    scripts = {
+        "__setitem__": [setitem, setitem_slice],
+        "__delitem__": [delitem],
+        "__iadd__": [iadd],
+        "__imul__": [imul],
+        "append": [lambda xs: xs.append(rng.getrandbits(60))],
+        "extend": [lambda xs: xs.extend(rng.getrandbits(60) for _ in range(5))],
+        "insert": [lambda xs: xs.insert(rng.randrange(len(xs) + 1), rng.getrandbits(60))],
+        "pop": [lambda xs: xs.pop(), lambda xs: xs.pop(rng.randrange(len(xs)))],
+        "remove": [lambda xs: xs.remove(xs[rng.randrange(len(xs))])],
+        "clear": [lambda xs: xs.clear()],
+        "sort": [lambda xs: xs.sort()],
+        "reverse": [lambda xs: xs.reverse()],
+    }
+    # lockstep: a manifest mutator with no script here must fail loudly
+    assert set(scripts) == set(surface["list_mutators"])
+    assert surface["bulk_mutators"] == ("bulk_store",)
+
+    LT = List[uint64, 1 << 16]
+    for name in surface["list_mutators"]:
+        for script in scripts[name]:
+            values = CachedRootList(rng.getrandbits(60) for _ in range(40))
+            LT.hash_tree_root(values)  # arm tracking/memos
+            script(values)
+            got = LT.hash_tree_root(values)
+            want = LT.hash_tree_root(CachedRootList(list(values)))
+            assert got == want, f"mutator {name} left a stale incremental root"
+
+    # the bulk-mutator channel, certified and uncertified
+    for changed in ([2, 17, 33], None):
+        values = CachedRootList(rng.getrandbits(60) for _ in range(40))
+        LT.hash_tree_root(values)
+        new = list(values)
+        for i in (2, 17, 33):
+            new[i] += 1
+        bulk_store(values, new, changed)
+        assert LT.hash_tree_root(values) == LT.hash_tree_root(CachedRootList(new))
+
+    # the container-field-write channel (Container.__setattr__)
+    assert surface["container_field_write"] == "Container.__setattr__"
+    CLT = List[Val, 4096]
+    values = CachedRootList(Val(a=i, b=bytes([i % 256]) * 32) for i in range(24))
+    CLT.hash_tree_root(values)
+    values[7].a = rng.getrandbits(50)
+    values[19].b = rng.randbytes(32)
+    got = CLT.hash_tree_root(values)
+    want = CLT.hash_tree_root(CachedRootList(Val(a=v.a, b=v.b) for v in values))
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
 # six-fork state-level bit-identity (incremental vs cold deserialize)
 # ---------------------------------------------------------------------------
 

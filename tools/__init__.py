@@ -1,0 +1,7 @@
+"""Developer tooling for the repo (not shipped with the library).
+
+``tools.speclint`` — the AST-based static-analysis suite (fork drift,
+SSZ mutation purity, pipeline concurrency). Run as a CLI
+(``python -m tools.speclint``) or via the tier-1 test
+(``tests/test_speclint.py``).
+"""

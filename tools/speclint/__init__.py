@@ -1,0 +1,93 @@
+"""speclint — AST static analysis for the invariants review can't hold.
+
+Three analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
+
+* ``forkdiff``   — drift among the six near-copy ``models/<fork>/``
+                   packages (shadowed duplicates, drifted copies,
+                   missing re-exports, signature divergence).
+* ``mutation``   — SSZ mutation purity in ``models/`` + ``pipeline/``:
+                   every write must flow through the instrumented
+                   surface ``ssz/core.py`` manifests, or incremental
+                   hash_tree_root serves stale roots.
+* ``concurrency``— shared mutable state in ``pipeline/`` +
+                   ``crypto/bls.py`` must be lock-dominated; bare
+                   threading primitives outside the blessed set flag.
+
+Run: ``python -m tools.speclint [--format text|json] [paths...]`` — or
+through the tier-1 gate ``tests/test_speclint.py`` (zero non-allowlisted
+findings over the repo). Exceptions live in ``allowlist.toml`` with a
+required justification each; stale entries are themselves findings.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import concurrency, forkdiff, mutation
+from .allowlist import ALLOWLIST_PATH, Allowlist, AllowlistError
+from .base import Finding, iter_py_files
+
+__all__ = [
+    "Allowlist",
+    "AllowlistError",
+    "ALLOWLIST_PATH",
+    "Finding",
+    "run",
+    "REPO_ROOT",
+]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_PKG = "ethereum_consensus_tpu"
+
+
+def _default_targets(root: str) -> dict:
+    return {
+        "models_dir": os.path.join(root, _PKG, "models"),
+        "mutation_paths": iter_py_files(
+            os.path.join(root, _PKG, "models"),
+            os.path.join(root, _PKG, "pipeline"),
+        ),
+        "concurrency_paths": iter_py_files(
+            os.path.join(root, _PKG, "pipeline"),
+            os.path.join(root, _PKG, "crypto", "bls.py"),
+        ),
+        "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
+    }
+
+
+def run(
+    root: "str | None" = None,
+    paths: "list | None" = None,
+    allowlist_path: "str | None" = None,
+) -> list:
+    """The full suite over the repo: every analyzer on its default
+    scope, allowlist applied, stale allowlist entries reported. When
+    ``paths`` is given, findings are filtered to files under those paths
+    (and stale-allowlist reporting is skipped — a partial run can't
+    judge staleness)."""
+    root = root or REPO_ROOT
+    targets = _default_targets(root)
+    findings: list[Finding] = []
+    findings.extend(forkdiff.analyze_models(targets["models_dir"], root))
+    findings.extend(
+        mutation.analyze(targets["mutation_paths"], root, targets["core_path"])
+    )
+    findings.extend(concurrency.analyze(targets["concurrency_paths"], root))
+
+    if paths:
+        wanted = [
+            os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+            for p in paths
+        ]
+        findings = [
+            f
+            for f in findings
+            if any(f.path == w or f.path.startswith(w + "/") for w in wanted)
+        ]
+
+    allow = Allowlist.load(allowlist_path or ALLOWLIST_PATH)
+    allow.apply(findings)
+    if not paths:
+        findings.extend(allow.stale_entries())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

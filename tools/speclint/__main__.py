@@ -1,0 +1,94 @@
+"""CLI: ``python -m tools.speclint [--format text|json] [paths...]``.
+
+Exit status: 0 when every finding is allowlisted (or there are none),
+1 when non-allowlisted findings remain, 2 on a malformed allowlist.
+
+``--write-forkdiff [PATH]`` renders docs/FORKDIFF.md from the fork-diff
+machinery and exits (0) without linting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import REPO_ROOT, AllowlistError, run
+from .forkdiff import render_forkdiff
+
+
+def main(argv: "list | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.speclint",
+        description="AST static analysis: fork drift, SSZ mutation purity, "
+        "pipeline concurrency (docs/SPECLINT.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="restrict findings to these files/directories (default: full repo)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="also print allowlisted findings (text format)",
+    )
+    parser.add_argument(
+        "--write-forkdiff",
+        nargs="?",
+        const=os.path.join(REPO_ROOT, "docs", "FORKDIFF.md"),
+        metavar="PATH",
+        help="render the fork-composition report to PATH "
+        "(default docs/FORKDIFF.md) and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.write_forkdiff:
+        models_dir = os.path.join(REPO_ROOT, "ethereum_consensus_tpu", "models")
+        report = render_forkdiff(models_dir, REPO_ROOT)
+        with open(args.write_forkdiff, "w", encoding="utf-8") as f:
+            f.write(report)
+        print(f"wrote {args.write_forkdiff}")
+        return 0
+
+    try:
+        findings = run(paths=args.paths or None)
+    except AllowlistError as exc:
+        print(f"speclint: allowlist error: {exc}", file=sys.stderr)
+        return 2
+
+    open_findings = [f for f in findings if not f.allowlisted]
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "open": len(open_findings),
+                    "allowlisted": len(findings) - len(open_findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        shown = findings if args.all else open_findings
+        for finding in shown:
+            print(finding.format_text())
+            print()
+        n_allow = len(findings) - len(open_findings)
+        print(
+            f"speclint: {len(open_findings)} open finding(s), "
+            f"{n_allow} allowlisted"
+        )
+    return 1 if open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""Checked-in exceptions: ``tools/speclint/allowlist.toml``.
+
+Each ``[[allow]]`` entry names a (rule, path, symbol) triple plus a
+REQUIRED human justification. Matching is by symbol, not line number, so
+ordinary edits never stale an entry; an entry that matches nothing is
+itself reported (``speclint/stale-allowlist``) so the file cannot rot.
+
+The interpreter here is 3.10 (no ``tomllib``) and the repo vendors no
+third-party TOML reader, so ``_parse_toml_tables`` implements the tiny
+subset the allowlist needs: ``[[table]]`` headers, ``key = "string"``
+pairs, comments, blank lines. The file stays valid TOML throughout.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .base import Finding
+
+ALLOWLIST_PATH = os.path.join(os.path.dirname(__file__), "allowlist.toml")
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist file (bad syntax or a missing required key)."""
+
+
+def _parse_string(raw: str, where: str) -> str:
+    raw = raw.strip()
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in ("'", '"'):
+        body = raw[1:-1]
+        if raw[0] == '"':
+            body = body.encode("ascii", "backslashreplace").decode("unicode_escape")
+        return body
+    raise AllowlistError(f"{where}: expected a quoted string, got {raw!r}")
+
+
+def _parse_toml_tables(text: str, table_name: str, where: str) -> list[dict]:
+    tables: list[dict] = []
+    current: dict | None = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        if stripped.startswith("[["):
+            name = stripped.strip("[]").strip()
+            if name != table_name:
+                raise AllowlistError(
+                    f"{where}:{lineno}: unexpected table [[{name}]] "
+                    f"(only [[{table_name}]] is recognized)"
+                )
+            current = {}
+            tables.append(current)
+            continue
+        if "=" not in stripped:
+            raise AllowlistError(f"{where}:{lineno}: cannot parse {stripped!r}")
+        if current is None:
+            raise AllowlistError(
+                f"{where}:{lineno}: key outside any [[{table_name}]] table"
+            )
+        key, _, value = stripped.partition("=")
+        current[key.strip()] = _parse_string(value, f"{where}:{lineno}")
+    return tables
+
+
+class Allowlist:
+    """Entries loaded from disk plus per-entry use tracking."""
+
+    REQUIRED_KEYS = ("rule", "path", "symbol", "justification")
+
+    def __init__(self, entries: list[dict], where: str = "<allowlist>"):
+        for i, entry in enumerate(entries):
+            for key in self.REQUIRED_KEYS:
+                if not str(entry.get(key, "")).strip():
+                    raise AllowlistError(
+                        f"{where}: entry {i + 1} "
+                        f"({entry.get('rule', '?')} @ {entry.get('path', '?')}) "
+                        f"is missing required key {key!r} — every exception "
+                        "needs a justification"
+                    )
+        self.entries = entries
+        self.where = where
+        self._used = [False] * len(entries)
+
+    @classmethod
+    def load(cls, path: str = ALLOWLIST_PATH) -> "Allowlist":
+        if not os.path.exists(path):
+            return cls([], where=path)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        return cls(_parse_toml_tables(text, "allow", path), where=path)
+
+    def match(self, finding: Finding) -> "dict | None":
+        for i, entry in enumerate(self.entries):
+            if (
+                entry["rule"] == finding.rule
+                and entry["path"] == finding.path
+                and entry["symbol"] == finding.symbol
+            ):
+                self._used[i] = True
+                return entry
+        return None
+
+    def apply(self, findings: list[Finding]) -> list[Finding]:
+        """Mark allowlisted findings in place; returns the same list."""
+        for finding in findings:
+            entry = self.match(finding)
+            if entry is not None:
+                finding.allowlisted = True
+                finding.justification = entry["justification"]
+        return findings
+
+    def stale_entries(self) -> list[Finding]:
+        """Entries that matched no finding this run — the allowlist refers
+        to code that no longer trips the rule and should be pruned.
+        Only meaningful after a FULL-repo ``apply`` (a path-filtered run
+        legitimately leaves entries unused)."""
+        out = []
+        for used, entry in zip(self._used, self.entries):
+            if not used:
+                out.append(
+                    Finding(
+                        rule="speclint/stale-allowlist",
+                        path=entry["path"],
+                        line=0,
+                        symbol=entry["symbol"],
+                        message=(
+                            f"allowlist entry for {entry['rule']} at "
+                            f"{entry['path']} ({entry['symbol']}) matched no "
+                            "finding"
+                        ),
+                        hint="remove the stale [[allow]] entry from allowlist.toml",
+                    )
+                )
+        return out

@@ -1,0 +1,162 @@
+"""speclint framework: findings, source loading, AST normalization.
+
+Everything here is plain ``ast`` over checked-in source files — no
+imports of the analyzed code, no runtime reflection (the one deliberate
+exception: the mutation analyzer reads the instrumented-surface manifest
+out of ``ssz/core.py``'s AST, so even that stays static). That keeps the
+linter runnable on a broken tree, which is exactly when you want it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    """One rule violation at a concrete location.
+
+    * ``rule`` — ``<analyzer>/<rule-name>`` (the allowlist key).
+    * ``path`` — repo-relative POSIX path of the offending file.
+    * ``line`` — 1-based line of the offending statement.
+    * ``symbol`` — the stable name the allowlist matches on (function,
+      class, or global being misused) so line drift never stales an
+      allowlist entry.
+    * ``message`` — one-line statement of the violation.
+    * ``hint`` — one-line fix suggestion.
+    """
+
+    rule: str
+    path: str
+    line: int
+    symbol: str
+    message: str
+    hint: str = ""
+    allowlisted: bool = False
+    justification: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "hint": self.hint,
+            "allowlisted": self.allowlisted,
+            "justification": self.justification,
+        }
+
+    def format_text(self) -> str:
+        mark = " [allowlisted]" if self.allowlisted else ""
+        out = f"{self.path}:{self.line}: {self.rule} ({self.symbol}){mark}\n    {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class SourceModule:
+    """A parsed source file plus the identity speclint reports it under."""
+
+    path: str  # repo-relative POSIX path
+    abspath: str
+    tree: ast.Module = field(repr=False)
+
+    @classmethod
+    def load(cls, abspath: str, root: str) -> "SourceModule":
+        with open(abspath, "rb") as f:
+            source = f.read()
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        return cls(path=rel, abspath=abspath, tree=ast.parse(source, filename=rel))
+
+
+def iter_py_files(*dirs_or_files: str) -> list[str]:
+    """Every .py file under the given paths, sorted, files passed through."""
+    out: list[str] = []
+    for p in dirs_or_files:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for base, _dirnames, filenames in os.walk(p):
+            for name in filenames:
+                if name.endswith(".py"):
+                    out.append(os.path.join(base, name))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# AST normalization (the fork-diff "identical definition" test)
+# ---------------------------------------------------------------------------
+
+
+class _DocstringStripper(ast.NodeTransformer):
+    def _strip(self, node):
+        body = node.body
+        if (
+            body
+            and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)
+        ):
+            node.body = body[1:] or [ast.Pass()]
+        return node
+
+    def visit_FunctionDef(self, node):
+        self.generic_visit(node)
+        return self._strip(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.generic_visit(node)
+        return self._strip(node)
+
+    def visit_ClassDef(self, node):
+        self.generic_visit(node)
+        return self._strip(node)
+
+
+def normalized_dump(node: ast.AST) -> str:
+    """``ast.dump`` of a copy with docstrings removed — two definitions
+    with equal dumps are byte-for-byte the same logic (comments and
+    docstrings excluded). Used to tell a *drifted copy* (identical body,
+    should be a re-export) from an *intentional override* (distinct
+    body)."""
+    import copy as _copy
+
+    clone = _copy.deepcopy(node)
+    clone = _DocstringStripper().visit(clone)
+    ast.fix_missing_locations(clone)
+    return ast.dump(clone)
+
+
+def function_signature(node: ast.FunctionDef) -> tuple:
+    """Comparable shape of a function's REQUIRED parameter list: the
+    positional parameters without defaults, in order. Defaulted
+    positionals, keyword-only hooks, ``*args``/``**kwargs``, and
+    annotations are deliberately excluded — a fork that only ADDS
+    optional seams (altair's ``process_operations(..., *, slash_fn=None)``)
+    keeps every prior-fork call site working, and an override that
+    narrows back to the spec shape is equally call-compatible. Only a
+    change to the required positional shape breaks callers."""
+    a = node.args
+    positional = [x.arg for x in a.posonlyargs] + [x.arg for x in a.args]
+    n_defaulted = len(a.defaults)
+    if n_defaulted:
+        positional = positional[:-n_defaulted]
+    return tuple(positional)
+
+
+def literal_str_list(node: ast.AST) -> "list[str] | None":
+    """The value of a ``__all__``-style list/tuple of string constants, or
+    None when it isn't statically a list of strings."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    out = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.append(elt.value)
+        else:
+            return None
+    return out

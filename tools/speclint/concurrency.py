@@ -1,0 +1,371 @@
+"""Concurrency analyzer for the two-stage pipeline's shared state.
+
+PR 2's background verifier made parts of ``pipeline/`` and
+``crypto/bls.py`` genuinely multi-threaded (stage A mutates state on the
+submitting thread while stage B verifies on the worker), and it already
+shipped one race fix (the pubkey cache's FIFO eviction). These rules are
+the lexical approximation of "every shared mutable reached from both
+threads is dominated by a lock":
+
+* ``concurrency/unlocked-global-write`` — a write to module-level
+  mutable state (a dict/list/set global, or a ``global``-rebound lazy
+  singleton) from inside a function, with no enclosing ``with <lock>:``
+  whose context expression names a module-level ``threading.Lock``.
+  Reads are deliberately NOT flagged: the repo's documented discipline
+  is lock-free reads (dict get is atomic) with serialized writes.
+* ``concurrency/unlocked-instance-write`` — a class that declares an
+  instance lock (``self._lock = threading.Lock()`` in ``__init__``)
+  must use it on every instance-attribute write outside ``__init__``:
+  declaring the lock IS the claim that the instance crosses threads
+  (``PipelineStats``), so an unlocked counter bump is a torn snapshot
+  waiting to happen. Lock-free classes (engine/scheduler, single-thread
+  by design) are out of scope by construction.
+* ``concurrency/bare-threading-primitive`` — ``threading`` primitives
+  outside the blessed set {Lock, RLock, local, current_thread,
+  get_ident} (plus ``concurrent.futures`` pools, which are the
+  sanctioned way to own a worker). Raw ``Thread``/``Event``/
+  ``Condition``/``Semaphore``/``Timer`` and ``_thread`` escape the
+  pipeline's "locks + single-worker FIFO pool" concurrency model and
+  need an explicit allowlist entry to exist here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Finding, SourceModule
+
+_MUTATING_METHODS = {
+    "append",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "remove",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "discard",
+    "sort",
+    "reverse",
+    "appendleft",
+    "popleft",
+    "__setitem__",
+    "__delitem__",
+}
+
+_BLESSED_THREADING = {"Lock", "RLock", "local", "current_thread", "get_ident"}
+
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "OrderedDict", "defaultdict"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr in ("Lock", "RLock")
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "threading"
+    ) or (isinstance(func, ast.Name) and func.id in ("Lock", "RLock"))
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else None
+        )
+        return name in _MUTABLE_CTORS
+    return False
+
+
+class _ModuleScan:
+    """Module-level facts: lock globals, mutable globals, lazy singletons."""
+
+    def __init__(self, tree: ast.Module):
+        self.locks: set = set()
+        self.mutable_globals: set = set()
+        self.none_globals: set = set()
+        for node in tree.body:
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if _is_lock_ctor(value):
+                    self.locks.add(target.id)
+                elif _is_mutable_literal(value):
+                    self.mutable_globals.add(target.id)
+                elif isinstance(value, ast.Constant) and value.value is None:
+                    self.none_globals.add(target.id)
+
+
+def _with_names(with_node: ast.With) -> set:
+    """Every Name id / Attribute attr mentioned in the with-items'
+    context expressions (``with self._lock:`` → {"self", "_lock"})."""
+    out: set = set()
+    for item in with_node.items:
+        for sub in ast.walk(item.context_expr):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+    return out
+
+
+class _FunctionChecker(ast.NodeVisitor):
+    """Walks one function body tracking the active ``with`` stack."""
+
+    def __init__(
+        self,
+        path: str,
+        qualname: str,
+        scan: _ModuleScan,
+        instance_locks: set,
+        findings: list,
+        is_init: bool,
+    ):
+        self.path = path
+        self.qualname = qualname
+        self.scan = scan
+        self.instance_locks = instance_locks
+        self.findings = findings
+        self.is_init = is_init
+        self.globals_declared: set = set()
+        self.held: list = []  # stack of name-sets from enclosing with blocks
+
+    # -- helpers -------------------------------------------------------------
+    def _lock_held(self, lock_names: set) -> bool:
+        return any(names & lock_names for names in self.held)
+
+    def _module_lock_held(self) -> bool:
+        return self._lock_held(self.scan.locks)
+
+    def _instance_lock_held(self) -> bool:
+        return self._lock_held(self.instance_locks)
+
+    def _emit(self, rule: str, line: int, symbol: str, message: str, hint: str):
+        self.findings.append(
+            Finding(
+                rule=rule, path=self.path, line=line, symbol=symbol,
+                message=message, hint=hint,
+            )
+        )
+
+    # -- scope / with tracking ----------------------------------------------
+    def visit_FunctionDef(self, node):
+        # nested defs (worker closures) inherit the ambient facts but get
+        # their own with-stack snapshot — a closure runs LATER, outside
+        # the lexically enclosing with block, so nothing is "held"
+        inner = _FunctionChecker(
+            self.path,
+            f"{self.qualname}.{node.name}",
+            self.scan,
+            self.instance_locks,
+            self.findings,
+            is_init=False,
+        )
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        self.held.append(_with_names(node))
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.pop()
+
+    def visit_Global(self, node):
+        self.globals_declared.update(node.names)
+
+    # -- writes --------------------------------------------------------------
+    def _check_global_write(self, name: str, line: int, what: str):
+        if not self._module_lock_held():
+            self._emit(
+                "concurrency/unlocked-global-write",
+                line,
+                f"{self.qualname}/{name}",
+                f"{what} of module global {name!r} without holding a "
+                "module-level lock — the background verifier and the "
+                "application thread can interleave here",
+                "wrap the write in `with <module lock>:` (reads may stay "
+                "lock-free), or allowlist with the reason it is safe",
+            )
+
+    def _check_instance_write(self, attr: str, line: int, what: str):
+        if self.is_init or attr in self.instance_locks:
+            return
+        if not self._instance_lock_held():
+            self._emit(
+                "concurrency/unlocked-instance-write",
+                line,
+                f"{self.qualname}/{attr}",
+                f"{what} of self.{attr} outside `with self.<lock>:` in a "
+                "class that declares an instance lock — the lock's "
+                "existence is the claim this object crosses threads",
+                "take the instance lock around the write (or allowlist "
+                "with the reason this member is single-threaded)",
+            )
+
+    def visit_Assign(self, node):
+        for target in node.targets:
+            self._check_write_target(target, node.lineno, "assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check_write_target(node.target, node.lineno, "in-place update")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for target in node.targets:
+            self._check_write_target(target, node.lineno, "delete")
+        self.generic_visit(node)
+
+    def _check_write_target(self, target: ast.AST, line: int, what: str):
+        if isinstance(target, ast.Name):
+            if target.id in self.globals_declared:
+                self._check_global_write(target.id, line, what)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name) and base.id in self.scan.mutable_globals:
+                self._check_global_write(base.id, line, f"subscript {what}")
+            elif (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and self.instance_locks
+            ):
+                self._check_instance_write(base.attr, line, f"subscript {what}")
+        elif isinstance(target, ast.Attribute):
+            if (
+                isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and self.instance_locks
+            ):
+                self._check_instance_write(target.attr, line, what)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_write_target(elt, line, what)
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATING_METHODS:
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in self.scan.mutable_globals:
+                self._check_global_write(
+                    base.id, node.lineno, f".{func.attr}() call"
+                )
+            elif (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+                and self.instance_locks
+            ):
+                self._check_instance_write(
+                    base.attr, node.lineno, f".{func.attr}() call"
+                )
+        self.generic_visit(node)
+
+
+def _instance_locks_of_class(cls: ast.ClassDef) -> set:
+    locks: set = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    locks.add(target.attr)
+    return locks
+
+
+def _check_threading_primitives(src: SourceModule, findings: list) -> None:
+    for node in ast.walk(src.tree):
+        bad = None
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "threading"
+            and node.attr not in _BLESSED_THREADING
+        ):
+            bad = f"threading.{node.attr}"
+        elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+            names = [a.name for a in node.names if a.name not in _BLESSED_THREADING]
+            if names:
+                bad = f"from threading import {', '.join(names)}"
+        elif isinstance(node, (ast.Import,)):
+            for alias in node.names:
+                if alias.name == "_thread":
+                    bad = "_thread"
+        if bad:
+            findings.append(
+                Finding(
+                    rule="concurrency/bare-threading-primitive",
+                    path=src.path,
+                    line=getattr(node, "lineno", 1),
+                    symbol=bad,
+                    message=(
+                        f"{bad} is outside the blessed concurrency set "
+                        "(Lock/RLock/local + concurrent.futures pools) — "
+                        "the pipeline's model is locks plus a single-worker "
+                        "FIFO pool"
+                    ),
+                    hint=(
+                        "use a Lock or a ThreadPoolExecutor, or allowlist "
+                        "with the reason this primitive is needed"
+                    ),
+                )
+            )
+
+
+def analyze_file(abspath: str, root: str) -> list[Finding]:
+    src = SourceModule.load(abspath, root)
+    scan = _ModuleScan(src.tree)
+    findings: list[Finding] = []
+    _check_threading_primitives(src, findings)
+
+    def check_function(node, qualname: str, instance_locks: set, is_init: bool):
+        checker = _FunctionChecker(
+            src.path, qualname, scan, instance_locks, findings, is_init
+        )
+        # pre-scan for `global` declarations anywhere in the body (they
+        # are function-scoped regardless of position)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                checker.globals_declared.update(sub.names)
+        for stmt in node.body:
+            checker.visit(stmt)
+
+    for node in src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            check_function(node, node.name, set(), is_init=False)
+        elif isinstance(node, ast.ClassDef):
+            instance_locks = _instance_locks_of_class(node)
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    check_function(
+                        item,
+                        f"{node.name}.{item.name}",
+                        instance_locks,
+                        is_init=item.name == "__init__",
+                    )
+    return findings
+
+
+def analyze(paths: list, root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in paths:
+        findings.extend(analyze_file(path, root))
+    return findings

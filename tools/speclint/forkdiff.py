@@ -1,0 +1,515 @@
+"""Fork-diff analyzer: drift among the six near-copy fork packages.
+
+The reference implementation prevents this failure class mechanically —
+spec-gen AST-merges each fork's diff modules onto the previous fork's
+spec, so a definition exists in exactly one place. Here the same layering
+is plain namespace composition (``models/_diff.inherit`` + explicit
+re-export imports), which a human can silently break in three ways, each
+a rule below:
+
+* ``forkdiff/shadowed-duplicate`` — a fork module re-DEFINES a name the
+  shared skeleton (``models/transition.py``) already exports. Identity
+  comparisons make this a live bug even when the bodies match: the PR 2
+  ``Validation`` enum (phase0 carried its own copy, so the Executor's
+  ``validation is Validation.ENABLED`` check was always False and phase0
+  blocks silently skipped proposer-signature AND state-root checks).
+* ``forkdiff/drifted-copy`` — a fork module re-defines a name from the
+  prior fork with a byte-identical body (docstrings/comments aside): a
+  copy that will drift the next time the original changes. Should be a
+  re-export (or ``inherit``).
+* ``forkdiff/missing-reexport`` — a name on the chain's *declared*
+  surface (``__all__`` accumulated fork-to-fork) is absent from this
+  fork's effective surface (not defined, not imported, not inherited) —
+  the ``process_slots`` class of hole PR 2 patched across all six
+  forks. A drop flags ONCE at the fork where it happens (and leaves the
+  required surface), so an intentional retirement is one fix-or-
+  allowlist decision at the boundary, not an echo down every later
+  fork.
+* ``forkdiff/signature-divergence`` — a fork's override takes a
+  different parameter list than the prior fork's definition, so code
+  written against one fork breaks on another. Intentional divergences
+  are allowlisted with a justification.
+
+The same machinery renders ``docs/FORKDIFF.md`` (``render_forkdiff``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .base import (
+    Finding,
+    SourceModule,
+    function_signature,
+    literal_str_list,
+    normalized_dump,
+)
+
+FORK_ORDER = ("phase0", "altair", "bellatrix", "capella", "deneb", "electra")
+
+# Module kinds whose public surface chains fork-to-fork: every name the
+# prior fork exports must stay reachable (defined, imported, or
+# inherited). Containers/genesis/fork/constants are fork-scoped by
+# design — their surface is the ``build`` factory / upgrade function, not
+# a per-name chain — so only the spec-logic kinds are checked.
+CHAINED_KINDS = (
+    "helpers",
+    "block_processing",
+    "epoch_processing",
+    "slot_processing",
+    "state_transition",
+)
+
+
+@dataclass
+class Definition:
+    """One top-level definition with everything the rules compare."""
+
+    name: str
+    kind: str  # "function" | "class" | "constant"
+    line: int
+    fork: str  # fork (or "transition") where the body lives
+    dump: str = ""  # normalized AST dump ("" for constants/imports)
+    signature: "tuple | None" = None
+    node: "ast.AST | None" = None
+
+
+@dataclass
+class ModuleSurface:
+    """Statically derived composition of one fork module."""
+
+    fork: str
+    kind: str
+    path: str
+    local: dict = field(default_factory=dict)  # name -> Definition
+    imported: dict = field(default_factory=dict)  # name -> (fork, kind) | None
+    inherit_parent: "tuple[str, str] | None" = None  # (fork, kind)
+    dunder_all: "list[str] | None" = None
+    module_aliases: dict = field(default_factory=dict)  # alias -> (fork, kind)
+
+
+def _resolve_relative(level: int, module: str) -> "tuple | None":
+    """Classify a ``from``-import inside ``models/<fork>/<kind>.py``.
+
+    Returns ("fork", fork, kind), ("shared", module_name), or None for
+    anything outside the models package."""
+    parts = module.split(".") if module else []
+    if level == 2:
+        if len(parts) == 2 and parts[0] in FORK_ORDER:
+            return ("fork", parts[0], parts[1])
+        if len(parts) == 1 and parts[0] in FORK_ORDER:
+            return ("forkpkg", parts[0], None)
+        if len(parts) == 1:
+            return ("shared", parts[0])
+    if level == 1 and len(parts) == 1:
+        return ("sibling", parts[0])
+    if level == 1 and not parts:
+        # ``from . import helpers as h`` — each alias is a sibling MODULE
+        # of the importing fork (so ``h.`` calls bind per fork)
+        return ("siblingpkg",)
+    return None
+
+
+def parse_fork_module(src: SourceModule, fork: str, kind: str) -> ModuleSurface:
+    surf = ModuleSurface(fork=fork, kind=kind, path=src.path)
+    for node in src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            surf.local[node.name] = Definition(
+                name=node.name,
+                kind="function",
+                line=node.lineno,
+                fork=fork,
+                dump=normalized_dump(node),
+                signature=function_signature(node),
+                node=node,
+            )
+        elif isinstance(node, ast.ClassDef):
+            surf.local[node.name] = Definition(
+                name=node.name,
+                kind="class",
+                line=node.lineno,
+                fork=fork,
+                dump=normalized_dump(node),
+                node=node,
+            )
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if target.id == "__all__":
+                    surf.dunder_all = literal_str_list(node.value)
+                else:
+                    surf.local[target.id] = Definition(
+                        name=target.id,
+                        kind="constant",
+                        line=node.lineno,
+                        fork=fork,
+                        dump=normalized_dump(node.value) if node.value else "",
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            where = _resolve_relative(node.level, node.module or "")
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                if where is None:
+                    # external to models/: keep a comparable origin token
+                    surf.imported[bound] = (
+                        "external",
+                        f"{'.' * node.level}{node.module or ''}",
+                        alias.name,
+                    )
+                elif where[0] == "fork":
+                    surf.imported[bound] = (where[1], where[2])
+                elif where[0] == "forkpkg":
+                    # ``from ..phase0 import containers as alias``
+                    surf.module_aliases[bound] = (where[1], alias.name)
+                elif where[0] == "siblingpkg":
+                    # ``from . import helpers as h`` — fork-local module
+                    surf.module_aliases[bound] = (fork, alias.name)
+                elif where[0] == "shared":
+                    surf.imported[bound] = ("transition", where[1])
+                elif where[0] == "sibling":
+                    surf.imported[bound] = (fork, where[1])
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            call = node.value
+            func = call.func
+            name = None
+            if isinstance(func, ast.Name):
+                name = func.id
+            elif isinstance(func, ast.Attribute):
+                name = func.attr
+            if name == "inherit" and len(call.args) == 2:
+                target = call.args[1]
+                if isinstance(target, ast.Name):
+                    surf.inherit_parent = surf.module_aliases.get(target.id)
+    return surf
+
+
+@dataclass
+class EffectiveName:
+    """One name on a fork module's effective surface and how it got there."""
+
+    name: str
+    how: str  # "local" | "imported" | "inherited"
+    origin: Definition | None  # the defining Definition, when traceable
+
+
+def _effective_surface(
+    surf: ModuleSurface,
+    prior: "dict[str, EffectiveName] | None",
+    shared: "dict[str, Definition]",
+) -> dict:
+    """name -> EffectiveName for this module, composing inherit + imports
+    + local defs exactly the way the runtime composition does."""
+    out: dict[str, EffectiveName] = {}
+    if surf.inherit_parent is not None and prior is not None:
+        for name, eff in prior.items():
+            if not name.startswith("_"):
+                out[name] = EffectiveName(name, "inherited", eff.origin)
+    for name, where in surf.imported.items():
+        origin = None
+        if where is not None and where[0] == "transition":
+            origin = shared.get(name)
+        elif prior is not None and name in prior:
+            origin = prior[name].origin
+        out[name] = EffectiveName(name, "imported", origin)
+    for name, definition in surf.local.items():
+        out[name] = EffectiveName(name, "local", definition)
+    return out
+
+
+def _binding_key(
+    surf: ModuleSurface, effective: "dict[str, EffectiveName]", name: str
+):
+    """A comparable token for what ``name`` means inside this module.
+    Two modules whose tokens agree bind the name to the same definition;
+    a disagreement means a textually identical function is actually
+    *parameterized* by fork-divergent globals (the late-binding idiom:
+    each fork's ``process_slots`` calls its OWN ``process_epoch``)."""
+    if name in surf.module_aliases:
+        return ("module", surf.module_aliases[name])
+    eff = effective.get(name)
+    if eff is not None and eff.origin is not None:
+        return ("def", id(eff.origin))
+    if name in surf.imported:
+        return ("import", surf.imported[name])
+    return ("absent", name)
+
+
+def _free_names(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_true_copy(
+    definition: Definition,
+    cur_surf: ModuleSurface,
+    cur_eff: dict,
+    prior_surf: ModuleSurface,
+    prior_eff: dict,
+) -> bool:
+    """Identical dump AND every referenced global resolves to the same
+    definition in both modules — only then is the re-definition a
+    drifted copy rather than deliberate late-binding."""
+    if definition.node is None:
+        return False
+    for name in _free_names(definition.node):
+        if name == definition.name:
+            continue  # self-reference: both sides name their own copy
+        if _binding_key(cur_surf, cur_eff, name) != _binding_key(
+            prior_surf, prior_eff, name
+        ):
+            return False
+    return True
+
+
+def _load_shared(models_dir: str, root: str) -> dict:
+    shared_path = os.path.join(models_dir, "transition.py")
+    shared: dict[str, Definition] = {}
+    if os.path.exists(shared_path):
+        src = SourceModule.load(shared_path, root)
+        parsed = parse_fork_module(src, "transition", "transition")
+        shared = parsed.local
+    return shared
+
+
+def _module_kinds(models_dir: str, forks: "tuple[str, ...]") -> list[str]:
+    kinds: list[str] = []
+    for fork in forks:
+        fork_dir = os.path.join(models_dir, fork)
+        if not os.path.isdir(fork_dir):
+            continue
+        for name in sorted(os.listdir(fork_dir)):
+            if name.endswith(".py") and name != "__init__.py":
+                kind = name[:-3]
+                if kind not in kinds:
+                    kinds.append(kind)
+    return kinds
+
+
+def analyze_models(models_dir: str, root: "str | None" = None) -> list[Finding]:
+    """Run every fork-diff rule over a ``models/``-layout directory
+    (``transition.py`` + one subpackage per fork, ordered by
+    FORK_ORDER membership)."""
+    root = root or os.getcwd()
+    forks = tuple(
+        f for f in FORK_ORDER if os.path.isdir(os.path.join(models_dir, f))
+    )
+    shared = _load_shared(models_dir, root)
+    findings: list[Finding] = []
+
+    for kind in _module_kinds(models_dir, forks):
+        prior_surface: "dict[str, EffectiveName] | None" = None
+        prior_surf_obj: "ModuleSurface | None" = None
+        prior_fork: "str | None" = None
+        # the chain's declared surface: __all__ names accumulated fork to
+        # fork; a fork must keep every required name reachable or flag
+        required: "set | None" = None
+        for fork in forks:
+            path = os.path.join(models_dir, fork, f"{kind}.py")
+            if not os.path.exists(path):
+                continue
+            src = SourceModule.load(path, root)
+            surf = parse_fork_module(src, fork, kind)
+            current = _effective_surface(surf, prior_surface, shared)
+
+            # -- shadowed-duplicate: re-definition of a shared-skeleton name
+            for name, definition in surf.local.items():
+                if name in shared and definition.kind in ("function", "class"):
+                    findings.append(
+                        Finding(
+                            rule="forkdiff/shadowed-duplicate",
+                            path=surf.path,
+                            line=definition.line,
+                            symbol=f"{fork}/{kind}.{name}",
+                            message=(
+                                f"{fork}/{kind}.py defines its own {definition.kind} "
+                                f"{name!r}, shadowing the shared skeleton's "
+                                f"models/transition.py definition — identity "
+                                "checks (`is`) against the shared object will "
+                                "silently fail (the PR 2 Validation-enum bug)"
+                            ),
+                            hint=(
+                                f"delete the local {name!r} and "
+                                f"`from ..transition import {name}`"
+                            ),
+                        )
+                    )
+
+            # -- rules against the prior fork's surface
+            if prior_surface is not None:
+                for name, definition in surf.local.items():
+                    prior_eff = prior_surface.get(name)
+                    if prior_eff is None or prior_eff.origin is None:
+                        continue
+                    origin = prior_eff.origin
+                    if (
+                        definition.dump
+                        and origin.dump
+                        and definition.dump == origin.dump
+                        and definition.kind in ("function", "class")
+                        and _is_true_copy(
+                            definition, surf, current, prior_surf_obj, prior_surface
+                        )
+                    ):
+                        findings.append(
+                            Finding(
+                                rule="forkdiff/drifted-copy",
+                                path=surf.path,
+                                line=definition.line,
+                                symbol=f"{fork}/{kind}.{name}",
+                                message=(
+                                    f"{fork}/{kind}.py re-defines {name!r} with a "
+                                    f"body identical to {origin.fork}'s — a copy "
+                                    "that will drift silently when the original "
+                                    "changes"
+                                ),
+                                hint=(
+                                    f"replace with a re-export from "
+                                    f"{origin.fork}/{kind} (or inherit())"
+                                ),
+                            )
+                        )
+                    elif (
+                        definition.kind == "function"
+                        and origin.signature is not None
+                        and definition.signature is not None
+                        and definition.signature != origin.signature
+                    ):
+                        findings.append(
+                            Finding(
+                                rule="forkdiff/signature-divergence",
+                                path=surf.path,
+                                line=definition.line,
+                                symbol=f"{fork}/{kind}.{name}",
+                                message=(
+                                    f"{fork}/{kind}.{name} takes "
+                                    f"{_fmt_sig(definition.signature)} but "
+                                    f"{origin.fork}'s definition takes "
+                                    f"{_fmt_sig(origin.signature)} — callers "
+                                    "written against one fork break on the other"
+                                ),
+                                hint=(
+                                    "align the parameter list with the prior "
+                                    "fork, or allowlist with the reason the "
+                                    "divergence is intentional"
+                                ),
+                            )
+                        )
+
+                if kind in CHAINED_KINDS and required is not None:
+                    for name in sorted(required):
+                        if name.startswith("_") or name in current:
+                            continue
+                        findings.append(
+                            Finding(
+                                rule="forkdiff/missing-reexport",
+                                path=surf.path,
+                                line=1,
+                                symbol=f"{fork}/{kind}.{name}",
+                                message=(
+                                    f"{name!r} is on the {kind} chain's "
+                                    f"declared surface (through {prior_fork}) "
+                                    f"but {fork}/{kind} neither defines, "
+                                    "imports, nor inherits it — the fork "
+                                    "surface has a hole (the process_slots "
+                                    "class of bug PR 2 patched)"
+                                ),
+                                hint=(
+                                    f"re-export {name!r} from "
+                                    f"{prior_fork}/{kind} (or use inherit()); "
+                                    "allowlist if the retirement is deliberate"
+                                ),
+                            )
+                        )
+
+            # declared surface carried to the next fork: this fork's own
+            # __all__ (falling back to its public local defs when absent)
+            # plus whatever part of the inherited requirement it still
+            # satisfies — a dropped name flags once, then leaves the chain
+            declared = set(
+                surf.dunder_all
+                if surf.dunder_all is not None
+                else (n for n in surf.local if not n.startswith("_"))
+            )
+            if required is None:
+                required = declared
+            else:
+                required = declared | {n for n in required if n in current}
+            prior_surface = current
+            prior_surf_obj = surf
+            prior_fork = fork
+    return findings
+
+
+def _fmt_sig(sig: tuple) -> str:
+    return "(" + ", ".join(sig) + ")"
+
+
+# ---------------------------------------------------------------------------
+# docs/FORKDIFF.md — the composition report, from the same machinery
+# ---------------------------------------------------------------------------
+
+
+def render_forkdiff(models_dir: str, root: "str | None" = None) -> str:
+    root = root or os.getcwd()
+    forks = tuple(
+        f for f in FORK_ORDER if os.path.isdir(os.path.join(models_dir, f))
+    )
+    shared = _load_shared(models_dir, root)
+    lines = [
+        "# FORKDIFF — fork-module composition report",
+        "",
+        "Generated by `python -m tools.speclint --write-forkdiff` from the",
+        "same AST machinery the fork-diff analyzer runs (tools/speclint/",
+        "forkdiff.py). For every fork module: which names are **new** in",
+        "that fork, which **override** the prior fork's definition, and how",
+        "many are **re-exported/inherited** unchanged. The reference gets",
+        "this table for free from spec-gen's AST merge; here it is derived",
+        "statically so drift is visible in review.",
+        "",
+        f"Fork order: {' → '.join(forks)}",
+        "",
+    ]
+    for kind in _module_kinds(models_dir, forks):
+        lines.append(f"## {kind}")
+        lines.append("")
+        prior_surface = None
+        for fork in forks:
+            path = os.path.join(models_dir, fork, f"{kind}.py")
+            if not os.path.exists(path):
+                continue
+            src = SourceModule.load(path, root)
+            surf = parse_fork_module(src, fork, kind)
+            current = _effective_surface(surf, prior_surface, shared)
+            new, overrides = [], []
+            for name, definition in sorted(surf.local.items()):
+                if name.startswith("_"):
+                    continue
+                if prior_surface is not None and name in prior_surface:
+                    overrides.append(name)
+                elif name in shared:
+                    overrides.append(name + " (!shadows shared skeleton)")
+                else:
+                    new.append(name)
+            carried = sum(
+                1
+                for name, eff in current.items()
+                if eff.how in ("imported", "inherited")
+            )
+            via = (
+                f"inherit({surf.inherit_parent[0]}.{surf.inherit_parent[1]})"
+                if surf.inherit_parent
+                else "explicit re-exports"
+            )
+            lines.append(f"### {fork} ({via}; {carried} names carried)")
+            if new:
+                lines.append(f"- new: {', '.join(new)}")
+            if overrides:
+                lines.append(f"- overrides: {', '.join(overrides)}")
+            if not new and not overrides:
+                lines.append("- no local public definitions (pure pass-through)")
+            lines.append("")
+            prior_surface = current
+    return "\n".join(lines) + "\n"
