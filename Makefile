@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench pipeline-selfcheck
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench pipeline-selfcheck trace metrics
 
 help:  ## list targets
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
@@ -30,3 +30,11 @@ bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 
 pipeline-selfcheck:  ## pipeline smoke: seq-vs-pipelined bit identity
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck
+
+trace:  ## record a pipeline run as Chrome trace JSON (open in Perfetto)
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --trace-out trace.json
+	@echo "load trace.json at https://ui.perfetto.dev or chrome://tracing"
+
+metrics:  ## dump the telemetry metrics registry after a pipeline run
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --metrics-out metrics.json
+	@cat metrics.json

@@ -44,6 +44,14 @@ The ``detail.configs`` dict carries the BASELINE.md configs and more:
                           (u64 vs int8-MXU), the routing-threshold probe
   * ``large_agg``       — 2^16-point G1 aggregation, device vs native
 
+Telemetry (docs/OBSERVABILITY.md): every config's result carries a
+``metrics`` block — registry counter deltas (SSZ digests, pubkey-cache
+hit rate, bulk-decompress and pairing-route counts, flush shape) — and
+the per-block configs attribute their ``phases`` from the transition's
+own telemetry spans. ``--trace-out PATH`` records the whole child run
+as Chrome trace JSON; ``--metrics-out PATH`` dumps the final registry
+snapshot.
+
 Prints ONE COMPACT JSON line as the last stdout line (small enough for
 any log-tail window — round 4's full dump truncated mid-object and the
 driver recorded parsed:null); the full per-config evidence, including
@@ -78,6 +86,8 @@ import numpy as np
 CHILD_ENV = "EC_BENCH_CHILD"
 PROGRESS_ENV = "EC_BENCH_PROGRESS"
 DEGRADED_ENV = "EC_BENCH_DEGRADED"
+TRACE_OUT_ENV = "EC_BENCH_TRACE_OUT"      # --trace-out (child records spans)
+METRICS_OUT_ENV = "EC_BENCH_METRICS_OUT"  # --metrics-out (registry snapshot)
 
 PROBE_TIMEOUT_S = 150       # TPU init is ~20-40s healthy; a hang never ends
 CHILD_TIMEOUT_S = 900       # hard parent-side budget for the whole child
@@ -758,90 +768,49 @@ def _cache_scaled(kind_key: str, validators: int, floor: int = 1 << 17,
 
 
 def _phase_breakdown(fork: str, state, ctx, signed) -> dict:
-    """One instrumented transition on a warm state copy: accumulate time
-    inside the signature batch verify, the full-state hash_tree_root path,
-    and the committee machinery (shuffle/committee/proposer), and split
-    the wall between the slot advance and block application. Timer
-    overhead makes the phases sum slightly above the uninstrumented
-    ``block_s``; the split is for ATTRIBUTION (VERDICT next-round #1b) —
-    the headline number stays the uninstrumented run."""
+    """One recorded transition on a warm state copy, attributed from the
+    transition's OWN telemetry spans (models/transition.py + the fork
+    helpers emit transition.slot_advance/.block/.sig_batch/.state_htr/
+    .committees; telemetry/phases.py sums them and computes the
+    operations residual) — the same attribution any entry point gets by
+    recording a run, so this bench, the pipeline CLI, and the spec
+    harness all speak one phase vocabulary. Recording overhead makes
+    the phases sum slightly above the uninstrumented ``block_s``; the
+    split is for ATTRIBUTION (VERDICT next-round #1b) — the headline
+    number stays the uninstrumented run."""
     import importlib
 
-    from ethereum_consensus_tpu.crypto import bls
+    from ethereum_consensus_tpu.telemetry import phases as tel_phases
+    from ethereum_consensus_tpu.telemetry import spans as tel_spans
 
     st = importlib.import_module(
         f"ethereum_consensus_tpu.models.{fork}.state_transition"
     )
-    h = importlib.import_module(
-        f"ethereum_consensus_tpu.models.{fork}.helpers"
-    )
-    acc = {"sig_batch_s": 0.0, "state_htr_s": 0.0, "committee_s": 0.0}
-    nest = {"n": 0}  # committee helpers may call one another: outer only
 
-    def tally_outer(key, fn):
-        def wrapped(*args, **kwargs):
-            nest["n"] += 1
-            t0 = time.perf_counter()
-            try:
-                return fn(*args, **kwargs)
-            finally:
-                elapsed = time.perf_counter() - t0
-                nest["n"] -= 1
-                if nest["n"] == 0:
-                    acc[key] += elapsed
-        return wrapped
-
-    state_cls = type(state)
-    own_htr = state_cls.__dict__.get("hash_tree_root")
-    orig_state_htr = state_cls.hash_tree_root  # bound classmethod
-
-    def timed_state_htr(cls, value):
-        t0 = time.perf_counter()
-        try:
-            return orig_state_htr(value)
-        finally:
-            acc["state_htr_s"] += time.perf_counter() - t0
-
-    orig_verify = bls.verify_signature_sets
-    orig_committee = h.get_beacon_committee
-    orig_proposer = h.get_beacon_proposer_index
-    state_cls.hash_tree_root = classmethod(timed_state_htr)
-    bls.verify_signature_sets = tally_outer("sig_batch_s", orig_verify)
-    h.get_beacon_committee = tally_outer("committee_s", orig_committee)
-    h.get_beacon_proposer_index = tally_outer("committee_s", orig_proposer)
-    try:
+    def run_transition():
         s = state.copy()
-        t0 = time.perf_counter()
         st.process_slots(s, signed.message.slot, ctx)
-        slots_s = time.perf_counter() - t0
-        htr_in_slots = acc["state_htr_s"]
-        t0 = time.perf_counter()
         st.state_transition_block_in_slot(
             s, signed, st.Validation.ENABLED, ctx
         )
-        block_s = time.perf_counter() - t0
-    finally:
-        # hash_tree_root is normally inherited from Container: delete the
-        # shadow we installed (restoring any class-own definition)
-        if own_htr is None:
-            del state_cls.hash_tree_root
-        else:
-            state_cls.hash_tree_root = own_htr
-        bls.verify_signature_sets = orig_verify
-        h.get_beacon_committee = orig_committee
-        h.get_beacon_proposer_index = orig_proposer
-    total = slots_s + block_s
-    ops_s = total - sum(acc.values())
-    return {
-        "slot_advance_s": round(slots_s, 4),
-        "block_apply_s": round(block_s, 4),
-        "sig_batch_s": round(acc["sig_batch_s"], 4),
-        "state_htr_s": round(acc["state_htr_s"], 4),
-        "state_htr_in_slot_advance_s": round(htr_in_slots, 4),
-        "committee_s": round(acc["committee_s"], 4),
-        "operations_s": round(max(0.0, ops_s), 4),
-        "note": "instrumented run; headline block_s is uninstrumented",
-    }
+
+    rec = tel_spans.RECORDER
+    if rec.enabled:
+        # a bench-wide recording (--trace-out) is live: don't clobber its
+        # buffer — attribute over the spans this transition appends
+        before_id = max((r.span_id for r in rec.records()), default=0)
+        run_transition()
+        records = [r for r in rec.records() if r.span_id > before_id]
+    else:
+        with tel_spans.recording(capacity=1 << 17):
+            run_transition()
+            records = rec.records()
+    out = tel_phases.attribution(records)
+    out["note"] = (
+        "span-attributed instrumented run; headline block_s is "
+        "uninstrumented"
+    )
+    return out
 
 
 def _bench_mainnet_block(fork: str, validators: int, atts: int) -> dict:
@@ -1150,12 +1119,51 @@ def _child_elapsed() -> float:
     return 0.0 if _CHILD_T0 is None else time.monotonic() - _CHILD_T0
 
 
+def _metrics_block(before: dict) -> dict:
+    """Per-config delta of the telemetry registry: the WORK a config did
+    (digests, cache traffic, pairing routes, flush shape), not just its
+    seconds — so BENCH_*.json trajectories capture counters too."""
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    d = tel_metrics.delta(before)
+    hits = d.get("bls.pubkey_cache.hits", 0)
+    misses = d.get("bls.pubkey_cache.misses", 0)
+    out = {
+        "ssz_digests": d.get("ssz.digests", 0),
+        "pubkey_cache_hits": hits,
+        "pubkey_cache_misses": misses,
+        "pubkey_cache_hit_rate": (
+            round(hits / (hits + misses), 4) if (hits + misses) else None
+        ),
+        "pubkey_cache_evictions": d.get("bls.pubkey_cache.evictions", 0),
+        "warm_raw_keys_bulk_calls": d.get("bls.warm_raw_keys.calls", 0),
+        "warm_raw_keys_keys": d.get("bls.warm_raw_keys.keys", 0),
+        "pairing_route_device": d.get("bls.pairing_route.device", 0),
+        "pairing_route_host": d.get("bls.pairing_route.host", 0),
+    }
+    flush = d.get("pipeline.flush_size")
+    if isinstance(flush, dict) and flush.get("count"):
+        out["flushes"] = flush["count"]
+        out["mean_flush_size"] = round(flush["mean"], 2)
+        out["queue_depth_high_watermark"] = d.get(
+            "pipeline.queue_depth_high_watermark", 0
+        )
+    return out
+
+
 def child_main() -> None:
     global _CHILD_T0
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+    from ethereum_consensus_tpu.telemetry import spans as tel_spans
+    from ethereum_consensus_tpu.utils import trace
+
     progress_path = os.environ[PROGRESS_ENV]
     results: dict = {}
     t_start = time.monotonic()
     _CHILD_T0 = t_start
+    trace_out = os.environ.get(TRACE_OUT_ENV)
+    if trace_out:
+        tel_spans.start_recording(capacity=1 << 18)
 
     def checkpoint():
         tmp = progress_path + ".tmp"
@@ -1171,12 +1179,15 @@ def child_main() -> None:
             checkpoint()
             continue
         _note(f"config {name} starting ({elapsed:.0f}s elapsed)")
+        metrics_base = tel_metrics.snapshot()
         t0 = time.monotonic()
         try:
-            out = fn()
+            with trace.span("bench." + name):
+                out = fn()
         except Exception as exc:  # noqa: BLE001 — never lose the other configs
             out = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
         out["wall_s"] = round(time.monotonic() - t0, 2)
+        out["metrics"] = _metrics_block(metrics_base)
         results[name] = out
         checkpoint()
         _note(f"config {name} done in {out['wall_s']}s")
@@ -1189,6 +1200,21 @@ def child_main() -> None:
 
         gc.collect()
         gc.freeze()
+
+    # process-wide registry totals ride the progress file so the parent
+    # can surface them in the full dump even though the registry lives
+    # in this child process
+    results["process_metrics"] = tel_metrics.snapshot()
+    checkpoint()
+    if trace_out:
+        tel_spans.stop_recording()
+        tel_spans.write_chrome_trace(trace_out)
+        _note(f"chrome trace written: {trace_out}")
+    metrics_out = os.environ.get(METRICS_OUT_ENV)
+    if metrics_out:
+        with open(metrics_out, "w") as f:
+            json.dump(tel_metrics.snapshot(), f, indent=1, sort_keys=True)
+        _note(f"metrics snapshot written: {metrics_out}")
 
 
 # ---------------------------------------------------------------------------
@@ -1267,6 +1293,20 @@ def main() -> None:
         child_main()
         return
 
+    # telemetry export flags (docs/OBSERVABILITY.md): the bench work all
+    # happens in the child process, so the paths travel by env var
+    argv = sys.argv[1:]
+    for flag, env_key in (
+        ("--trace-out", TRACE_OUT_ENV),
+        ("--metrics-out", METRICS_OUT_ENV),
+    ):
+        if flag in argv:
+            at = argv.index(flag)
+            if at + 1 >= len(argv):
+                print(f"{flag} requires a path argument", file=sys.stderr)
+                sys.exit(2)
+            os.environ[env_key] = os.path.abspath(argv[at + 1])
+
     healthy, note, probe_transcript = probe_default_backend()
     _note(f"backend probe: healthy={healthy} ({note})")
 
@@ -1281,6 +1321,9 @@ def main() -> None:
 
         env = cpu_mesh_env(1, repo_root=REPO)
         env[DEGRADED_ENV] = note
+        for env_key in (TRACE_OUT_ENV, METRICS_OUT_ENV):
+            if os.environ.get(env_key):  # survive the hermetic scrub
+                env[env_key] = os.environ[env_key]
     env[CHILD_ENV] = "1"
     env[PROGRESS_ENV] = progress_path
 
@@ -1316,6 +1359,7 @@ def main() -> None:
             return round(obj, 4)
         return obj
 
+    process_metrics = configs.pop("process_metrics", None)
     htr = configs.pop("htr", None) or {}
     value = vs = 0.0
     error = None
@@ -1374,6 +1418,7 @@ def main() -> None:
             "backend_probe": note,
             "backend_probe_transcript": probe_transcript,
             "degraded": None if healthy else f"cpu fallback: {note}",
+            "metrics": process_metrics,
             "configs": configs,
         }
     )

@@ -35,6 +35,8 @@ from ..error import (
     InvalidSignatureError,
 )
 from ..native import bls as native_bls
+from ..telemetry import metrics as _metrics
+from ..utils import trace
 from .curves import (
     G1_GENERATOR,
     G1Point,
@@ -170,15 +172,34 @@ _RAW_PK_CACHE_MAX = 1 << 16
 # races into KeyError. Reads stay lock-free — dict get is atomic.
 _PK_CACHE_LOCK = threading.Lock()
 
+# registry counters (docs/OBSERVABILITY.md): a cache "hit" is a raw-form
+# lookup satisfied by _RAW_PK_CACHE, a "miss" is a lookup that fell
+# through to an actual per-key decompression (deferred registry parses
+# that stay cold are neither — their decompression is counted by the
+# warm_raw_keys bulk counters when it happens eight-wide).
+_CACHE_HITS = _metrics.counter("bls.pubkey_cache.hits")
+_CACHE_MISSES = _metrics.counter("bls.pubkey_cache.misses")
+_CACHE_INSERTS = _metrics.counter("bls.pubkey_cache.inserts")
+_CACHE_EVICTIONS = _metrics.counter("bls.pubkey_cache.evictions")
+_WARM_CALLS = _metrics.counter("bls.warm_raw_keys.calls")
+_WARM_KEYS = _metrics.counter("bls.warm_raw_keys.keys")
+_ROUTE_DEVICE = _metrics.counter("bls.pairing_route.device")
+_ROUTE_HOST = _metrics.counter("bls.pairing_route.host")
+
 
 def _pk_cache_put(data: bytes, raw: bytes) -> None:
     with _PK_CACHE_LOCK:
+        evicted = 0
         while len(_RAW_PK_CACHE) >= _RAW_PK_CACHE_MAX:
             try:
                 _RAW_PK_CACHE.pop(next(iter(_RAW_PK_CACHE)))
+                evicted += 1
             except (KeyError, StopIteration):  # pragma: no cover - defensive
                 break
         _RAW_PK_CACHE[data] = raw
+    _CACHE_INSERTS.inc()
+    if evicted:
+        _CACHE_EVICTIONS.inc(evicted)
 
 
 def warm_pubkey_cache(keys) -> None:
@@ -241,8 +262,10 @@ class PublicKey:
             data = self.to_bytes()
             hit = _RAW_PK_CACHE.get(data)
             if hit is not None:
+                _CACHE_HITS.inc()
                 self._raw = hit
                 return hit
+            _CACHE_MISSES.inc()
             rc, raw, is_inf = native_bls.g1_decompress(
                 data, check_subgroup=False
             )
@@ -281,6 +304,8 @@ class PublicKey:
             return cls.from_bytes(data)  # no lazy raw path in the oracle
         self = cls._from_valid_bytes(data)
         self._raw = _RAW_PK_CACHE.get(data)
+        if self._raw is not None:
+            _CACHE_HITS.inc()
         return self
 
     @classmethod
@@ -294,9 +319,11 @@ class PublicKey:
             cached_raw = _RAW_PK_CACHE.get(data)
             if cached_raw is not None:
                 # a cache hit was subgroup-checked when it entered
+                _CACHE_HITS.inc()
                 self = cls._from_valid_bytes(data)
                 self._raw = cached_raw
                 return self
+            _CACHE_MISSES.inc()
             rc, raw, is_inf = native_bls.g1_decompress(data, check_subgroup=True)
             if rc != 0:
                 raise InvalidPublicKeyError(native_bls.decode_error_message(rc))
@@ -453,12 +480,15 @@ def warm_raw_keys(public_keys) -> None:
             continue
         hit = _RAW_PK_CACHE.get(pk._bytes)
         if hit is not None:
+            _CACHE_HITS.inc()
             pk._raw = hit
             continue
         todo.setdefault(pk._bytes, []).append(pk)
     if len(todo) < 8:  # below the lane width there is nothing to win
         return
     keys = list(todo)
+    _WARM_CALLS.inc()
+    _WARM_KEYS.inc(len(keys))
     for rc_raw_inf, key in zip(
         native_bls.g1_decompress_batch(keys, check_subgroup=False), keys
     ):
@@ -692,10 +722,12 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
     if _device_flags.pairing_enabled(len(sets)):
         verdict = _batch_device_pairing(sets, dst, scalars)
         if verdict is not None:
+            _ROUTE_DEVICE.inc()
             return verdict
     # raw-affine pubkeys: decompressed once per key (cached on the
     # PublicKey — subgroup-checked at parse time), so repeat verifiers
     # (the same validators every block) never pay the sqrt again
+    _ROUTE_HOST.inc()
     return native_bls.batch_verify_raw(
         [([pk.raw_uncompressed() for pk in s.public_keys], s.message,
           s.signature.to_bytes()) for s in sets],
@@ -835,7 +867,10 @@ def verify_signature_sets_async(
 
         t0 = _time.perf_counter()
         try:
-            return verify_signature_sets(sets, dst)
+            # the span lands on the verifier thread's lane, so a recorded
+            # pipeline run shows stage B as its own Perfetto track
+            with trace.span("pipeline.flush.verify", sets=len(sets)):
+                return verify_signature_sets(sets, dst)
         finally:
             if timer is not None:
                 timer(_time.perf_counter() - t0)

@@ -20,6 +20,7 @@ from ...error import (
 from ...execution_engine import verify_and_notify_new_payload
 from ...primitives import BLS_WITHDRAWAL_PREFIX, ETH1_ADDRESS_WITHDRAWAL_PREFIX
 from ...signing import compute_signing_root
+from ...utils import trace
 from ..signature_batch import verify_or_defer
 from .. import _diff
 from ..altair import block_processing as _altair_bp
@@ -123,7 +124,16 @@ def process_execution_payload(state, body, context) -> None:
 def get_expected_withdrawals(state, context) -> list:
     """(block_processing.rs:348) — numpy sweep when the registry is big
     enough to matter, with the literal per-index loop as the fallback
-    (and the cross-checked oracle in tests)."""
+    (and the cross-checked oracle in tests). The span marks the
+    per-block registry sweep — the third named hot scan in the warm
+    deneb profile (ROADMAP)."""
+    with trace.span(
+        "capella.withdrawals_sweep", validators=len(state.validators)
+    ):
+        return _expected_withdrawals(state, context)
+
+
+def _expected_withdrawals(state, context) -> list:
     if len(state.validators) >= 256:
         hits = _sweep_hits_vectorized(state, context)
         if hits is not None:

@@ -32,6 +32,7 @@ from ...execution_engine import verify_and_notify_new_payload
 from ...primitives import FAR_FUTURE_EPOCH, UNSET_DEPOSIT_RECEIPTS_START_INDEX
 from ...signing import compute_signing_root, verify_signed_data
 from ...ssz import is_valid_merkle_branch
+from ...utils import trace
 from .. import _diff
 from ..signature_batch import verify_or_defer
 from ..altair.constants import (
@@ -91,6 +92,13 @@ FULL_EXIT_REQUEST_AMOUNT = 0  # (constants.rs:4)
 
 def get_expected_withdrawals(state, context) -> tuple[list, int]:
     """(block_processing.rs:33) → (withdrawals, partial_withdrawals_count)"""
+    with trace.span(
+        "electra.withdrawals_sweep", validators=len(state.validators)
+    ):
+        return _expected_withdrawals(state, context)
+
+
+def _expected_withdrawals(state, context) -> tuple[list, int]:
     epoch = h.get_current_epoch(state, context)
     withdrawal_index = state.next_withdrawal_index
     validator_index = state.next_withdrawal_validator_index

@@ -20,6 +20,7 @@ import hashlib
 from ... import _device_flags
 from ...crypto import bls
 from ...domains import DomainType
+from ...utils import trace
 from ...error import (
     InvalidIndexedAttestation,
     OutOfBoundsError,
@@ -346,9 +347,14 @@ def get_active_validator_indices(state, epoch: int) -> tuple[int, ...]:
             return hit
     else:
         cache = None  # legacy tuple form (pre-r5 pickles) or absent
-    out = tuple(
-        i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
-    )
+    # cache-miss full-registry sweep — the per-block hot scan the warm
+    # profile names (ROADMAP); the span shows exactly when it recomputes
+    with trace.span(
+        "helpers.active_indices_sweep", validators=len(state.validators)
+    ):
+        out = tuple(
+            i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)
+        )
     # REBIND a fresh dict rather than mutating in place: Container.copy()
     # shares the state __dict__ values, so an in-place insert would leak
     # a diverged copy's active set into the original (and vice versa) —
@@ -397,17 +403,18 @@ def get_committee_count_at_slot(state, slot: int, context) -> int:
 
 def get_beacon_committee(state, slot: int, index: int, context) -> list[int]:
     """(helpers.rs:775)"""
-    epoch = compute_epoch_at_slot(slot, context)
-    committees_per_slot = get_committee_count_per_slot(state, epoch, context)
-    indices = get_active_validator_indices(state, epoch)
-    seed = get_seed(state, epoch, DomainType.BEACON_ATTESTER, context)
-    return compute_committee(
-        indices,
-        seed,
-        (slot % context.SLOTS_PER_EPOCH) * committees_per_slot + index,
-        committees_per_slot * context.SLOTS_PER_EPOCH,
-        context,
-    )
+    with trace.span("transition.committees", kind="committee", slot=int(slot)):
+        epoch = compute_epoch_at_slot(slot, context)
+        committees_per_slot = get_committee_count_per_slot(state, epoch, context)
+        indices = get_active_validator_indices(state, epoch)
+        seed = get_seed(state, epoch, DomainType.BEACON_ATTESTER, context)
+        return compute_committee(
+            indices,
+            seed,
+            (slot % context.SLOTS_PER_EPOCH) * committees_per_slot + index,
+            committees_per_slot * context.SLOTS_PER_EPOCH,
+            context,
+        )
 
 
 def get_beacon_proposer_index(state, context) -> int:
@@ -421,14 +428,17 @@ def get_beacon_proposer_index(state, context) -> int:
     cached = state.__dict__.get("_proposer_cache")
     key = (int(state.slot), len(state.validators))
     if cached is not None and cached[0] == key:
+        # the cache-hit path stays span-free: the altair sync-aggregate
+        # reward loop takes it 512x per block and the hit is ~a dict get
         return cached[1]
-    epoch = get_current_epoch(state, context)
-    seed = _sha256(
-        get_seed(state, epoch, DomainType.BEACON_PROPOSER, context)
-        + int(state.slot).to_bytes(8, "little")
-    )
-    indices = get_active_validator_indices(state, epoch)
-    out = compute_proposer_index(state, indices, seed, context)
+    with trace.span("transition.committees", kind="proposer", slot=key[0]):
+        epoch = get_current_epoch(state, context)
+        seed = _sha256(
+            get_seed(state, epoch, DomainType.BEACON_PROPOSER, context)
+            + int(state.slot).to_bytes(8, "little")
+        )
+        indices = get_active_validator_indices(state, epoch)
+        out = compute_proposer_index(state, indices, seed, context)
     state.__dict__["_proposer_cache"] = (key, out)
     return out
 
@@ -460,9 +470,13 @@ def get_total_active_balance(state, context) -> int:
     cached = state.__dict__.get("_total_active_balance_cache")
     if cached is not None and cached[0] == key:
         return cached[1]
-    total = get_total_balance(
-        state, get_active_validator_indices(state, epoch), context
-    )
+    # cache-miss O(active-set) balance sum — the second named hot scan
+    with trace.span(
+        "helpers.total_balance_sweep", validators=len(state.validators)
+    ):
+        total = get_total_balance(
+            state, get_active_validator_indices(state, epoch), context
+        )
     state.__dict__["_total_active_balance_cache"] = (key, total)
     return total
 

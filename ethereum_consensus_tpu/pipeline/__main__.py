@@ -12,6 +12,15 @@ Two tiers, best available wins:
   scheduler + signature-window machinery directly with real BLS keys,
   including a tampered-set rollback-attribution check.
 
+Telemetry exports (docs/OBSERVABILITY.md):
+
+* ``--trace-out PATH``   — record every span/event of the selfcheck and
+  write a Chrome trace-event JSON (Perfetto / ``chrome://tracing``):
+  stage A and the background verifier render as separate tracks with
+  flush dispatch/verify/settle windows and rollbacks visible.
+* ``--metrics-out PATH`` — dump the process-wide metrics registry
+  snapshot (digests, pubkey-cache hit rates, flush shapes, ...) as JSON.
+
 Exit code 0 = all checks passed; any failure prints the reason and
 exits 1.
 """
@@ -135,10 +144,25 @@ def _selfcheck_window() -> None:
     )
 
 
+def _flag_value(argv: "list[str]", flag: str) -> "str | None":
+    if flag in argv:
+        at = argv.index(flag)
+        if at + 1 >= len(argv):
+            raise SystemExit(f"{flag} requires a path argument")
+        return argv[at + 1]
+    return None
+
+
 def main(argv: "list[str]") -> int:
+    trace_out = _flag_value(argv, "--trace-out")
+    metrics_out = _flag_value(argv, "--metrics-out")
     if "--selfcheck" not in argv:
         print(__doc__)
         return 2
+    from ..telemetry import metrics, spans
+
+    if trace_out:
+        spans.start_recording()
     try:
         if _find_chain_utils():
             _selfcheck_chain()
@@ -146,6 +170,17 @@ def main(argv: "list[str]") -> int:
     except Exception as exc:  # noqa: BLE001 — smoke must report, not crash
         print(f"SELFCHECK FAILED: {type(exc).__name__}: {exc}")
         return 1
+    finally:
+        if trace_out:
+            spans.stop_recording()
+            spans.write_chrome_trace(trace_out)
+            print(f"chrome trace written: {trace_out}")
+        if metrics_out:
+            import json
+
+            with open(metrics_out, "w", encoding="utf-8") as f:
+                json.dump(metrics.snapshot(), f, indent=1, sort_keys=True)
+            print(f"metrics snapshot written: {metrics_out}")
     print("selfcheck OK")
     return 0
 

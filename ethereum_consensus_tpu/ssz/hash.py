@@ -13,6 +13,8 @@ from __future__ import annotations
 import hashlib
 from typing import Callable
 
+from ..telemetry import metrics as _metrics
+
 __all__ = [
     "hash_bytes",
     "hash_pair",
@@ -35,32 +37,36 @@ __all__ = [
 # to assert WORK DONE, not just wall time: the incremental-HTR regression
 # test pins "one validator edit == one 4096-leaf group + the log-depth
 # path", which wall-clock alone can't prove.
-_digest_count = 0
+#
+# The count lives in the process-wide telemetry registry (one locked
+# Counter) because the chain pipeline hashes from BOTH threads at once —
+# stage A's incremental HTR and the stage-B verifier's committed-state
+# replays — and the previous unlocked module-global increment could drop
+# updates under that interleaving. digest_count()/add_digests() stay as
+# thin compatibility shims over the registry metric.
+_DIGESTS = _metrics.counter("ssz.digests")
 
 
 def digest_count() -> int:
     """Total digests computed so far (read a delta around the op under test)."""
-    return _digest_count
+    return _DIGESTS.value()
 
 
 def add_digests(n: int) -> None:
     """Record ``n`` digests computed outside the per-call wrappers (native
     whole-tree reductions, device dispatches)."""
-    global _digest_count
-    _digest_count += n
+    _DIGESTS.inc(n)
 
 
 def hash_bytes(data: bytes) -> bytes:
     """SHA-256 of arbitrary bytes (host)."""
-    global _digest_count
-    _digest_count += 1
+    _DIGESTS.inc()
     return hashlib.sha256(data).digest()
 
 
 def hash_pair(left: bytes, right: bytes) -> bytes:
     """SHA-256 of the 64-byte concatenation of two 32-byte nodes."""
-    global _digest_count
-    _digest_count += 1
+    _DIGESTS.inc()
     return hashlib.sha256(left + right).digest()
 
 
@@ -114,9 +120,9 @@ _native_attempted = False
 def hash_level(nodes: bytes) -> bytes:
     """Hash one merkle level, routing to the fastest registered backend:
     device for huge levels, native C++ for medium, hashlib otherwise."""
-    global _native_attempted, _digest_count
+    global _native_attempted
     n = len(nodes) // 64
-    _digest_count += n
+    _DIGESTS.inc(n)
     if _device_hasher is not None and n >= DEVICE_MIN_NODES:
         return _device_hasher(nodes)
     if (

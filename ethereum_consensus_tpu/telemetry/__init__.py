@@ -1,0 +1,24 @@
+"""Unified telemetry: structured spans + process-wide metrics.
+
+Two stdlib-only submodules (importable from any layer, including the
+pure-host ``ssz``/``crypto`` paths — nothing here touches jax):
+
+* ``spans``   — the in-process ring-buffer span recorder behind the
+  ``utils/trace.py`` facade, with Chrome trace-event JSON export
+  (Perfetto / ``chrome://tracing``). Off by default; near-zero cost
+  while off.
+* ``metrics`` — the process-wide counter/gauge/histogram registry with
+  snapshot/delta semantics; the one home for operational counters
+  (``ssz.digests``, ``bls.pubkey_cache.*``, ``pipeline.*``, ...).
+* ``phases``  — derives the bench's per-block phase attribution
+  (sig batch / state HTR / committees / operations) from recorded
+  transition spans.
+
+Conventions and export formats: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from . import metrics, phases, spans
+
+__all__ = ["metrics", "phases", "spans"]

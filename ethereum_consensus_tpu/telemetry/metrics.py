@@ -1,0 +1,227 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+One lock-disciplined home for every operational counter the codebase
+used to keep as ad-hoc module globals — the ``ssz/hash.py`` digest
+count (previously an unlocked ``global`` incremented from both pipeline
+threads), the ``crypto/bls.py`` pubkey-cache hits/misses/evictions and
+bulk-decompress counts, the pairing-route decisions, and the
+``pipeline.*`` counters ``PipelineStats`` views.
+
+Semantics:
+
+* **get-or-create by name** — ``counter(name)`` / ``gauge(name)`` /
+  ``histogram(name)`` return the one process-wide instance for that
+  name (double-checked under the registry lock); asking for an existing
+  name with a different kind raises.
+* **lock discipline** (speclint-checked) — every mutation holds the
+  metric's own lock; reads are lock-free (a Python int/float load is
+  atomic under the GIL). Counters are monotonic, so readers see a value
+  that was true at some instant — exactly what delta arithmetic needs.
+* **snapshot/delta** — ``snapshot()`` is a JSON-ready plain dict of
+  every registered metric; ``delta(before, after)`` subtracts two
+  snapshots (counters and histogram count/sum subtract; gauges report
+  the ``after`` value — they are levels, not totals).
+
+Naming convention (docs/OBSERVABILITY.md): dotted lowercase paths,
+``<subsystem>.<object>.<what>`` — e.g. ``ssz.digests``,
+``bls.pubkey_cache.hits``, ``pipeline.flushes``. Seconds-valued
+counters end in ``_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "counter",
+    "gauge",
+    "histogram",
+    "registered",
+    "snapshot",
+    "delta",
+]
+
+
+class Counter:
+    """Monotonic total (int or float increments)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def value(self):
+        return self._value
+
+    def __repr__(self) -> str:
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """A level: last-set value, plus a high-watermark helper."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    def update_max(self, v) -> None:
+        """Raise the gauge to ``v`` if larger (queue-depth high-watermark
+        semantics)."""
+        with self._lock:
+            if v > self._value:
+                self._value = v
+
+    def value(self):
+        return self._value
+
+    def __repr__(self) -> str:
+        return f"Gauge({self.name}={self._value})"
+
+
+class Histogram:
+    """Counted observations with exact count/sum/min/max and a bounded
+    sample of raw values (the newest ``sample_limit`` observations) —
+    meant for low-rate shapes like flush sizes, not per-digest rates."""
+
+    __slots__ = ("name", "_lock", "_count", "_sum", "_min", "_max", "_values",
+                 "sample_limit")
+
+    def __init__(self, name: str, sample_limit: int = 1 << 12):
+        self.name = name
+        self.sample_limit = sample_limit
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0
+        self._min = None
+        self._max = None
+        self._values: list = []
+
+    def observe(self, v) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+            self._values.append(v)
+            if len(self._values) > self.sample_limit:
+                del self._values[: len(self._values) - self.sample_limit]
+
+    def summary(self) -> dict:
+        with self._lock:
+            count, total = self._count, self._sum
+            lo, hi = self._min, self._max
+        return {
+            "count": count,
+            "sum": total,
+            "min": lo,
+            "max": hi,
+            "mean": (total / count) if count else None,
+        }
+
+    def values(self) -> list:
+        """The newest observations (up to ``sample_limit``), oldest first."""
+        with self._lock:
+            return list(self._values)
+
+    def __repr__(self) -> str:
+        return f"Histogram({self.name}, n={self._count})"
+
+
+# -- the process-wide registry ------------------------------------------------
+
+_REGISTRY: dict = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _get_or_create(name: str, kind):
+    metric = _REGISTRY.get(name)
+    if metric is None:
+        with _REGISTRY_LOCK:
+            metric = _REGISTRY.get(name)
+            if metric is None:
+                metric = kind(name)
+                _REGISTRY[name] = metric
+    if not isinstance(metric, kind):
+        raise TypeError(
+            f"metric {name!r} is a {type(metric).__name__}, "
+            f"not a {kind.__name__}"
+        )
+    return metric
+
+
+def counter(name: str) -> Counter:
+    """The process-wide counter named ``name`` (created on first use)."""
+    return _get_or_create(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get_or_create(name, Gauge)
+
+
+def histogram(name: str) -> Histogram:
+    return _get_or_create(name, Histogram)
+
+
+def registered() -> "list[str]":
+    """Registered metric names, sorted."""
+    return sorted(_REGISTRY)
+
+
+def snapshot() -> dict:
+    """JSON-ready ``{name: value}`` of every registered metric (histograms
+    report their ``summary()`` dict). Consistent per metric, not across
+    metrics — fine for monotonic-counter deltas."""
+    out = {}
+    for name in sorted(_REGISTRY):
+        metric = _REGISTRY[name]
+        if isinstance(metric, Histogram):
+            out[name] = metric.summary()
+        else:
+            out[name] = metric.value()
+    return out
+
+
+def delta(before: dict, after: "dict | None" = None) -> dict:
+    """``after - before`` over two snapshots (``after`` defaults to a
+    fresh ``snapshot()``). Counters subtract; histogram ``count``/``sum``
+    subtract (``min``/``max``/``mean`` describe the after-window only in
+    mean's case, so the delta reports count/sum/mean-of-window); gauges
+    are levels and report the ``after`` value. Metrics absent from
+    ``before`` count from zero."""
+    if after is None:
+        after = snapshot()
+    out = {}
+    for name, now in after.items():
+        prev = before.get(name)
+        if isinstance(now, dict):  # histogram summary
+            prev = prev if isinstance(prev, dict) else {}
+            count = now.get("count", 0) - prev.get("count", 0)
+            total = (now.get("sum") or 0) - (prev.get("sum") or 0)
+            out[name] = {
+                "count": count,
+                "sum": total,
+                "mean": (total / count) if count else None,
+            }
+        elif isinstance(_REGISTRY.get(name), Gauge):
+            out[name] = now
+        else:
+            out[name] = now - (prev if isinstance(prev, (int, float)) else 0)
+    return out

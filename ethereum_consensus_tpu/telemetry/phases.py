@@ -1,0 +1,80 @@
+"""Transition phase attribution from recorded spans.
+
+The per-block cost split the ROADMAP quotes — signature batch / state
+HTR / committees / operations — used to be computed by bench-local
+monkeypatching inside ``bench.py``. It now derives from the named spans
+the transition itself emits (``models/transition.py`` +
+``models/phase0/helpers.py``), so ANY entry point that records a run —
+bench, the pipeline CLI, the spec harness — attributes the same way.
+
+Span name contract (docs/OBSERVABILITY.md):
+
+* ``transition.slot_advance`` — one per ``process_slots`` call;
+* ``transition.block``        — one per block-in-slot application;
+* ``transition.sig_batch``    — the batched signature verification
+  (≈ 0 under the pipeline's ``defer_flushes``: the work moved to the
+  stage-B ``pipeline.flush.verify`` span);
+* ``transition.state_htr``    — every full-state hash_tree_root (the
+  per-slot root memo and the state-root check);
+* ``transition.committees``   — committee/proposer machinery
+  (``get_beacon_committee`` bodies, proposer-index cache misses).
+
+``operations`` is everything else inside the transition:
+``slot_advance + block − sig_batch − state_htr − committees`` — the same
+residual definition the old bench plumbing used, so BENCH_*.json
+trajectories stay comparable across the migration.
+"""
+
+from __future__ import annotations
+
+__all__ = ["PHASE_SPANS", "attribution"]
+
+PHASE_SPANS = {
+    "slot_advance": "transition.slot_advance",
+    "block": "transition.block",
+    "sig_batch": "transition.sig_batch",
+    "state_htr": "transition.state_htr",
+    "committees": "transition.committees",
+}
+
+
+def _total(records, name: str) -> float:
+    return sum(r.duration_s for r in records if r.name == name)
+
+
+def attribution(records) -> dict:
+    """Phase seconds from a list of ``SpanRecord``s (one or more recorded
+    transitions). Returns the bench ``phases`` dict shape."""
+    by_id = {r.span_id: r for r in records}
+
+    def has_ancestor(rec, name: str) -> bool:
+        seen = 0
+        parent = by_id.get(rec.parent_id)
+        while parent is not None and seen < 64:
+            if parent.name == name:
+                return True
+            parent = by_id.get(parent.parent_id)
+            seen += 1
+        return False
+
+    slots_s = _total(records, PHASE_SPANS["slot_advance"])
+    block_s = _total(records, PHASE_SPANS["block"])
+    sig_s = _total(records, PHASE_SPANS["sig_batch"])
+    htr_s = _total(records, PHASE_SPANS["state_htr"])
+    committee_s = _total(records, PHASE_SPANS["committees"])
+    htr_in_slots = sum(
+        r.duration_s
+        for r in records
+        if r.name == PHASE_SPANS["state_htr"]
+        and has_ancestor(r, PHASE_SPANS["slot_advance"])
+    )
+    ops_s = (slots_s + block_s) - (sig_s + htr_s + committee_s)
+    return {
+        "slot_advance_s": round(slots_s, 4),
+        "block_apply_s": round(block_s, 4),
+        "sig_batch_s": round(sig_s, 4),
+        "state_htr_s": round(htr_s, 4),
+        "state_htr_in_slot_advance_s": round(htr_in_slots, 4),
+        "committee_s": round(committee_s, 4),
+        "operations_s": round(max(0.0, ops_s), 4),
+    }

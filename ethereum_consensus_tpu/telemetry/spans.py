@@ -1,0 +1,303 @@
+"""Structured span recorder: thread-aware ring buffer + Chrome-trace export.
+
+The tracing facade (``utils/trace.py``) stays the only API call sites
+use; this module is the recording sink behind it. When recording is off
+(the default) the facade never calls in here beyond one attribute read,
+so the disabled path costs nothing measurable (guarded by
+tests/test_telemetry.py's overhead test).
+
+When recording is on, every ``trace.span`` exit appends one fixed-size
+record — name, thread lane, parent span, start/end ``perf_counter``
+stamps, the call site's fields, the error repr if the body raised — into
+a bounded ``deque`` (oldest spans drop first; spans-in-progress live
+only on a per-thread stack). ``chrome_trace()`` renders the buffer as
+Chrome trace-event JSON (the ``{"traceEvents": [...]}`` flavor), loadable
+in Perfetto / ``chrome://tracing``: each recording thread becomes one
+``tid`` lane with its Python thread name as metadata, spans are ``"X"``
+complete events in microseconds, point events are ``"i"`` instants. A
+pipelined replay therefore renders stage A (the submitting thread) and
+the background verifier as separate tracks, with flush dispatch/settle/
+verify windows and rollbacks visible.
+
+Thread lanes are small sequential ints (0 = first thread to record, in
+practice the main thread) rather than raw ``threading.get_ident()``
+values, so the Perfetto track list stays readable; the real ident is
+kept in the thread-name metadata.
+
+Lock discipline (speclint-checked): every write to the recorder's shared
+structures holds ``self._lock``; the hot ``enabled`` read and the
+per-thread span stack (``threading.local``) stay lock-free.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+__all__ = [
+    "SpanRecord",
+    "SpanRecorder",
+    "RECORDER",
+    "DEFAULT_CAPACITY",
+    "is_recording",
+    "start_recording",
+    "stop_recording",
+    "recording",
+    "write_chrome_trace",
+]
+
+DEFAULT_CAPACITY = 1 << 16
+
+
+class SpanRecord:
+    """One completed span (or, transiently, one in progress on its
+    thread's stack). ``parent_id`` is 0 for top-level spans; parents are
+    resolved per thread at begin time, so cross-thread work (the
+    verifier) starts its own tree."""
+
+    __slots__ = (
+        "span_id",
+        "parent_id",
+        "name",
+        "lane",
+        "t0",
+        "t1",
+        "fields",
+        "error",
+    )
+
+    def __init__(self, span_id: int, parent_id: int, name: str, lane: int,
+                 t0: float, fields: dict):
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.lane = lane
+        self.t0 = t0
+        self.t1 = t0
+        self.fields = fields
+        self.error = None
+
+    @property
+    def duration_s(self) -> float:
+        return max(0.0, self.t1 - self.t0)
+
+
+class _EventRecord:
+    __slots__ = ("name", "lane", "ts", "fields")
+
+    def __init__(self, name: str, lane: int, ts: float, fields: dict):
+        self.name = name
+        self.lane = lane
+        self.ts = ts
+        self.fields = fields
+
+
+def _json_safe(value):
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    return str(value)
+
+
+class SpanRecorder:
+    """In-process ring-buffer recorder; one module-level instance
+    (``RECORDER``) serves the whole process."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._spans: deque = deque(maxlen=capacity)
+        self._events: deque = deque(maxlen=capacity)
+        self._lanes: dict = {}        # thread ident -> small lane int
+        self._lane_names: dict = {}   # lane int -> thread name
+        self._tls = threading.local()
+        self._ids = itertools.count(1)
+        self._t0 = 0.0                # perf_counter origin of the recording
+        self._wall0 = 0.0             # wall-clock at start (metadata only)
+        self.enabled = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, capacity: "int | None" = None) -> None:
+        """Begin a fresh recording (drops any previous buffer)."""
+        with self._lock:
+            if capacity is not None and capacity != self._capacity:
+                self._capacity = capacity
+                self._spans = deque(maxlen=capacity)
+                self._events = deque(maxlen=capacity)
+            else:
+                self._spans.clear()
+                self._events.clear()
+            self._lanes.clear()
+            self._lane_names.clear()
+            self._t0 = time.perf_counter()
+            self._wall0 = time.time()
+            self.enabled = True
+
+    def stop(self) -> None:
+        with self._lock:
+            self.enabled = False
+
+    # -- recording (called from the trace facade) ---------------------------
+    def _lane(self) -> int:
+        ident = threading.get_ident()
+        lane = self._lanes.get(ident)
+        if lane is None:
+            with self._lock:
+                lane = self._lanes.get(ident)
+                if lane is None:
+                    lane = len(self._lanes)
+                    self._lanes[ident] = lane
+                    self._lane_names[lane] = (
+                        f"{threading.current_thread().name} ({ident})"
+                    )
+        return lane
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def begin(self, name: str, fields: dict) -> SpanRecord:
+        stack = self._stack()
+        rec = SpanRecord(
+            span_id=next(self._ids),
+            parent_id=stack[-1].span_id if stack else 0,
+            name=name,
+            lane=self._lane(),
+            t0=time.perf_counter(),
+            fields=fields,
+        )
+        stack.append(rec)
+        return rec
+
+    def end(self, rec: SpanRecord, error: "str | None" = None) -> None:
+        rec.t1 = time.perf_counter()
+        rec.error = error
+        stack = self._stack()
+        # the facade pairs begin/end via try/finally, so rec is the top;
+        # remove by identity anyway in case a caller misnests
+        if stack and stack[-1] is rec:
+            stack.pop()
+        else:  # pragma: no cover - defensive
+            try:
+                stack.remove(rec)
+            except ValueError:
+                pass
+        with self._lock:
+            self._spans.append(rec)
+
+    def event(self, name: str, fields: dict) -> None:
+        rec = _EventRecord(name, self._lane(), time.perf_counter(), fields)
+        with self._lock:
+            self._events.append(rec)
+
+    # -- reading -------------------------------------------------------------
+    def records(self) -> "list[SpanRecord]":
+        """Completed spans, consistent copy (any order; sort by ``t0``)."""
+        with self._lock:
+            return list(self._spans)
+
+    def chrome_trace(self) -> dict:
+        """The buffer as a Chrome trace-event JSON document
+        (Perfetto / ``chrome://tracing`` loadable). Timestamps are
+        microseconds relative to the recording start, strictly
+        non-negative and monotonic per the ``perf_counter`` clock."""
+        with self._lock:
+            spans = sorted(self._spans, key=lambda r: r.t0)
+            events = sorted(self._events, key=lambda r: r.ts)
+            lane_names = dict(self._lane_names)
+            t0 = self._t0
+            wall0 = self._wall0
+        pid = os.getpid()
+        out = [
+            {
+                "ph": "M",
+                "pid": pid,
+                "name": "process_name",
+                "args": {"name": "ethereum_consensus_tpu"},
+            }
+        ]
+        for lane in sorted(lane_names):
+            out.append(
+                {
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": lane,
+                    "name": "thread_name",
+                    "args": {"name": lane_names[lane]},
+                }
+            )
+        for rec in spans:
+            args = {k: _json_safe(v) for k, v in rec.fields.items()}
+            args["span_id"] = rec.span_id
+            if rec.parent_id:
+                args["parent_id"] = rec.parent_id
+            if rec.error is not None:
+                args["error"] = rec.error
+            out.append(
+                {
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": rec.lane,
+                    "name": rec.name,
+                    "cat": rec.name.split(".", 1)[0],
+                    "ts": max(0.0, (rec.t0 - t0) * 1e6),
+                    "dur": max(0.0, (rec.t1 - rec.t0) * 1e6),
+                    "args": args,
+                }
+            )
+        for rec in events:
+            out.append(
+                {
+                    "ph": "i",
+                    "s": "t",
+                    "pid": pid,
+                    "tid": rec.lane,
+                    "name": rec.name,
+                    "cat": rec.name.split(".", 1)[0],
+                    "ts": max(0.0, (rec.ts - t0) * 1e6),
+                    "args": {k: _json_safe(v) for k, v in rec.fields.items()},
+                }
+            )
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {"recordingStartUnixTime": wall0},
+        }
+
+
+RECORDER = SpanRecorder()
+
+
+def is_recording() -> bool:
+    return RECORDER.enabled
+
+
+def start_recording(capacity: "int | None" = None) -> None:
+    RECORDER.start(capacity)
+
+
+def stop_recording() -> None:
+    RECORDER.stop()
+
+
+@contextmanager
+def recording(capacity: "int | None" = None):
+    """Record spans for the duration of the block; yields ``RECORDER``."""
+    RECORDER.start(capacity)
+    try:
+        yield RECORDER
+    finally:
+        RECORDER.stop()
+
+
+def write_chrome_trace(path: str) -> None:
+    """Serialize the current buffer as Chrome trace JSON at ``path``."""
+    doc = RECORDER.chrome_trace()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)

@@ -2,9 +2,20 @@
 
 Reference parity: the reference pulls in the `tracing` crate as a facade in
 its API client (beacon-api-client/Cargo.toml:21, examples/sse.rs:4-20); the
-core library emits nothing. Here the same role is played on top of stdlib
-``logging``: cheap structured spans and events that are silent unless the
-application installs a handler (``basic_setup`` for the examples/CLIs).
+core library emits nothing. Here the same facade fans out to two sinks:
+
+* the **logging sink** (stdlib ``logging``, silent unless the application
+  installs a handler — ``basic_setup`` for the examples/CLIs), exactly the
+  pre-telemetry behavior, so every existing ``span``/``event`` call site
+  works unchanged;
+* the **span recorder** (``telemetry/spans.py``), an in-process ring
+  buffer with Chrome-trace export, active only between
+  ``telemetry.spans.start_recording()``/``stop_recording()``.
+
+When neither sink is active (the default), ``span`` takes a fast path
+that does no formatting, no recording, and no timestamp bookkeeping
+beyond one ``perf_counter`` read kept for the error log — the disabled
+cost is guarded by tests/test_telemetry.py's overhead test.
 
 Usage::
 
@@ -17,13 +28,18 @@ Usage::
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from contextlib import contextmanager
+
+from ..telemetry import spans as _spans
 
 __all__ = ["logger", "span", "event", "basic_setup"]
 
 logger = logging.getLogger("ethereum_consensus_tpu")
 logger.addHandler(logging.NullHandler())
+
+_RECORDER = _spans.RECORDER
 
 
 def _fmt_fields(fields: dict) -> str:
@@ -32,8 +48,25 @@ def _fmt_fields(fields: dict) -> str:
 
 @contextmanager
 def span(name: str, **fields):
-    """A timed span: DEBUG on enter, INFO with elapsed ms on exit, ERROR
-    (with the exception) if the body raises."""
+    """A timed span, delivered to every active sink: the logging sink
+    (DEBUG on enter, INFO with elapsed ms on exit, ERROR with the
+    exception if the body raises) and, while recording, the telemetry
+    span recorder (thread lane, parent span, wall window, fields)."""
+    if not (_RECORDER.enabled or logger.isEnabledFor(logging.INFO)):
+        # disabled fast path: no sink wants enter/exit; keep only the
+        # error log the always-on path would emit
+        start = time.perf_counter()
+        try:
+            yield
+        except Exception as exc:
+            logger.error(
+                "abort %s %s error=%r elapsed_ms=%.2f",
+                name, _fmt_fields(fields), exc,
+                (time.perf_counter() - start) * 1e3,
+            )
+            raise
+        return
+    rec = _RECORDER.begin(name, fields) if _RECORDER.enabled else None
     if logger.isEnabledFor(logging.DEBUG):
         logger.debug("enter %s %s", name, _fmt_fields(fields))
     start = time.perf_counter()
@@ -45,6 +78,8 @@ def span(name: str, **fields):
             name, _fmt_fields(fields), exc,
             (time.perf_counter() - start) * 1e3,
         )
+        if rec is not None:
+            _RECORDER.end(rec, error=repr(exc))
         raise
     else:
         if logger.isEnabledFor(logging.INFO):
@@ -52,20 +87,34 @@ def span(name: str, **fields):
                 "exit %s %s elapsed_ms=%.2f",
                 name, _fmt_fields(fields), (time.perf_counter() - start) * 1e3,
             )
+        if rec is not None:
+            _RECORDER.end(rec)
 
 
 def event(name: str, **fields) -> None:
-    """A point-in-time structured event at INFO."""
+    """A point-in-time structured event, delivered to every active sink."""
+    if _RECORDER.enabled:
+        _RECORDER.event(name, fields)
     if logger.isEnabledFor(logging.INFO):
         logger.info("%s %s", name, _fmt_fields(fields))
 
 
+_BASIC_HANDLER: "logging.Handler | None" = None
+_BASIC_SETUP_LOCK = threading.Lock()
+
+
 def basic_setup(level: int = logging.INFO) -> None:
     """Install a stderr handler (the examples' tracing_subscriber
-    equivalent, reference examples/sse.rs:20)."""
-    handler = logging.StreamHandler()
-    handler.setFormatter(
-        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
-    )
-    logger.addHandler(handler)
-    logger.setLevel(level)
+    equivalent, reference examples/sse.rs:20). Idempotent: repeated
+    calls adjust the level instead of stacking duplicate handlers
+    (which double-printed every event)."""
+    global _BASIC_HANDLER
+    with _BASIC_SETUP_LOCK:
+        if _BASIC_HANDLER is None:
+            handler = logging.StreamHandler()
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+            )
+            logger.addHandler(handler)
+            _BASIC_HANDLER = handler
+        logger.setLevel(level)

@@ -348,17 +348,65 @@ def test_raise_if_any_invalid_bypasses_sink():
 
 
 # ---------------------------------------------------------------------------
-# smoke entry point
+# telemetry: a pipelined run's trace shows both stages on distinct threads
 # ---------------------------------------------------------------------------
 
 
-def test_selfcheck_entry_point():
+def test_pipeline_trace_stage_a_and_stage_b_on_distinct_threads():
+    """A recorded pipelined replay must carry pipeline.stage_a spans on
+    the submitting thread and pipeline.flush.verify spans on the
+    background verifier's own lane — the two-track Perfetto view the
+    telemetry tentpole promises."""
+    from ethereum_consensus_tpu.telemetry import spans
+
+    state, ctx, blocks = produce_multi_fork_chain(64)
+    executor = Executor(state.copy(), ctx)
+    with spans.recording():
+        stats = executor.stream(
+            blocks, policy=FlushPolicy(window_size=3, max_in_flight=2)
+        )
+        doc = spans.RECORDER.chrome_trace()
+    assert stats.rollbacks == 0
+
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    stage_a_tids = {e["tid"] for e in complete if e["name"] == "pipeline.stage_a"}
+    verify_tids = {
+        e["tid"] for e in complete if e["name"] == "pipeline.flush.verify"
+    }
+    settle = [e for e in complete if e["name"] == "pipeline.flush.settle"]
+    assert stage_a_tids, "no stage-A spans recorded"
+    assert verify_tids, "no stage-B verify spans recorded"
+    assert stage_a_tids.isdisjoint(verify_tids), (
+        "stage A and the background verifier must record on distinct tid "
+        f"lanes, got A={stage_a_tids} B={verify_tids}"
+    )
+    assert settle, "no flush settle spans recorded"
+    # phase spans ride along per block inside stage A
+    names = {e["name"] for e in complete}
+    assert {
+        "transition.sig_batch",
+        "transition.state_htr",
+        "transition.committees",
+        "transition.operations",
+    } <= names
+
+
+# ---------------------------------------------------------------------------
+# smoke entry point (+ the --trace-out acceptance shape)
+# ---------------------------------------------------------------------------
+
+
+def test_selfcheck_entry_point_writes_acceptance_trace(tmp_path):
+    import json
     import os
     import subprocess
 
+    trace_path = tmp_path / "pipe.json"
+    metrics_path = tmp_path / "metrics.json"
     proc = subprocess.run(
         [sys.executable, "-m", "ethereum_consensus_tpu.pipeline",
-         "--selfcheck"],
+         "--selfcheck", "--trace-out", str(trace_path),
+         "--metrics-out", str(metrics_path)],
         capture_output=True,
         text=True,
         timeout=570,
@@ -367,6 +415,31 @@ def test_selfcheck_entry_point():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "selfcheck OK" in proc.stdout
+
+    # the ISSUE acceptance shape: valid Chrome-trace JSON, stage_a +
+    # flush/settle spans over >= 2 distinct tids, four phase spans per
+    # block
+    doc = json.loads(trace_path.read_text())
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {}
+    for e in complete:
+        names.setdefault(e["name"], []).append(e)
+    assert "pipeline.stage_a" in names
+    assert "pipeline.flush.verify" in names and "pipeline.flush.settle" in names
+    span_tids = {e["tid"] for e in complete}
+    assert len(span_tids) >= 2
+    assert {e["tid"] for e in names["pipeline.stage_a"]}.isdisjoint(
+        {e["tid"] for e in names["pipeline.flush.verify"]}
+    )
+    n_blocks = 6  # the chain tier's pipelined replay
+    for phase in ("transition.sig_batch", "transition.state_htr",
+                  "transition.committees", "transition.operations"):
+        assert len(names.get(phase, [])) >= n_blocks, phase
+
+    # the metrics dump carries the migrated counters
+    snap = json.loads(metrics_path.read_text())
+    assert snap["ssz.digests"] > 0
+    assert snap["pipeline.flushes"] > 0
 
 
 # ---------------------------------------------------------------------------

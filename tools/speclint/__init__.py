@@ -10,8 +10,9 @@ Three analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
                    surface ``ssz/core.py`` manifests, or incremental
                    hash_tree_root serves stale roots.
 * ``concurrency``— shared mutable state in ``pipeline/`` +
-                   ``crypto/bls.py`` must be lock-dominated; bare
-                   threading primitives outside the blessed set flag.
+                   ``telemetry/`` + ``crypto/bls.py`` + the trace
+                   facade must be lock-dominated; bare threading
+                   primitives outside the blessed set flag.
 
 Run: ``python -m tools.speclint [--format text|json] [paths...]`` — or
 through the tier-1 gate ``tests/test_speclint.py`` (zero non-allowlisted
@@ -49,7 +50,9 @@ def _default_targets(root: str) -> dict:
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
+            os.path.join(root, _PKG, "telemetry"),
             os.path.join(root, _PKG, "crypto", "bls.py"),
+            os.path.join(root, _PKG, "utils", "trace.py"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
