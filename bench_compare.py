@@ -1,0 +1,133 @@
+"""Diff two bench evidence files per phase/metric — regressions in one
+command.
+
+    python bench_compare.py BENCH_A.json BENCH_B.json [--threshold 0.05]
+    make bench-diff A=BENCH_A.json B=BENCH_B.json
+
+Accepts ``BENCH_FULL.json``-shaped files (a ``configs`` dict, as written
+next to bench.py) or a bare per-config dict. Every numeric leaf shared
+by both files is compared; seconds-like keys (``*_s``, ``*_s_per_*``)
+are flagged as REGRESSED/IMPROVED beyond the threshold, with the
+``phases`` split (sig batch / state HTR / committees / operations —
+docs/OBSERVABILITY.md) listed first so an operations-term regression is
+the first line you read, not bench archaeology. Exit status 1 when any
+seconds-like metric regressed beyond the threshold (CI-friendly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _configs(doc: dict) -> dict:
+    if "configs" in doc and isinstance(doc["configs"], dict):
+        return doc["configs"]
+    if "detail" in doc and isinstance(doc.get("detail"), dict):
+        inner = doc["detail"]
+        if isinstance(inner.get("configs"), dict):
+            return inner["configs"]
+    return doc
+
+
+def _numeric_leaves(obj, prefix="") -> dict:
+    out: dict = {}
+    if isinstance(obj, dict):
+        for key, value in obj.items():
+            out.update(_numeric_leaves(value, f"{prefix}{key}."))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _seconds_like(key: str) -> bool:
+    leaf = key.rsplit(".", 1)[-1]
+    return leaf.endswith("_s") or "_s_per_" in leaf or leaf.endswith("_ms")
+
+
+def compare(a: dict, b: dict, threshold: float) -> "tuple[list, int]":
+    """Rows of (config, metric, old, new, ratio, verdict); count of
+    seconds-like regressions beyond the threshold."""
+    rows: list = []
+    regressions = 0
+    shared_configs = sorted(set(_configs(a)) & set(_configs(b)))
+    for name in shared_configs:
+        ca, cb = _configs(a)[name], _configs(b)[name]
+        if not (isinstance(ca, dict) and isinstance(cb, dict)):
+            continue
+        la, lb = _numeric_leaves(ca), _numeric_leaves(cb)
+        # phases first: the attribution split is the headline diff
+        keys = sorted(
+            set(la) & set(lb),
+            key=lambda k: (not k.startswith("phases."), k),
+        )
+        for key in keys:
+            old, new = la[key], lb[key]
+            if old == new:
+                continue
+            ratio = (new / old) if old else None
+            verdict = ""
+            if _seconds_like(key) and ratio is not None:
+                if ratio > 1 + threshold:
+                    verdict = "REGRESSED"
+                    regressions += 1
+                elif ratio < 1 - threshold:
+                    verdict = "improved"
+            rows.append((name, key, old, new, ratio, verdict))
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python bench_compare.py",
+        description="per-phase diff of two BENCH_*.json evidence files",
+    )
+    parser.add_argument("old")
+    parser.add_argument("new")
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.05,
+        help="relative change below which a seconds metric is noise "
+        "(default 0.05)",
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="also print unchanged-verdict (non-seconds) metric changes",
+    )
+    args = parser.parse_args(argv)
+
+    with open(args.old) as f:
+        a = json.load(f)
+    with open(args.new) as f:
+        b = json.load(f)
+
+    rows, regressions = compare(a, b, args.threshold)
+    current = None
+    shown = 0
+    for name, key, old, new, ratio, verdict in rows:
+        if not verdict and not args.all:
+            continue
+        if name != current:
+            print(f"\n[{name}]")
+            current = name
+        ratio_s = f"x{ratio:.3f}" if ratio is not None else "n/a"
+        tag = f"  {verdict}" if verdict else ""
+        print(f"  {key:<44} {old:>12.4f} -> {new:>12.4f}  {ratio_s}{tag}")
+        shown += 1
+    if not shown:
+        print("no metric changes beyond threshold "
+              f"({args.threshold:.0%}) in shared configs")
+    print(
+        f"\n{regressions} seconds-metric regression(s) beyond "
+        f"{args.threshold:.0%}"
+    )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

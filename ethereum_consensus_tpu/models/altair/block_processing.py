@@ -21,6 +21,7 @@ from ...error import (
 )
 from ...signing import compute_signing_root
 from ...ssz import is_valid_merkle_branch
+from .. import ops_vector
 from ..signature_batch import verify_or_defer
 from ..phase0.block_processing import (  # noqa: F401 — fork-diff re-exports
     get_validator_from_deposit,
@@ -55,8 +56,13 @@ __all__ = [
 ]
 
 
-def process_attestation(state, attestation, context) -> None:
-    """(block_processing.rs:31)"""
+def _prepare_attestation(state, attestation, context):
+    """Every check and resolution of altair process_attestation BEFORE the
+    participation writes: validation, committee/flag resolution, signature
+    verify (deferred under a batch). Returns ``(attesting_indices,
+    participation_flag_indices, is_current)`` — shared verbatim by the
+    scalar path below and the columnar block engine
+    (models/ops_vector.py), so the two can't drift."""
     data = attestation.data
     current_epoch = h.get_current_epoch(state, context)
     previous_epoch = h.get_previous_epoch(state, context)
@@ -98,6 +104,19 @@ def process_attestation(state, attestation, context) -> None:
     attesting_indices = h.get_attesting_indices(
         state, data, attestation.aggregation_bits, context
     )
+    return attesting_indices, participation_flag_indices, is_current
+
+
+def _apply_attestation_participation(
+    state, attesting_indices, participation_flag_indices, is_current,
+    context, helpers=None,
+) -> None:
+    """The participation-flag writes + proposer reward of altair+
+    process_attestation — the scalar per-index loop, identical across
+    altair..electra (only the validation above differs per fork). This is
+    the fallback and the differential-test oracle for the columnar block
+    engine's vectorized twin."""
+    hm = helpers or h
     participation = (
         state.current_epoch_participation
         if is_current
@@ -105,14 +124,14 @@ def process_attestation(state, attestation, context) -> None:
     )
     proposer_reward_numerator = 0
     # hoist the O(n) total-active-balance out of the attester loop
-    brpi = h.get_base_reward_per_increment(state, context)
+    brpi = hm.get_base_reward_per_increment(state, context)
     increment = context.EFFECTIVE_BALANCE_INCREMENT
     for index in attesting_indices:
         for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-            if flag_index in participation_flag_indices and not h.has_flag(
+            if flag_index in participation_flag_indices and not hm.has_flag(
                 participation[index], flag_index
             ):
-                participation[index] = h.add_flag(participation[index], flag_index)
+                participation[index] = hm.add_flag(participation[index], flag_index)
                 proposer_reward_numerator += (
                     state.validators[index].effective_balance // increment
                 ) * brpi * weight
@@ -121,8 +140,19 @@ def process_attestation(state, attestation, context) -> None:
         (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT
     )
     proposer_reward = proposer_reward_numerator // proposer_reward_denominator
-    h.increase_balance(
-        state, h.get_beacon_proposer_index(state, context), proposer_reward
+    hm.increase_balance(
+        state, hm.get_beacon_proposer_index(state, context), proposer_reward
+    )
+
+
+def process_attestation(state, attestation, context) -> None:
+    """(block_processing.rs:31)"""
+    attesting_indices, participation_flag_indices, is_current = (
+        _prepare_attestation(state, attestation, context)
+    )
+    _apply_attestation_participation(
+        state, attesting_indices, participation_flag_indices, is_current,
+        context,
     )
 
 
@@ -245,6 +275,27 @@ def apply_deposit(
         h.increase_balance(state, existing, deposit_data.amount)
 
 
+def _registry_pubkey_index(state) -> dict:
+    """pubkey -> registry index, cached on the state per registry length.
+
+    Sound because the registry is append-only and a validator's public
+    key is immutable once deposited; a deposit changes the length key and
+    rebuilds. The sync aggregate resolves all 512 committee members'
+    indices EVERY block, and the uncached full-registry dictcomp was the
+    single biggest operations item of the warm 2^17 deneb block (~67 ms).
+    REBOUND, never mutated in place — Container.copy() shares __dict__
+    values (the _active_idx_cache rationale in phase0/helpers.py)."""
+    cached = state.__dict__.get("_pubkey_index_cache")
+    n = len(state.validators)
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    index_by_key = {
+        bytes(v.public_key): i for i, v in enumerate(state.validators)
+    }
+    state.__dict__["_pubkey_index_cache"] = (n, index_by_key)
+    return index_by_key
+
+
 def process_sync_aggregate(state, sync_aggregate, context) -> None:
     """(block_processing.rs:192) — eth_fast_aggregate_verify over up to
     SYNC_COMMITTEE_SIZE keys; the #2 signature hot path."""
@@ -287,6 +338,7 @@ def process_sync_aggregate(state, sync_aggregate, context) -> None:
         h.get_total_active_balance(state, context)
         // context.EFFECTIVE_BALANCE_INCREMENT
     )
+    index_by_key = _registry_pubkey_index(state)
     total_base_rewards = (
         h.get_base_reward_per_increment(state, context) * total_active_increments
     )
@@ -301,7 +353,6 @@ def process_sync_aggregate(state, sync_aggregate, context) -> None:
         participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
     )
 
-    index_by_key = {bytes(v.public_key): i for i, v in enumerate(state.validators)}
     committee_indices = [index_by_key[bytes(pk)] for pk in committee_keys]
     for participant_index, bit in zip(committee_indices, bits):
         if bit:
@@ -347,8 +398,15 @@ def process_operations(
         process_proposer_slashing(state, op, context, slash_fn=slash_fn)
     for op in body.attester_slashings:
         process_attester_slashing(state, op, context, slash_fn=slash_fn)
-    for op in body.attestations:
-        attestation_fn(state, op, context)
+    # block-scoped columnar fast path: all attestations validated through
+    # the fork's own _prepare_attestation, flags committed with one
+    # bulk_store per participation list; the scalar loop is the fallback
+    # (small registry, custom attestation_fn, no numpy) and the oracle
+    if not ops_vector.process_attestations_batch(
+        state, body.attestations, context, attestation_fn
+    ):
+        for op in body.attestations:
+            attestation_fn(state, op, context)
     if body.deposits:
         pubkey_index = {
             bytes(v.public_key): i for i, v in enumerate(state.validators)
@@ -366,3 +424,10 @@ def process_block(state, block, context) -> None:
     process_eth1_data(state, block.body, context)
     process_operations(state, block.body, context)
     process_sync_aggregate(state, block.body.sync_aggregate, context)
+
+
+# bellatrix/capella re-export this module's process_attestation, so one
+# registration covers the three forks that share the altair validation
+ops_vector.register_attestation_preparer(
+    process_attestation, _prepare_attestation, h
+)

@@ -60,13 +60,15 @@ def _host_deltas_vectorized(state, context, hm, inactivity_quotient_name):
     could reach 2^63 falls back per-index)."""
     import numpy as np
 
-    from ...ops.registry_columns import pack_registry
+    from ..ops_vector import pack_registry_cached
     from .constants import TIMELY_HEAD_FLAG_INDEX, WEIGHT_DENOMINATOR
 
     n = len(state.validators)
     prev = hm.get_previous_epoch(state, context)
     cur = hm.get_current_epoch(state, context)
-    packed = pack_registry(
+    # delta-refreshed registry-column cache (models/ops_vector.py); the
+    # literal fromiter packing is its internal fallback
+    packed = pack_registry_cached(
         state, prev, use_current_participation=(prev == cur)
     )
     eff = packed["effective_balance"]
@@ -175,20 +177,18 @@ def process_inactivity_updates(state, context) -> None:
     if n >= _VECTORIZED_DELTAS_MIN_N:
         import numpy as np
 
-        from ...ops.registry_columns import pack_registry
+        from ..ops_vector import pack_registry_cached
 
-        # extract the scores FIRST: if the overflow guard trips, the
-        # literal loop re-reads everything anyway and a full 7-column
-        # pack would be wasted work
-        scores = np.fromiter(
-            (int(s) for s in state.inactivity_scores), np.uint64, n
+        # cached columns make the full pack ~free warm, so the scores
+        # read rides the same pack (the overflow guard below still
+        # routes pathological states to the literal loop)
+        packed = pack_registry_cached(
+            state, prev_epoch,
+            use_current_participation=(prev_epoch == current_epoch),
         )
+        scores = packed["inactivity_scores"]
         bias = int(context.inactivity_score_bias)
         if int(scores.max()) < 2**64 - bias:
-            packed = pack_registry(
-                state, prev_epoch,
-                use_current_participation=(prev_epoch == current_epoch),
-            )
             from ...ops.registry_columns import unslashed_flag_mask
 
             participating = unslashed_flag_mask(

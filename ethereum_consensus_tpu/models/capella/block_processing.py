@@ -23,6 +23,7 @@ from ...signing import compute_signing_root
 from ...utils import trace
 from ..signature_batch import verify_or_defer
 from .. import _diff
+from .. import ops_vector as _ops_vector
 from ..altair import block_processing as _altair_bp
 from ..bellatrix import block_processing as _bellatrix_bp
 from ..bellatrix.block_processing import (
@@ -122,20 +123,23 @@ def process_execution_payload(state, body, context) -> None:
 
 
 def get_expected_withdrawals(state, context) -> list:
-    """(block_processing.rs:348) — numpy sweep when the registry is big
-    enough to matter, with the literal per-index loop as the fallback
-    (and the cross-checked oracle in tests). The span marks the
-    per-block registry sweep — the third named hot scan in the warm
-    deneb profile (ROADMAP)."""
-    with trace.span(
-        "capella.withdrawals_sweep", validators=len(state.validators)
-    ):
-        return _expected_withdrawals(state, context)
+    """(block_processing.rs:348) — columnar sweep (registry-column cache,
+    models/ops_vector.py) when the registry is big enough to matter, with
+    the literal per-index loop as the fallback (and the cross-checked
+    oracle in tests). The ``capella.withdrawals_sweep`` span now marks
+    only the LITERAL registry sweep — the third named hot scan of the
+    warm deneb profile (ROADMAP) — while the columnar path runs under
+    ``ops_vector.withdrawals``, so the hot-scan span disappearing per
+    block is the signal the cache engaged (bench asserts it)."""
+    return _expected_withdrawals(state, context)
 
 
 def _expected_withdrawals(state, context) -> list:
     if len(state.validators) >= 256:
-        hits = _sweep_hits_vectorized(state, context)
+        with trace.span(
+            "ops_vector.withdrawals", validators=len(state.validators)
+        ):
+            hits = _sweep_hits_vectorized(state, context)
         if hits is not None:
             withdrawal_index = state.next_withdrawal_index
             withdrawals = []
@@ -153,7 +157,10 @@ def _expected_withdrawals(state, context) -> list:
                 )
                 withdrawal_index += 1
             return withdrawals
-    return _get_expected_withdrawals_loop(state, context)
+    with trace.span(
+        "capella.withdrawals_sweep", validators=len(state.validators)
+    ):
+        return _get_expected_withdrawals_loop(state, context)
 
 
 def _get_expected_withdrawals_loop(state, context) -> list:
@@ -195,40 +202,26 @@ def _get_expected_withdrawals_loop(state, context) -> list:
 def _sweep_hits_vectorized(state, context) -> "list[tuple[int, bool]] | None":
     """(validator_index, is_full) of the sweep's first hits, in sweep
     order, capped at MAX_WITHDRAWALS_PER_PAYLOAD — exactly the indices
-    the literal loop would emit. None = fall back (no numpy / odd
-    values)."""
+    the literal loop would emit. Columns come from the delta-refreshed
+    registry-column cache (models/ops_vector.py) instead of per-block
+    fromiter walks. None = fall back, with the reason counted in
+    ``ops_vector.fallback.*`` so a degraded host is visible in bench
+    ``metrics`` blocks instead of just slow."""
     try:
         import numpy as np
     except Exception:  # noqa: BLE001 — environment without numpy
+        _ops_vector.fallback("no_numpy")
         return None
-    from ...primitives import ETH1_ADDRESS_WITHDRAWAL_PREFIX
-
-    vals = state.validators
-    n = len(vals)
+    cols = _ops_vector.withdrawal_columns(state)
+    if cols is None:
+        return None
+    prefix = cols["withdrawal_prefix"]
+    weps = cols["withdrawable_epoch"]
+    effs = cols["effective_balance"]
+    bals = cols["balances"]
+    n = bals.shape[0]
     epoch = h.get_current_epoch(state, context)
-    try:
-        prefix_ok = np.fromiter(
-            (
-                bytes(v.withdrawal_credentials)[:1]
-                == ETH1_ADDRESS_WITHDRAWAL_PREFIX
-                for v in vals
-            ),
-            dtype=bool,
-            count=n,
-        )
-        weps = np.fromiter(
-            (int(v.withdrawable_epoch) for v in vals), dtype=np.uint64, count=n
-        )
-        effs = np.fromiter(
-            (int(v.effective_balance) for v in vals), dtype=np.uint64, count=n
-        )
-        bals = np.fromiter(
-            (int(b) for b in state.balances), dtype=np.uint64, count=n
-        )
-    except (TypeError, ValueError, OverflowError):
-        return None
-    if len(bals) != n:
-        return None
+    prefix_ok = prefix == np.uint8(ETH1_ADDRESS_WITHDRAWAL_PREFIX[0])
     maxeb = np.uint64(int(context.MAX_EFFECTIVE_BALANCE))
     full = prefix_ok & (weps <= np.uint64(int(epoch))) & (bals > 0)
     part = prefix_ok & (effs == maxeb) & (bals > maxeb) & ~full

@@ -24,8 +24,8 @@ from ...crypto import bls
 from ...signing import compute_signing_root
 from ..signature_batch import verify_or_defer
 from .. import _diff
+from .. import ops_vector as _ops_vector
 from ..altair import block_processing as _altair_bp
-from ..altair.constants import PROPOSER_WEIGHT, PARTICIPATION_FLAG_WEIGHTS, WEIGHT_DENOMINATOR
 from ..bellatrix.containers import execution_payload_to_header
 from ..capella import block_processing as _capella_bp
 from ..capella.block_processing import (
@@ -49,9 +49,11 @@ __all__ = [
 ]
 
 
-def process_attestation(state, attestation, context) -> None:
-    """(block_processing.rs:26) — EIP-7045 removes the one-epoch upper
-    inclusion bound; participation flags come from deneb helpers."""
+def _prepare_attestation(state, attestation, context):
+    """deneb validation half of process_attestation (EIP-7045: no upper
+    inclusion bound). Returns ``(attesting_indices,
+    participation_flag_indices, is_current)`` for the shared scalar apply
+    and the columnar block engine."""
     data = attestation.data
     current_epoch = h.get_current_epoch(state, context)
     previous_epoch = h.get_previous_epoch(state, context)
@@ -89,31 +91,18 @@ def process_attestation(state, attestation, context) -> None:
     attesting_indices = h.get_attesting_indices(
         state, data, attestation.aggregation_bits, context
     )
-    participation = (
-        state.current_epoch_participation
-        if is_current
-        else state.previous_epoch_participation
-    )
-    proposer_reward_numerator = 0
-    # hoist the O(n) total-active-balance out of the attester loop
-    brpi = h.get_base_reward_per_increment(state, context)
-    increment = context.EFFECTIVE_BALANCE_INCREMENT
-    for index in attesting_indices:
-        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
-            if flag_index in participation_flag_indices and not h.has_flag(
-                participation[index], flag_index
-            ):
-                participation[index] = h.add_flag(participation[index], flag_index)
-                proposer_reward_numerator += (
-                    state.validators[index].effective_balance // increment
-                ) * brpi * weight
+    return attesting_indices, participation_flag_indices, is_current
 
-    proposer_reward_denominator = (
-        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT) * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT
+
+def process_attestation(state, attestation, context) -> None:
+    """(block_processing.rs:26) — EIP-7045 removes the one-epoch upper
+    inclusion bound; participation flags come from deneb helpers."""
+    attesting_indices, participation_flag_indices, is_current = (
+        _prepare_attestation(state, attestation, context)
     )
-    proposer_reward = proposer_reward_numerator // proposer_reward_denominator
-    h.increase_balance(
-        state, h.get_beacon_proposer_index(state, context), proposer_reward
+    _altair_bp._apply_attestation_participation(
+        state, attesting_indices, participation_flag_indices, is_current,
+        context, helpers=h,
     )
 
 
@@ -220,3 +209,7 @@ def process_block(state, block, context) -> None:
 
 
 _diff.inherit(globals(), _capella_bp)
+
+_ops_vector.register_attestation_preparer(
+    process_attestation, _prepare_attestation, h
+)

@@ -123,10 +123,25 @@ def process_pending_consolidations(state, context) -> None:
 
 def process_effective_balance_updates(state, context) -> None:
     """(epoch_processing.rs electra process_effective_balance_updates) —
-    per-validator limit depends on compounding credentials."""
+    per-validator limit depends on compounding credentials. Columnar host
+    twin above the vectorized threshold (models/ops_vector.py, EIP-7251
+    compounding-aware); the literal loop is the oracle/fallback."""
     # the ONLY spec site that mutates effective balances: drop the
     # total-active-balance memo (helpers.get_total_active_balance)
     state.__dict__.pop("_total_active_balance_cache", None)
+    from ..phase0.epoch_processing import _VECTORIZED_REWARDS_MIN_N
+
+    if len(state.validators) >= _VECTORIZED_REWARDS_MIN_N:
+        from ..ops_vector import effective_balance_update_hits
+
+        hits = effective_balance_update_hits(
+            state, context, per_validator_limit=True
+        )
+        if hits is not None:
+            validators = state.validators
+            for index, value in hits:
+                validators[index].effective_balance = value
+            return
     hysteresis_increment = (
         context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
     )

@@ -529,20 +529,33 @@ def vectorized_registry_scan(
     current_epoch = h.get_current_epoch(state, context)
     n = len(state.validators)
     vals = state.validators
-    eligibility = np.fromiter(
-        (v.activation_eligibility_epoch for v in vals),
-        dtype=np.uint64,
-        count=n,
-    )
-    activation = np.fromiter(
-        (v.activation_epoch for v in vals), dtype=np.uint64, count=n
-    )
-    exit_epoch = np.fromiter(
-        (v.exit_epoch for v in vals), dtype=np.uint64, count=n
-    )
-    eff = np.fromiter(
-        (v.effective_balance for v in vals), dtype=np.uint64, count=n
-    )
+    # delta-refreshed registry columns when available (the masks below
+    # are derived arrays, and nothing re-syncs the cache mid-scan, so
+    # the views stay frozen at extraction exactly like the fromiters)
+    from ..ops_vector import columns_for
+
+    cols = columns_for(state)
+    vc = cols.validator_columns(state) if cols is not None else None
+    if vc is not None:
+        eligibility = vc["activation_eligibility_epoch"]
+        activation = vc["activation_epoch"]
+        exit_epoch = vc["exit_epoch"]
+        eff = vc["effective_balance"]
+    else:
+        eligibility = np.fromiter(
+            (v.activation_eligibility_epoch for v in vals),
+            dtype=np.uint64,
+            count=n,
+        )
+        activation = np.fromiter(
+            (v.activation_epoch for v in vals), dtype=np.uint64, count=n
+        )
+        exit_epoch = np.fromiter(
+            (v.exit_epoch for v in vals), dtype=np.uint64, count=n
+        )
+        eff = np.fromiter(
+            (v.effective_balance for v in vals), dtype=np.uint64, count=n
+        )
     far = np.uint64(FAR_FUTURE_EPOCH)
     if queue_entry_ge_min_activation:
         balance_rule = eff >= np.uint64(int(context.MIN_ACTIVATION_BALANCE))
@@ -655,7 +668,9 @@ def process_eth1_data_reset(state, context) -> None:
 
 def process_effective_balance_updates(state, context) -> None:
     """Hysteresis sweep over the whole registry; device twin above
-    threshold (ops/sweeps.py effective_balance_updates_device)."""
+    threshold (ops/sweeps.py effective_balance_updates_device), columnar
+    host twin (models/ops_vector.py effective_balance_update_hits) above
+    the vectorized threshold, literal loop as oracle/fallback."""
     # the ONLY spec site that mutates effective balances: drop the
     # total-active-balance memo (helpers.get_total_active_balance)
     state.__dict__.pop("_total_active_balance_cache", None)
@@ -672,6 +687,18 @@ def process_effective_balance_updates(state, context) -> None:
             if validator.effective_balance != value:
                 validator.effective_balance = value
         return
+    if len(state.validators) >= _VECTORIZED_REWARDS_MIN_N:
+        from ..ops_vector import effective_balance_update_hits
+
+        hits = effective_balance_update_hits(state, context)
+        if hits is not None:
+            validators = state.validators
+            # changed-only writes through __setattr__ (the instrumented
+            # channel): the literal loop only ever stores a different
+            # value on a threshold crossing, so this is the same state
+            for index, value in hits:
+                validators[index].effective_balance = value
+            return
     hysteresis_increment = (
         context.EFFECTIVE_BALANCE_INCREMENT // context.HYSTERESIS_QUOTIENT
     )

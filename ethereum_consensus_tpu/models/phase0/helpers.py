@@ -602,6 +602,26 @@ def get_indexed_attestation(state, attestation, context):
     )
 
 
+def _registry_pubkey_objects(state) -> list:
+    """Lazily-filled ``PublicKey`` object memo per registry index, keyed
+    by registry length in the state ``__dict__``.
+
+    Soundness: the registry is append-only and a validator's public key
+    is immutable once deposited, so index ``i`` maps to one key forever
+    at a given length — filling a slot in the SHARED list (state copies
+    share ``__dict__`` values) can only install the identical immutable
+    object either side would have parsed. A deposit changes the length
+    key, which REBINDS a fresh list (the _active_idx_cache discipline:
+    never mutate a shared memo's SHAPE, only fill identical content)."""
+    cached = state.__dict__.get("_pubkey_obj_cache")
+    n = len(state.validators)
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    slots = [None] * n
+    state.__dict__["_pubkey_obj_cache"] = (n, slots)
+    return slots
+
+
 def is_valid_indexed_attestation(state, indexed_attestation, context, error=None) -> None:
     """Raises on failure (helpers.rs:71). The BLS fast_aggregate_verify here
     is the #1 signature hot path (SURVEY.md §3.1): inside a
@@ -620,11 +640,19 @@ def is_valid_indexed_attestation(state, indexed_attestation, context, error=None
     # decompression defers to VERIFICATION time (bls.warm_raw_keys runs
     # the eight-wide bulk path there) — in the chain pipeline that is
     # stage B, overlapped with the next block's application instead of
-    # serialized into this one's
-    public_keys = [
-        bls.PublicKey.from_validated_bytes(state.validators[i].public_key)
-        for i in indices
-    ]
+    # serialized into this one's. The PublicKey OBJECTS are memoized per
+    # registry index (_registry_pubkey_objects): re-parsing ~8k registry
+    # keys per warm block was a measurable operations term at 2^17.
+    pk_objects = _registry_pubkey_objects(state)
+    from_validated = bls.PublicKey.from_validated_bytes
+    validators = state.validators
+    public_keys = []
+    for i in indices:
+        pk = pk_objects[i]
+        if pk is None:
+            pk = from_validated(validators[i].public_key)
+            pk_objects[i] = pk
+        public_keys.append(pk)
     domain = get_domain(
         state,
         DomainType.BEACON_ATTESTER,

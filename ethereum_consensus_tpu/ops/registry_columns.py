@@ -10,7 +10,20 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["pack_registry", "unslashed_flag_mask"]
+__all__ = ["pack_registry", "unslashed_flag_mask", "activity_masks"]
+
+
+def activity_masks(activation, exit_epoch, withdrawable, slashed, previous_epoch):
+    """(active_previous, eligible) boolean columns from the epoch columns —
+    THE eligibility formula (altair helpers.rs:265), shared by the
+    fromiter packing below and the cached-column packing in
+    models/ops_vector.py so the two can't drift."""
+    prev = np.uint64(int(previous_epoch))
+    active_previous = (activation <= prev) & (prev < exit_epoch)
+    eligible = active_previous | (
+        slashed & (prev + np.uint64(1) < withdrawable)
+    )
+    return active_previous, eligible
 
 
 def pack_registry(state, previous_epoch: int, use_current_participation: bool = False) -> dict:
@@ -44,23 +57,6 @@ def pack_registry(state, previous_epoch: int, use_current_participation: bool = 
         "slashed": np.fromiter(
             (bool(v.slashed) for v in state.validators), np.bool_, n
         ),
-        "active_previous": np.fromiter(
-            (
-                v.activation_epoch <= previous_epoch < v.exit_epoch
-                for v in state.validators
-            ),
-            np.bool_,
-            n,
-        ),
-        "eligible": np.fromiter(
-            (
-                (v.activation_epoch <= previous_epoch < v.exit_epoch)
-                or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
-                for v in state.validators
-            ),
-            np.bool_,
-            n,
-        ),
         "previous_participation": np.fromiter(
             (int(f) for f in participation_list), np.uint8, n
         ),
@@ -69,6 +65,17 @@ def pack_registry(state, previous_epoch: int, use_current_participation: bool = 
         ),
         "balances": np.fromiter((int(b) for b in state.balances), np.uint64, n),
     }
+    out["active_previous"], out["eligible"] = activity_masks(
+        np.fromiter(
+            (v.activation_epoch for v in state.validators), np.uint64, n
+        ),
+        np.fromiter((v.exit_epoch for v in state.validators), np.uint64, n),
+        np.fromiter(
+            (v.withdrawable_epoch for v in state.validators), np.uint64, n
+        ),
+        out["slashed"],
+        previous_epoch,
+    )
     return out
 
 

@@ -432,7 +432,8 @@ class CachedRootList(list):
                  "_elems_fresh", "_parents_registered", "_self_ref",
                  "_container_parents", "_mut_gen", "_pack_gen",
                  "_dirty_groups", "_tree_memo", "_pack_tree",
-                 "_memos_owned", "__weakref__")
+                 "_memos_owned", "_col_dirty", "_col_cache", "_col_owned",
+                 "__weakref__")
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -470,6 +471,29 @@ class CachedRootList(list):
         self._elems_fresh: bool = False
         self._parents_registered: bool = False
         self._self_ref = None
+        # --- element-level column invalidation (models/ops_vector.py,
+        # docs/OPS_VECTOR.md). None = no columnar consumer attached;
+        # set() = the ELEMENT indices whose values changed since the
+        # consumer last drained. Activated by the registry-column cache
+        # (which sets it to an empty set at build time) and maintained by
+        # every sanctioned mutation channel — the instrumented list
+        # mutators below, Container.__setattr__'s weak-parent notify for
+        # container elements, and bulk_store's changed-indices contract.
+        # Any mutation whose touched indices can't be named (structural
+        # resize, reorder, uncertified bulk write) resets it to None, and
+        # the consumer falls back to a full column rebuild. This is the
+        # same single-writer discipline as _dirty_groups, at element
+        # (not 4096-group) granularity, for host arrays instead of
+        # merkle subtrees.
+        self._col_dirty: "set | None" = None
+        # The columnar view itself (an opaque record owned by
+        # models/ops_vector.py) lives WITH the list so it travels across
+        # state copies: _copy_value shares it structurally and drops
+        # ownership on BOTH sides (the _tree_memo/_memos_owned
+        # discipline) — whichever side refreshes first clones its arrays,
+        # so staleness costs one buffer copy, never a wrong column.
+        self._col_cache = None
+        self._col_owned: bool = True
         # (key, packed_bytes, root) of the last merkleization, exempt
         # from mutation invalidation: correctness comes from comparing
         # the EXACT packed bytes on reuse, so a stale entry can only
@@ -506,6 +530,9 @@ class CachedRootList(list):
 # (one chunk per element root). Module globals so the property tests can
 # shrink the geometry and exercise many groups on small collections.
 _DIRTY_GROUP_SHIFT = 12
+# Above this many pending column-dirty element indices a full column
+# rebuild is cheaper than maintaining (and later replaying) the set.
+_COL_DIRTY_CAP = 1 << 16
 # Track only collections whose merkle layer clears one group — below
 # that a full re-merkleization is a single cheap native call anyway.
 _DIRTY_TRACK_MIN_CHUNKS = 1 << 12
@@ -541,6 +568,28 @@ def _mutation_groups(name, args, pre_len, post_len):
         return None
     # insert/remove/sort/reverse/__delitem__/__imul__/clear: index map gone
     return None
+
+
+def _mutation_elems(name, args, pre_len, post_len):
+    """Element indices touched by an instrumented list mutation, for the
+    column-invalidation channel (``_col_dirty``), or None when the touched
+    set can't be named (resize, reorder, slice-resize) — the columnar
+    consumer then rebuilds. Stricter than ``_mutation_groups``: a column
+    array has fixed length, so ANY length change loses tracking."""
+    if post_len != pre_len:
+        return None
+    if name == "__setitem__":
+        i = args[0]
+        if type(i) is int:
+            return ((i + pre_len) if i < 0 else i,)
+        if type(i) is slice:
+            start, stop, step = i.indices(pre_len)
+            if step == 1:
+                return range(start, stop)
+        return None
+    if name in ("extend", "__iadd__", "__imul__"):
+        return ()  # length unchanged ⇒ empty payload / *1: content intact
+    return None  # sort/reverse permute in place: index map gone
 
 
 def _instrument(name):
@@ -583,6 +632,13 @@ def _instrument(name):
                 self._dirty_groups = None
             else:
                 dg.update(marks)
+        cd = self._col_dirty
+        if cd is not None:
+            elems = _mutation_elems(name, args, pre_len, len(self))
+            if elems is None:
+                self._col_dirty = None
+            else:
+                cd.update(elems)
         if self._parents_registered:
             # keep newly added container elements wired to this list (and
             # stamped with their index, so their mutations mark the right
@@ -689,6 +745,13 @@ def instrumented_surface() -> dict:
       bypass it.
     * ``bulk_mutators`` — module-level bulk entry points with an explicit
       changed-indices dirty contract.
+    * ``column_channel`` — the element-level invalidation feed the
+      registry-column cache (``models/ops_vector.py``) consumes: every
+      sanctioned mutation channel above also marks ``_col_dirty`` (or
+      resets it to None when the touched indices can't be named), so a
+      columnar view stays delta-refreshable without any consumer-side
+      hooks. Single consumer per list; drained under the same
+      single-writer discipline as ``_dirty_groups``.
     """
     return {
         "list_type": "CachedRootList",
@@ -698,6 +761,15 @@ def instrumented_surface() -> dict:
         ),
         "container_field_write": "Container.__setattr__",
         "bulk_mutators": ("bulk_store",),
+        "column_channel": {
+            "dirty_slot": "_col_dirty",
+            "consumer": "ethereum_consensus_tpu.models.ops_vector",
+            "markers": (
+                "CachedRootList instrumented mutators",
+                "Container.__setattr__",
+                "bulk_store",
+            ),
+        },
     }
 
 
@@ -1227,21 +1299,37 @@ def bulk_store(values, new_values, changed_indices=None) -> None:
             if p is not None:
                 p._ssz_root_dirty()
     dg = values._dirty_groups
-    if dg is None:
+    cd = values._col_dirty
+    if dg is None and cd is None:
         return
     gs = _DIRTY_GROUP_SHIFT
     if changed_indices is None:
-        if n:
+        # uncertified: every element may differ — columnar consumers
+        # rebuild rather than refresh
+        values._col_dirty = None
+        if dg is not None and n:
             dg.update(range(((n - 1) >> gs) + 1))
         return
     try:
         import numpy as _np
 
         arr = _np.asarray(changed_indices, dtype=_np.int64)
-        if arr.size:
+        if dg is not None and arr.size:
             dg.update(_np.unique(arr >> gs).tolist())
+        if cd is not None:
+            if arr.size + len(cd) > _COL_DIRTY_CAP:
+                values._col_dirty = None  # full rebuild beats a huge set
+            else:
+                cd.update(arr.tolist())
     except (TypeError, ValueError):
-        dg.update({int(i) >> gs for i in changed_indices})
+        idxs = [int(i) for i in changed_indices]
+        if dg is not None:
+            dg.update({i >> gs for i in idxs})
+        if cd is not None:
+            if len(idxs) + len(cd) > _COL_DIRTY_CAP:
+                values._col_dirty = None
+            else:
+                cd.update(idxs)
 
 
 def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> bytes:
@@ -1830,6 +1918,11 @@ class Container(metaclass=_ContainerMeta):
         parents = d.get("_ssz_parents")
         if parents is not None:
             idx = d.get("_ssz_idx")
+            # the column channel only trusts immutable scalars: a field
+            # that becomes e.g. a bytearray could then mutate in place
+            # without notifying, so its row can't stay column-tracked
+            tv = value.__class__
+            col_safe = tv is int or tv is bytes or tv is bool
             for ref in parents:
                 p = ref()
                 if p is None:
@@ -1837,21 +1930,29 @@ class Container(metaclass=_ContainerMeta):
                 if p.__class__ is CachedRootList:
                     p._elems_fresh = False
                     dg = p._dirty_groups
-                    if dg is not None:
+                    cd = p._col_dirty
+                    if dg is not None or cd is not None:
                         # the stamped index is trusted only when it still
                         # points at THIS object in THAT list (stamps are
                         # per-element, and a structural mutation or a
                         # different-position alias can stale them); any
                         # mismatch downgrades the list to the discovery
                         # walk rather than risking a missed group
-                        if (
+                        stamped = (
                             idx is not None
                             and idx < list.__len__(p)
                             and list.__getitem__(p, idx) is self
-                        ):
-                            dg.add(idx >> _DIRTY_GROUP_SHIFT)
-                        else:
-                            p._dirty_groups = None
+                        )
+                        if dg is not None:
+                            if stamped:
+                                dg.add(idx >> _DIRTY_GROUP_SHIFT)
+                            else:
+                                p._dirty_groups = None
+                        if cd is not None:
+                            if stamped and col_safe:
+                                cd.add(idx)
+                            else:
+                                p._col_dirty = None
                 elif had:
                     p._ssz_root_dirty()
         if type(value) is list:
@@ -1876,6 +1977,10 @@ class Container(metaclass=_ContainerMeta):
                     continue
                 if p.__class__ is CachedRootList:
                     p._elems_fresh = False
+                    # a NESTED child changed: the columnar consumers only
+                    # attach to scalar-leaf element lists (which never take
+                    # this path), so stay conservative and drop tracking
+                    p._col_dirty = None
                     dg = p._dirty_groups
                     if dg is not None:
                         if (
@@ -2102,6 +2207,22 @@ class Container(metaclass=_ContainerMeta):
         return type(self).hash_tree_root(self)
 
 
+def _share_col_cache(value: "CachedRootList", copied: "CachedRootList") -> None:
+    """Structural share of the columnar view across a copy: contents are
+    identical at copy time, so the arrays are too. The pending dirty set
+    is duplicated (each side replays it against its own future), and
+    ownership drops on BOTH sides so the first refresh clones before
+    mutating (the _tree_memo discipline)."""
+    cc = value._col_cache
+    cd = value._col_dirty
+    if cc is None or cd is None:
+        return
+    copied._col_cache = cc
+    copied._col_dirty = set(cd)
+    copied._col_owned = False
+    value._col_owned = False
+
+
 def _copy_scalar_leaf_list(value: "CachedRootList") -> "CachedRootList":
     """Specialized copy for lists of scalar-leaf containers (the validator
     registry): element dicts are duplicated raw (their field values are
@@ -2126,6 +2247,7 @@ def _copy_scalar_leaf_list(value: "CachedRootList") -> "CachedRootList":
         append(copied, nv)
     copied._parents_registered = True
     copied._elems_fresh = value._elems_fresh
+    _share_col_cache(value, copied)
     return copied
 
 
@@ -2179,6 +2301,7 @@ def _copy_value(typ: SSZType, value: Any):
             copied._root_cache = dict(value._root_cache)
             copied._pack_memo = value._pack_memo  # immutable tuple: shared
             copied._uniform_kind = value._uniform_kind
+            _share_col_cache(value, copied)
             # the generation pair travels too: the copy's memo is exactly
             # as fresh as the original's was at copy time, and the copy's
             # own instrumented mutators bump only ITS counter

@@ -27,7 +27,7 @@ trajectories stay comparable across the migration.
 
 from __future__ import annotations
 
-__all__ = ["PHASE_SPANS", "attribution"]
+__all__ = ["PHASE_SPANS", "HOT_SWEEP_SPANS", "attribution", "hot_sweep_report"]
 
 PHASE_SPANS = {
     "slot_advance": "transition.slot_advance",
@@ -36,6 +36,18 @@ PHASE_SPANS = {
     "state_htr": "transition.state_htr",
     "committees": "transition.committees",
 }
+
+# The named ROADMAP hot scans. With the epoch caches and the columnar
+# withdrawals path (models/ops_vector.py) engaged, NONE of these may
+# appear on a warm per-block path — the columnar twin runs under
+# ``ops_vector.withdrawals`` instead. Epoch-boundary occurrences (inside
+# ``transition.process_epoch``) are legitimate once-per-epoch work.
+HOT_SWEEP_SPANS = (
+    "helpers.active_indices_sweep",
+    "helpers.total_balance_sweep",
+    "capella.withdrawals_sweep",
+    "electra.withdrawals_sweep",
+)
 
 
 def _total(records, name: str) -> float:
@@ -77,4 +89,36 @@ def attribution(records) -> dict:
         "state_htr_in_slot_advance_s": round(htr_in_slots, 4),
         "committee_s": round(committee_s, 4),
         "operations_s": round(max(0.0, ops_s), 4),
+    }
+
+
+def hot_sweep_report(records) -> dict:
+    """Occurrences of the named ROADMAP hot-scan spans over a recorded
+    run, split into ``boundary`` (inside ``transition.process_epoch`` —
+    legitimate once-per-epoch recomputation) and ``per_block`` (must be
+    ABSENT on a warm path: the epoch caches and the columnar withdrawals
+    sweep take them off it). ``per_block_absent`` is the bench
+    assertion bit."""
+    by_id = {r.span_id: r for r in records}
+
+    def inside_epoch_processing(rec) -> bool:
+        seen = 0
+        parent = by_id.get(rec.parent_id)
+        while parent is not None and seen < 64:
+            if parent.name == "transition.process_epoch":
+                return True
+            parent = by_id.get(parent.parent_id)
+            seen += 1
+        return False
+
+    per_block: dict = {}
+    boundary: dict = {}
+    for r in records:
+        if r.name in HOT_SWEEP_SPANS:
+            bucket = boundary if inside_epoch_processing(r) else per_block
+            bucket[r.name] = bucket.get(r.name, 0) + 1
+    return {
+        "per_block": per_block,
+        "boundary": boundary,
+        "per_block_absent": not per_block,
     }

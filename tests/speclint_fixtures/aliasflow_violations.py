@@ -1,0 +1,60 @@
+"""Alias-dataflow fixture: seeded violations per aliasflow rule plus
+sanctioned twins that must NOT flag. Parsed, never imported."""
+
+
+def bad_detached_store(state, n):
+    scores = [0] * n
+    state.inactivity_scores = scores
+    scores[3] = 5  # seeded: aliasflow/detached-store-mutation
+
+
+def bad_detached_append(state, n):
+    flags = [0] * n
+    state.current_epoch_participation = flags
+    flags.append(7)  # seeded: aliasflow/detached-store-mutation
+
+
+def bad_column_write(state, prev):
+    packed = pack_registry_cached(state, prev)  # noqa: F821 — parsed only
+    packed["balances"][0] = 0  # seeded: aliasflow/column-buffer-mutation
+
+
+def bad_column_alias_write(cols, state):
+    eff = cols.list_column(state, "balances")
+    eff[2] = 9  # seeded: aliasflow/column-buffer-mutation
+
+
+def bad_column_fill(state):
+    buf = withdrawal_columns(state)  # noqa: F821 — parsed only
+    buf.fill(0)  # seeded: aliasflow/column-buffer-mutation
+
+
+def ok_mutate_then_store(state, n):
+    # mutations BEFORE the store are the normal build-then-assign idiom
+    scores = [0] * n
+    scores[3] = 5
+    state.inactivity_scores = scores
+
+
+def ok_rebind_clears_taint(state, n):
+    scores = [0] * n
+    state.inactivity_scores = scores
+    scores = [1] * n  # fresh object: the old alias is gone
+    scores[0] = 2
+
+
+def ok_column_copy(state, prev):
+    packed = pack_registry_cached(state, prev)  # noqa: F821 — parsed only
+    working = packed["balances"].copy()
+    working[0] = 0  # a private copy: sanctioned
+
+
+def ok_mutate_through_field(state, index):
+    # writes through the container field use the instrumented surface
+    state.inactivity_scores[index] = 0
+
+
+def ok_self_attribute(self, values):
+    # self.<attr> is a plain instance slot, not an SSZ field
+    self.buffer = values
+    values.append(1)

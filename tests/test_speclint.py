@@ -28,7 +28,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools import speclint
-from tools.speclint import concurrency, forkdiff, mutation
+from tools.speclint import aliasflow, concurrency, forkdiff, mutation
 from tools.speclint.allowlist import Allowlist, AllowlistError
 
 REPO_ROOT = speclint.REPO_ROOT
@@ -254,6 +254,57 @@ def test_concurrency_locked_twins_not_flagged(concurrency_findings):
         f.symbol.startswith("SharedCounter.__init__")
         for f in concurrency_findings
     )
+
+
+# ---------------------------------------------------------------------------
+# aliasflow self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def aliasflow_findings():
+    return aliasflow.analyze(
+        [os.path.join(FIXTURES, "aliasflow_violations.py")], REPO_ROOT
+    )
+
+
+@pytest.mark.parametrize(
+    "rule,symbol",
+    [
+        ("aliasflow/detached-store-mutation", "bad_detached_store"),
+        ("aliasflow/detached-store-mutation", "bad_detached_append"),
+        ("aliasflow/column-buffer-mutation", "bad_column_write"),
+        ("aliasflow/column-buffer-mutation", "bad_column_alias_write"),
+        ("aliasflow/column-buffer-mutation", "bad_column_fill"),
+    ],
+)
+def test_aliasflow_catches_seeded_violation(aliasflow_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(aliasflow_findings)
+
+
+def test_aliasflow_sanctioned_twins_not_flagged(aliasflow_findings):
+    for sym in (
+        "ok_mutate_then_store",
+        "ok_rebind_clears_taint",
+        "ok_column_copy",
+        "ok_mutate_through_field",
+        "ok_self_attribute",
+    ):
+        assert not any(
+            f.symbol.startswith(sym) for f in aliasflow_findings
+        ), sym
+
+
+def test_aliasflow_scope_covers_the_columnar_engine():
+    """models/ops_vector.py (and the whole models/ tree) must be inside
+    the aliasflow+mutation scope — the columnar cache is exactly the
+    surface these rules exist for."""
+    targets = speclint._default_targets(REPO_ROOT)
+    ops_vector = os.path.join(
+        REPO_ROOT, "ethereum_consensus_tpu", "models", "ops_vector.py"
+    )
+    assert ops_vector in targets["mutation_paths"]
+    assert ops_vector in targets["concurrency_paths"]
 
 
 # ---------------------------------------------------------------------------

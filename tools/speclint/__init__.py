@@ -1,6 +1,6 @@
 """speclint — AST static analysis for the invariants review can't hold.
 
-Three analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
+Four analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
 
 * ``forkdiff``   — drift among the six near-copy ``models/<fork>/``
                    packages (shadowed duplicates, drifted copies,
@@ -10,9 +10,15 @@ Three analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
                    surface ``ssz/core.py`` manifests, or incremental
                    hash_tree_root serves stale roots.
 * ``concurrency``— shared mutable state in ``pipeline/`` +
-                   ``telemetry/`` + ``crypto/bls.py`` + the trace
-                   facade must be lock-dominated; bare threading
-                   primitives outside the blessed set flag.
+                   ``telemetry/`` + ``crypto/bls.py`` +
+                   ``models/ops_vector.py`` + the trace facade must be
+                   lock-dominated; bare threading primitives outside
+                   the blessed set flag.
+* ``aliasflow``  — alias-dataflow purity over the mutation scope: a
+                   buffer stored into a container field then mutated
+                   through the stale alias, and in-place mutation of a
+                   registry-column cache buffer (the ROADMAP-noted gap
+                   the columnar engine made load-bearing).
 
 Run: ``python -m tools.speclint [--format text|json] [paths...]`` — or
 through the tier-1 gate ``tests/test_speclint.py`` (zero non-allowlisted
@@ -24,7 +30,7 @@ from __future__ import annotations
 
 import os
 
-from . import concurrency, forkdiff, mutation
+from . import aliasflow, concurrency, forkdiff, mutation
 from .allowlist import ALLOWLIST_PATH, Allowlist, AllowlistError
 from .base import Finding, iter_py_files
 
@@ -53,6 +59,9 @@ def _default_targets(root: str) -> dict:
             os.path.join(root, _PKG, "telemetry"),
             os.path.join(root, _PKG, "crypto", "bls.py"),
             os.path.join(root, _PKG, "utils", "trace.py"),
+            # the columnar engine keeps process-wide state (one-shot
+            # fallback events, the preparer registry) — lock-checked
+            os.path.join(root, _PKG, "models", "ops_vector.py"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
@@ -76,6 +85,7 @@ def run(
         mutation.analyze(targets["mutation_paths"], root, targets["core_path"])
     )
     findings.extend(concurrency.analyze(targets["concurrency_paths"], root))
+    findings.extend(aliasflow.analyze(targets["mutation_paths"], root))
 
     if paths:
         wanted = [

@@ -1,0 +1,270 @@
+"""Alias-dataflow purity analyzer (the ROADMAP-noted gap).
+
+The mutation analyzer catches writes that bypass the instrumented
+surface *syntactically* (``list.append(x, v)``). What it could not see
+is a buffer that leaks ACROSS an aliasing boundary and is then mutated
+through the stale alias — the write itself looks perfectly sanctioned.
+Two concrete shapes, both per-function dataflow over the AST:
+
+* ``aliasflow/detached-store-mutation`` — a local name is stored into a
+  container field (``state.field = xs``) and then mutated through the
+  ORIGINAL name::
+
+      scores = [0] * n
+      state.inactivity_scores = scores
+      scores[3] = 5          # LOST: the container wrapped a COPY
+
+  ``Container.__setattr__`` wraps a plain list into a fresh
+  ``CachedRootList`` (ssz/core.py), so the retained alias no longer
+  writes through — the mutation silently diverges from the state. A
+  rebind of the name after the store clears the taint; receivers named
+  ``self``/``cls`` are exempt (plain instance attributes, not SSZ
+  fields), as are underscore-prefixed attributes (memo idiom).
+
+* ``aliasflow/column-buffer-mutation`` — a backing buffer obtained from
+  the registry-column cache (``models/ops_vector.py``: ``columns_for``,
+  ``validator_columns``, ``list_column``, ``withdrawal_columns``,
+  ``pack_registry``/``pack_registry_cached``) is mutated in place::
+
+      packed = pack_registry_cached(state, prev)
+      packed["balances"][i] = 0     # corrupts the shared cache
+
+  The cache hands out views of its delta-maintained arrays; in-place
+  mutation corrupts every later consumer without tripping any runtime
+  guard on platforms where the read-only flag is circumvented (object
+  dtype fallbacks, ``.base`` access). Taint propagates through plain
+  aliasing and subscripts; an intervening ``.copy()`` produces a clean
+  buffer and clears it.
+
+Both rules walk statements in source order inside each function, so a
+mutation BEFORE the store/escape never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .base import Finding, SourceModule
+
+# the registry-column cache surface (models/ops_vector.py) — a call to
+# any of these (bare or as a method) yields a shared backing buffer
+COLUMN_ACCESSORS = {
+    "columns_for",
+    "validator_columns",
+    "list_column",
+    "withdrawal_columns",
+    "pack_registry",
+    "pack_registry_cached",
+}
+
+# list mutator methods whose call on a detached alias silently diverges
+# (the public half of the instrumented manifest, duplicated as literals
+# so this analyzer stays manifest-independent for plain lists too)
+_LIST_MUTATOR_METHODS = {
+    "append", "extend", "insert", "pop", "remove", "clear", "sort",
+    "reverse",
+}
+
+# ndarray in-place mutator methods on a column buffer
+_NDARRAY_MUTATOR_METHODS = {"fill", "sort", "put", "partition", "setfield"}
+
+
+def _call_name(func: ast.AST) -> "str | None":
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _root_name(node: ast.AST) -> "str | None":
+    """The base Name of a Subscript/Attribute chain (``x[0]["k"]`` → x)."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _FunctionFlow(ast.NodeVisitor):
+    """Statement-ordered dataflow over ONE function body."""
+
+    def __init__(self, analyzer, qualname: str):
+        self.analyzer = analyzer
+        self.qualname = qualname
+        # name -> store line (detached-alias rule)
+        self.stored: dict = {}
+        # names currently bound to a shared column buffer
+        self.column_taint: set = set()
+
+    # -- taint helpers -------------------------------------------------------
+    def _value_is_column_source(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.Call):
+            name = _call_name(value.func)
+            return name in COLUMN_ACCESSORS
+        if isinstance(value, ast.Subscript):
+            return self._value_is_column_source(value.value) or (
+                _root_name(value) in self.column_taint
+            )
+        if isinstance(value, ast.Name):
+            return value.id in self.column_taint
+        return False
+
+    def _value_is_clean_copy(self, value: ast.AST) -> bool:
+        return (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "copy"
+        )
+
+    # -- statements ----------------------------------------------------------
+    def visit_Assign(self, node):
+        self.generic_visit(node)  # flag mutations inside the RHS first
+        value = node.value
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                # rebind clears both taints; then re-taint as appropriate
+                self.stored.pop(target.id, None)
+                self.column_taint.discard(target.id)
+                if not self._value_is_clean_copy(
+                    value
+                ) and self._value_is_column_source(value):
+                    self.column_taint.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                # obj.field = name — the container wraps a COPY of a plain
+                # list; the retained name becomes a detached alias
+                if (
+                    isinstance(value, ast.Name)
+                    and not target.attr.startswith("_")
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id not in ("self", "cls")
+                ):
+                    self.stored[value.id] = node.lineno
+            elif isinstance(target, ast.Subscript):
+                self._check_subscript_write(target, node.lineno)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        target = node.target
+        if isinstance(target, ast.Subscript):
+            self._check_subscript_write(target, node.lineno)
+        elif isinstance(target, ast.Name):
+            # x += [...] on a detached alias is an in-place extend
+            if target.id in self.stored:
+                self._flag_detached(target.id, node.lineno)
+
+    def visit_Delete(self, node):
+        self.generic_visit(node)
+        for target in node.targets:
+            if isinstance(target, ast.Subscript):
+                self._check_subscript_write(target, node.lineno)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        func = node.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            name = func.value.id
+            if name in self.stored and func.attr in _LIST_MUTATOR_METHODS:
+                self._flag_detached(name, node.lineno)
+            if name in self.column_taint and func.attr in _NDARRAY_MUTATOR_METHODS:
+                self._flag_column(name, node.lineno)
+
+    # nested defs get their own flow (fresh scope)
+    def visit_FunctionDef(self, node):
+        self.analyzer._analyze_function(node, f"{self.qualname}.{node.name}")
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.analyzer._analyze_function(
+                    item, f"{self.qualname}.{node.name}.{item.name}"
+                )
+
+    # -- flagging ------------------------------------------------------------
+    def _check_subscript_write(self, target: ast.Subscript, line: int) -> None:
+        root = _root_name(target)
+        if root is None:
+            return
+        if root in self.stored:
+            self._flag_detached(root, line)
+        if root in self.column_taint:
+            self._flag_column(root, line)
+
+    def _flag_detached(self, name: str, line: int) -> None:
+        self.analyzer.findings.append(
+            Finding(
+                rule="aliasflow/detached-store-mutation",
+                path=self.analyzer.path,
+                line=line,
+                symbol=self.qualname,
+                message=(
+                    f"`{name}` was stored into a container field (line "
+                    f"{self.stored[name]}) and is mutated afterwards — the "
+                    "container wrapped a COPY (CachedRootList), so this "
+                    "write does not reach the SSZ value"
+                ),
+                hint=(
+                    "mutate through the container field "
+                    "(`state.<field>[...] = ...`), or store the name only "
+                    "after the last mutation"
+                ),
+            )
+        )
+        self.stored.pop(name, None)  # one finding per alias
+
+    def _flag_column(self, name: str, line: int) -> None:
+        self.analyzer.findings.append(
+            Finding(
+                rule="aliasflow/column-buffer-mutation",
+                path=self.analyzer.path,
+                line=line,
+                symbol=self.qualname,
+                message=(
+                    f"`{name}` aliases a registry-column cache buffer "
+                    "(models/ops_vector.py) and is mutated in place — the "
+                    "delta-maintained cache would serve corrupted columns "
+                    "to every later consumer"
+                ),
+                hint="take a `.copy()` of the column before mutating it",
+            )
+        )
+        self.column_taint.discard(name)
+
+
+class _ModuleAnalyzer:
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+
+    def _analyze_function(self, node, qualname: str) -> None:
+        flow = _FunctionFlow(self, qualname)
+        for stmt in node.body:
+            flow.visit(stmt)
+
+    def analyze_module(self, tree: ast.Module) -> None:
+        for item in tree.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(item, item.name)
+            elif isinstance(item, ast.ClassDef):
+                for sub in item.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._analyze_function(
+                            sub, f"{item.name}.{sub.name}"
+                        )
+
+
+def analyze_file(abspath: str, root: str) -> list[Finding]:
+    src = SourceModule.load(abspath, root)
+    analyzer = _ModuleAnalyzer(src.path)
+    analyzer.analyze_module(src.tree)
+    return analyzer.findings
+
+
+def analyze(paths: list, root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in paths:
+        findings.extend(analyze_file(os.path.abspath(path), root))
+    return findings
