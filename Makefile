@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff pipeline-selfcheck trace metrics
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff chaos pipeline-selfcheck trace metrics
 
 help:  ## list targets
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
@@ -28,8 +28,11 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block, columnar engine must engage
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py -q -m bench_smoke
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + the scenario smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_scenarios.py -q -m 'bench_smoke or chaos_smoke'
+
+chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundary chain (minutes)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q -m chaos_smoke
 
 bench-diff:  ## per-phase diff of two bench evidence files: make bench-diff A=old.json B=new.json
 	$(PY) bench_compare.py $(A) $(B)

@@ -849,7 +849,7 @@ def _verify_pool():
 
 
 def verify_signature_sets_async(
-    sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None
+    sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None, pre=None
 ):
     """Dispatch one batched verification to the background verifier thread;
     returns a ``concurrent.futures.Future[list[bool]]``.
@@ -859,7 +859,10 @@ def verify_signature_sets_async(
     entry that releases the GIL for its whole duration, so the overlap is
     real CPU parallelism, not just interleaving. ``timer``, if given, is
     called on the worker with the verification's duration in seconds —
-    the pipeline's stage-occupancy probe."""
+    the pipeline's stage-occupancy probe. ``pre``, if given, runs on the
+    worker immediately before verification (the pipeline's fault-injection
+    seam, pipeline/faults.py); anything it raises surfaces through the
+    future exactly as a real worker fault would."""
     sets = list(sets)
 
     def run() -> list[bool]:
@@ -867,6 +870,8 @@ def verify_signature_sets_async(
 
         t0 = _time.perf_counter()
         try:
+            if pre is not None:
+                pre()
             # the span lands on the verifier thread's lane, so a recorded
             # pipeline run shows stage B as its own Perfetto track
             with trace.span("pipeline.flush.verify", sets=len(sets)):

@@ -89,16 +89,28 @@ def state_transition_block_in_slot_generic(
                 # arithmetic guard): earlier call sites' signatures first
                 batch.raise_if_any_invalid()
                 raise
+            if validation is Validation.ENABLED:
+                with trace.span(
+                    "transition.state_htr", slot=int(block.slot)
+                ):
+                    state_root = type(state).hash_tree_root(state)
+                if block.state_root != state_root:
+                    # sequentially this block's signature claims verify
+                    # (the flush) BEFORE the root check, so a bad
+                    # signature earlier in the block preempts the root
+                    # error. Under the pipeline's cross-block sink the
+                    # flush would defer — re-check the collected sets
+                    # NOW so the attribution matches the sequential
+                    # path (a corrupted body usually breaks both: the
+                    # body root shifts the header AND the claim it
+                    # carried is the actually-invalid thing).
+                    batch.raise_if_any_invalid()
+                    raise InvalidStateRoot(block.state_root, state_root)
             # under the pipeline's defer_flushes this drains to the
             # cross-block sink in ~0 time — the verification cost then
             # shows up as stage B's pipeline.flush.verify span instead
             with trace.span("transition.sig_batch", sets=len(batch)):
                 batch.flush()
-        if validation is Validation.ENABLED:
-            with trace.span("transition.state_htr", slot=int(block.slot)):
-                state_root = type(state).hash_tree_root(state)
-            if block.state_root != state_root:
-                raise InvalidStateRoot(block.state_root, state_root)
 
 
 def state_transition_generic(

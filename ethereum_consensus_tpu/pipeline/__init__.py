@@ -16,15 +16,20 @@ the device pairing route engages underneath ``crypto.bls`` exactly when
 ``ops.install()`` has routed it.
 """
 
-from .engine import ChainPipeline, PipelineBrokenError
+from .engine import ChainPipeline
+from .errors import PipelineBrokenError, TransientFlushError, WorkerKilled
+from .faults import FaultInjector
 from .scheduler import FlushPolicy, VerifyScheduler, Window
 from .stats import PipelineStats
 
 __all__ = [
     "ChainPipeline",
+    "FaultInjector",
     "FlushPolicy",
     "PipelineBrokenError",
     "PipelineStats",
+    "TransientFlushError",
     "VerifyScheduler",
     "Window",
+    "WorkerKilled",
 ]

@@ -42,6 +42,15 @@ failing set's structured error — the same exception the sequential path
 raises. Semantics match the sequential Executor observably: identical
 final state bit-for-bit on success, the same structured error and a
 coherent last-committed state on failure.
+
+Fault hardening (docs/SCENARIOS.md): every settle wait is bounded by
+``FlushPolicy.settle_timeout_s`` — a wedged verifier raises
+``PipelineBrokenError`` with the stuck window's attribution and the
+state restored to the last committed position, never a deadlock.
+Transient flush faults retry with bounded backoff; a dead worker
+degrades the window to in-line host verification (scheduler.py). An
+optional ``fault_injector`` (faults.FaultInjector) drives these paths
+deterministically for the scenario harness.
 """
 
 from __future__ import annotations
@@ -52,15 +61,11 @@ from ..error import Error
 from ..models.signature_batch import SignatureBatch, defer_flushes
 from ..models.transition import Validation
 from ..utils import trace
+from .errors import PipelineBrokenError
 from .scheduler import FlushPolicy, VerifyScheduler, Window
 from .stats import PipelineStats
 
 __all__ = ["ChainPipeline", "PipelineBrokenError"]
-
-
-class PipelineBrokenError(RuntimeError):
-    """The pipeline already failed (the structured error was raised at the
-    failure point) or was aborted; it accepts no further blocks."""
 
 
 class _Entry:
@@ -98,12 +103,15 @@ class ChainPipeline:
         policy: FlushPolicy | None = None,
         validation: Validation = Validation.ENABLED,
         stats: PipelineStats | None = None,
+        fault_injector=None,
     ):
         self._executor = executor
         self.policy = policy or FlushPolicy()
         self._validation = validation
         self.stats = stats or PipelineStats()
-        self._sched = VerifyScheduler(self.policy, self.stats)
+        self._sched = VerifyScheduler(
+            self.policy, self.stats, fault_injector=fault_injector
+        )
         self._pending: list[_Entry] = []
         # committed position = checkpoint + proven blocks since it
         self._checkpoint = executor.state.copy()
@@ -235,7 +243,18 @@ class ChainPipeline:
         self._sched.dispatch(window)
 
     def _settle_oldest(self) -> None:
-        window, verdicts = self._sched.settle_oldest()
+        try:
+            window, verdicts = self._sched.settle_oldest()
+        except PipelineBrokenError as exc:
+            # a bounded settle expired (verifier wedged): abandon every
+            # in-flight window, restore the committed position, and break
+            # the pipeline — the submitter gets attribution, not a hang
+            self._sched.drop_all()
+            self._pending.clear()
+            self._materialize_committed()
+            self._broken = exc
+            self.stats.stop()
+            raise
         if all(verdicts):
             self._commit(window.entries, window.post_state)
             return

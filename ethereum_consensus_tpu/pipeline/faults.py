@@ -1,0 +1,125 @@
+"""FaultInjector — deterministic infrastructure-fault injection for the
+chain pipeline (the scenario harness's stage-B chaos hook).
+
+The injector owns a per-(window seq, attempt) fault plan; the scheduler
+asks it for a hook before every flush dispatch and runs that hook ON THE
+VERIFIER WORKER immediately before verification (the ``pre`` parameter
+of ``bls.verify_signature_sets_async``), so an injected fault surfaces
+exactly where a real one would: inside the flush future.
+
+Three fault shapes, matching the hardening they exercise
+(scheduler.settle_oldest):
+
+* ``fail_flush(seq, times)``  — ``TransientFlushError`` on the first
+  ``times`` attempts of window ``seq``; the scheduler retries with
+  bounded backoff (``FlushPolicy.flush_retries``) and the flush succeeds
+  once the plan is exhausted.
+* ``kill_worker(seq)``        — ``WorkerKilled`` from the worker
+  mid-flush; the scheduler detects the death and degrades that window to
+  in-line host verification (no hang, verdicts still exact).
+* ``delay_flush(seq, s)``     — the worker sleeps ``s`` seconds before
+  verifying; with ``s`` beyond ``FlushPolicy.settle_timeout_s`` the
+  bounded settle raises ``PipelineBrokenError`` with the stuck window's
+  attribution instead of deadlocking the submitter.
+
+Thread-safety: the plan is written from the test/driver thread and read
+from both the engine thread (hook_for) and the worker (the hook itself);
+every access holds the instance lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..telemetry import metrics
+from ..utils import trace
+from .errors import TransientFlushError, WorkerKilled
+
+__all__ = ["FaultInjector"]
+
+
+class FaultInjector:
+    """Deterministic per-window fault plan for the verify scheduler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._transient: dict = {}   # seq -> remaining failures
+        self._kill: set = set()      # seqs whose worker dies mid-flush
+        self._delay: dict = {}       # seq -> seconds of worker stall
+        self._injected: list = []    # (seq, attempt, kind) audit log
+
+    # -- plan construction (driver side) -------------------------------------
+    def fail_flush(self, seq: int, times: int = 1) -> "FaultInjector":
+        """Raise ``TransientFlushError`` on the first ``times`` verify
+        attempts of window ``seq``."""
+        with self._lock:
+            self._transient[seq] = times
+        return self
+
+    def kill_worker(self, seq: int) -> "FaultInjector":
+        """Kill the verifier worker mid-flush on window ``seq`` (every
+        attempt — a dead worker stays dead)."""
+        with self._lock:
+            self._kill.add(seq)
+        return self
+
+    def delay_flush(self, seq: int, seconds: float) -> "FaultInjector":
+        """Stall the worker ``seconds`` before verifying window ``seq``."""
+        with self._lock:
+            self._delay[seq] = float(seconds)
+        return self
+
+    @property
+    def injected(self) -> list:
+        """(seq, attempt, kind) tuples, in injection order."""
+        with self._lock:
+            return list(self._injected)
+
+    # -- hook resolution (scheduler side) ------------------------------------
+    def hook_for(self, seq: int, attempt: int):
+        """The pre-verify hook to run on the worker for this (window,
+        attempt), or None when no fault is planned. The hook itself
+        consumes the plan entry, so a retry of the same window re-asks
+        and gets the NEXT planned behavior."""
+        with self._lock:
+            armed = (
+                seq in self._kill
+                or seq in self._delay
+                or self._transient.get(seq, 0) > 0
+            )
+        if not armed:
+            return None
+
+        def fire() -> None:
+            with self._lock:
+                delay = self._delay.get(seq)
+                kill = seq in self._kill
+                remaining = self._transient.get(seq, 0)
+                if remaining > 0:
+                    self._transient[seq] = remaining - 1
+                kind = (
+                    "delay" if delay else
+                    "worker_death" if kill else
+                    "transient" if remaining > 0 else None
+                )
+                if kind is not None:
+                    self._injected.append((seq, attempt, kind))
+            if kind is None:
+                return
+            metrics.counter(f"pipeline.fault.injected.{kind}").inc()
+            trace.event(
+                "pipeline.fault.injected",
+                seq=seq, attempt=attempt, kind=kind,
+            )
+            if delay:
+                time.sleep(delay)
+            if kill:
+                raise WorkerKilled(f"injected worker death (window {seq})")
+            if remaining > 0:
+                raise TransientFlushError(
+                    f"injected transient flush fault (window {seq}, "
+                    f"attempt {attempt})"
+                )
+
+        return fire

@@ -18,13 +18,29 @@ forks, or rollback (engine.py's job). Three rules:
   unverified speculative state in memory).
 * **Order**: windows settle strictly FIFO (the verifier pool is a single
   worker), so chain order and commit order agree by construction.
+
+Hardening (the scenario harness's fault targets, docs/SCENARIOS.md):
+every settle is TIMEOUT-BOUNDED (``FlushPolicy.settle_timeout_s``; a
+wedged worker raises ``PipelineBrokenError`` carrying the stuck window's
+attribution instead of deadlocking the submitter), a
+``TransientFlushError`` from the worker is retried with bounded backoff
+(``flush_retries`` × ``retry_backoff_s``), and a worker death or any
+other non-verdict crash degrades THAT window to in-line host
+verification — the verdicts stay exact, only the overlap is lost. Every
+path is counted (``pipeline.fault.*``, ``pipeline.degraded_flushes``)
+and the process-wide ``pipeline.degraded`` gauge latches once any
+window degraded.
 """
 
 from __future__ import annotations
 
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+
 from ..crypto import bls
-from ..models.signature_batch import SignatureBatch
+from ..telemetry import metrics as _metrics
 from ..utils import trace
+from .errors import PipelineBrokenError, TransientFlushError, WorkerKilled
 from .stats import PipelineStats
 
 __all__ = ["FlushPolicy", "VerifyScheduler", "Window"]
@@ -48,30 +64,53 @@ class FlushPolicy:
     * ``flush_empty`` — whether windows whose blocks deferred zero sets
       (Validation.DISABLED replay) still pass through the scheduler; off
       by default, they commit immediately.
+    * ``settle_timeout_s`` — the bound on every settle wait: a window
+      whose future hasn't resolved after this long raises
+      ``PipelineBrokenError`` with the window's attribution. None
+      disables the bound (NOT recommended — a wedged worker then hangs
+      the submitter forever, which is exactly the failure mode this
+      exists to close).
+    * ``flush_retries`` — how many times a ``TransientFlushError`` from
+      the worker is re-dispatched before the window degrades to in-line
+      verification.
+    * ``retry_backoff_s`` — base backoff before retry k (linear:
+      ``k * retry_backoff_s``), bounding total stall to
+      ``flush_retries * (flush_retries + 1) / 2 * retry_backoff_s``.
     """
 
     __slots__ = (
-        "window_size", "max_in_flight", "checkpoint_interval", "flush_empty"
+        "window_size", "max_in_flight", "checkpoint_interval", "flush_empty",
+        "settle_timeout_s", "flush_retries", "retry_backoff_s",
     )
 
     def __init__(self, window_size: int = 8, max_in_flight: int = 2,
-                 checkpoint_interval: int = 8, flush_empty: bool = False):
+                 checkpoint_interval: int = 8, flush_empty: bool = False,
+                 settle_timeout_s: "float | None" = 300.0,
+                 flush_retries: int = 2, retry_backoff_s: float = 0.05):
         if window_size < 1:
             raise ValueError("window_size must be >= 1")
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1")
         if checkpoint_interval < 1:
             raise ValueError("checkpoint_interval must be >= 1")
+        if settle_timeout_s is not None and settle_timeout_s <= 0:
+            raise ValueError("settle_timeout_s must be positive or None")
+        if flush_retries < 0:
+            raise ValueError("flush_retries must be >= 0")
         self.window_size = window_size
         self.max_in_flight = max_in_flight
         self.checkpoint_interval = checkpoint_interval
         self.flush_empty = flush_empty
+        self.settle_timeout_s = settle_timeout_s
+        self.flush_retries = flush_retries
+        self.retry_backoff_s = retry_backoff_s
 
     def __repr__(self) -> str:
         return (
             f"FlushPolicy(window_size={self.window_size}, "
             f"max_in_flight={self.max_in_flight}, "
-            f"checkpoint_interval={self.checkpoint_interval})"
+            f"checkpoint_interval={self.checkpoint_interval}, "
+            f"settle_timeout_s={self.settle_timeout_s})"
         )
 
 
@@ -80,24 +119,28 @@ class Window:
     signature batch, and — on checkpoint-carrying windows — the
     post-window state snapshot the engine installs as the new checkpoint
     when the verdicts come back clean (``post_state`` is None
-    otherwise; the committed position is then checkpoint + blocks)."""
+    otherwise; the committed position is then checkpoint + blocks).
+    ``attempts`` counts dispatches (retries of transient faults)."""
 
-    __slots__ = ("entries", "batch", "post_state", "future", "seq")
+    __slots__ = ("entries", "batch", "post_state", "future", "seq", "attempts")
 
-    def __init__(self, entries, batch: SignatureBatch, post_state, seq: int):
+    def __init__(self, entries, batch, post_state, seq: int):
         self.entries = entries
         self.batch = batch
         self.post_state = post_state
         self.future = None
         self.seq = seq
+        self.attempts = 0
 
 
 class VerifyScheduler:
     """Bounded FIFO dispatch onto the shared background verifier."""
 
-    def __init__(self, policy: FlushPolicy, stats: PipelineStats):
+    def __init__(self, policy: FlushPolicy, stats: PipelineStats,
+                 fault_injector=None):
         self.policy = policy
         self.stats = stats
+        self.fault_injector = fault_injector
         self._in_flight: list[Window] = []
 
     # -- queue state ---------------------------------------------------------
@@ -114,6 +157,28 @@ class VerifyScheduler:
         return not self._in_flight
 
     # -- dispatch / settle ---------------------------------------------------
+    def _window_slots(self, window: Window) -> tuple:
+        return tuple(
+            e.slot for e in window.entries if getattr(e, "slot", None) is not None
+        )
+
+    def _submit(self, window: Window) -> None:
+        """One verify dispatch of ``window`` (initial or retry). A failed
+        SUBMIT (the pool itself is gone — interpreter shutdown, a test
+        tore the pool down) degrades immediately: the overlap is
+        unavailable, the verdicts must not be."""
+        pre = None
+        if self.fault_injector is not None:
+            pre = self.fault_injector.hook_for(window.seq, window.attempts)
+        window.attempts += 1
+        try:
+            window.future = bls.verify_signature_sets_async(
+                window.batch.sets, timer=self.stats.stage_b_busy, pre=pre
+            )
+        except RuntimeError:
+            _metrics.counter("pipeline.fault.dispatch_failure").inc()
+            window.future = _InlineFuture(self._verify_inline(window))
+
     def dispatch(self, window: Window) -> None:
         """Queue one window onto the verifier. The caller must have made
         room (``not full``) by settling the oldest window first."""
@@ -130,22 +195,97 @@ class VerifyScheduler:
             sets=n_sets,
             in_flight=len(self._in_flight) + 1,
         )
-        window.future = bls.verify_signature_sets_async(
-            window.batch.sets, timer=self.stats.stage_b_busy
-        )
+        self._submit(window)
         self._in_flight.append(window)
         self.stats.flush_dispatched(n_sets)
         self.stats.queue_depth(len(self._in_flight))
 
+    def _verify_inline(self, window: Window) -> "list[bool]":
+        """Graceful degradation: prove the window's sets on THIS thread
+        (the same host verification the sequential path runs). Verdicts
+        and attribution are exactly what the worker would have produced;
+        only the stage overlap is lost — which the latched
+        ``pipeline.degraded`` gauge makes visible."""
+        # the stats mutator owns the pipeline.degraded_flushes registry
+        # counter; only the latched gauge is set here
+        _metrics.gauge("pipeline.degraded").set(1)
+        self.stats.degraded_flush()
+        trace.event(
+            "pipeline.degraded", seq=window.seq, sets=len(window.batch)
+        )
+        with trace.span("pipeline.flush.verify_inline", seq=window.seq):
+            return bls.verify_signature_sets(window.batch.sets)
+
     def settle_oldest(self) -> "tuple[Window, list[bool]]":
         """Block until the oldest in-flight window's verdicts are in;
-        returns (window, per-set verdicts in call-site order)."""
+        returns (window, per-set verdicts in call-site order).
+
+        Bounded and fault-hardened: a worker stuck past
+        ``settle_timeout_s`` raises ``PipelineBrokenError`` with the
+        window's attribution; a ``TransientFlushError`` re-dispatches up
+        to ``flush_retries`` times with linear backoff; a worker death
+        (or any other non-verdict crash) falls back to in-line host
+        verification on this thread."""
         if not self._in_flight:
             raise RuntimeError("settle_oldest with nothing in flight")
         window = self._in_flight.pop(0)
+        policy = self.policy
         with trace.span("pipeline.flush.settle", seq=window.seq):
-            verdicts = window.future.result()
-        return window, verdicts
+            while True:
+                try:
+                    verdicts = window.future.result(
+                        timeout=policy.settle_timeout_s
+                    )
+                    return window, verdicts
+                except (_FutureTimeout, TimeoutError):
+                    _metrics.counter("pipeline.fault.settle_timeout").inc()
+                    window.future.cancel()
+                    slots = self._window_slots(window)
+                    raise PipelineBrokenError(
+                        f"flush window {window.seq} (slots {list(slots)}, "
+                        f"{len(window.batch)} sets) did not settle within "
+                        f"{policy.settle_timeout_s}s — verifier wedged; "
+                        "the pipeline is broken, the state is at the last "
+                        "committed position",
+                        window_seq=window.seq,
+                        slots=slots,
+                    ) from None
+                except TransientFlushError as exc:
+                    _metrics.counter("pipeline.fault.transient").inc()
+                    if window.attempts > policy.flush_retries:
+                        # retries exhausted: the fault is persistent —
+                        # degrade this window rather than fail the chain
+                        trace.event(
+                            "pipeline.fault.retries_exhausted",
+                            seq=window.seq,
+                            attempts=window.attempts,
+                            error=repr(exc),
+                        )
+                        return window, self._verify_inline(window)
+                    _metrics.counter("pipeline.fault.retries").inc()
+                    self.stats.fault_retry()
+                    backoff = window.attempts * policy.retry_backoff_s
+                    trace.event(
+                        "pipeline.fault.retry",
+                        seq=window.seq,
+                        attempt=window.attempts,
+                        backoff_s=backoff,
+                    )
+                    if backoff > 0:
+                        time.sleep(backoff)
+                    self._submit(window)
+                except (WorkerKilled, Exception) as exc:  # noqa: BLE001
+                    # worker death or an unexpected crash: NOT a verdict
+                    # (structured consensus errors never propagate through
+                    # the future — verify returns verdict lists), so the
+                    # sound recovery is to re-verify in-line right here
+                    _metrics.counter("pipeline.fault.worker_death").inc()
+                    trace.event(
+                        "pipeline.fault.worker_death",
+                        seq=window.seq,
+                        error=repr(exc),
+                    )
+                    return window, self._verify_inline(window)
 
     def drop_all(self) -> None:
         """Abandon every in-flight window (rollback path): the futures
@@ -153,3 +293,20 @@ class VerifyScheduler:
         FIFO order, and a later submit would queue behind them anyway —
         but their verdicts are no longer consulted."""
         self._in_flight.clear()
+
+
+class _InlineFuture:
+    """A pre-resolved future for the dispatch-failure degradation path:
+    quacks like ``concurrent.futures.Future`` for the one consumer
+    (``settle_oldest``)."""
+
+    __slots__ = ("_verdicts",)
+
+    def __init__(self, verdicts):
+        self._verdicts = verdicts
+
+    def result(self, timeout=None):
+        return self._verdicts
+
+    def cancel(self) -> bool:
+        return False

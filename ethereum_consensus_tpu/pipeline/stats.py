@@ -56,6 +56,8 @@ _COUNTER_NAMES = (
     "rollbacks",
     "sequential_reverifies",
     "checkpoints",
+    "fault_retries",
+    "degraded_flushes",
     "stage_a_s",
     "stage_b_s",
 )
@@ -143,6 +145,14 @@ class PipelineStats:
         return self._view("checkpoints")
 
     @property
+    def fault_retries(self) -> int:
+        return self._view("fault_retries")
+
+    @property
+    def degraded_flushes(self) -> int:
+        return self._view("degraded_flushes")
+
+    @property
     def stage_a_s(self) -> float:
         return self._view("stage_a_s")
 
@@ -186,6 +196,12 @@ class PipelineStats:
     def sequential_reverify(self) -> None:
         self._counters["sequential_reverifies"].inc()
 
+    def fault_retry(self) -> None:
+        self._counters["fault_retries"].inc()
+
+    def degraded_flush(self) -> None:
+        self._counters["degraded_flushes"].inc()
+
     def queue_depth(self, depth: int) -> None:
         self._queue_gauge.update_max(depth)
         with self._lock:
@@ -222,6 +238,8 @@ class PipelineStats:
             "rollbacks": self.rollbacks,
             "sequential_reverifies": self.sequential_reverifies,
             "checkpoints": self.checkpoints,
+            "fault_retries": self.fault_retries,
+            "degraded_flushes": self.degraded_flushes,
             "stage_a_s": stage_a,
             "stage_b_s": stage_b,
             "wall_s": wall,

@@ -1,0 +1,85 @@
+"""Adversarial scenario harness — fault-injected pipeline replay verified
+against the sequential scalar executor (docs/SCENARIOS.md).
+
+Five scenario families (families.py) drive the chain pipeline through
+hostile chains and injected infrastructure failures:
+
+1. **fork-boundary replay** — one chain crossing all five fork
+   boundaries (phase0→…→electra) with attestation + withdrawal traffic
+   live at every edge;
+2. **invalid-block storms** — a mutator library (mutators.py) corrupts
+   a configurable fraction of a chain; every failure must roll back to
+   the committed position with the mutator's exact structured error;
+3. **equivocation traffic** — duplicate and intersecting attestation
+   aggregates shaped like mainnet gossip;
+4. **deep reorg / checkpoint-restore** — resume from an earlier
+   checkpoint and replay a divergent branch, column caches traveling
+   copy-on-write;
+5. **infrastructure faults** — a ``pipeline.FaultInjector`` kills the
+   verifier worker mid-flush, delays a flush past its deadline, or
+   raises transient errors; the hardened pipeline retries, degrades to
+   in-line verification, or raises ``PipelineBrokenError`` with exact
+   attribution — never hangs.
+
+The assertion core is harness.py: ``run_storm``, ``oracle_replay``,
+``assert_bit_identical``, ``assert_column_consistency``. Everything is
+host-only and jax-free, like ``pipeline/``.
+"""
+
+from .harness import (
+    StormFailure,
+    StormReport,
+    assert_bit_identical,
+    assert_column_consistency,
+    build_corrupted_stream,
+    forced_columnar,
+    oracle_replay,
+    run_storm,
+    scalar_mode,
+)
+from .mutators import (
+    MUTATORS,
+    BlockMutator,
+    MutationEnv,
+    bad_attestation_signature,
+    bad_proposer_signature,
+    bad_state_root,
+    future_slot,
+    malformed_operation,
+    plan_storm,
+)
+from .families import (
+    FAMILIES,
+    deep_reorg_checkpoint_restore,
+    equivocation_traffic,
+    fork_boundary_replay,
+    infrastructure_faults,
+    invalid_block_storm,
+)
+
+__all__ = [
+    "BlockMutator",
+    "FAMILIES",
+    "MUTATORS",
+    "MutationEnv",
+    "StormFailure",
+    "StormReport",
+    "assert_bit_identical",
+    "assert_column_consistency",
+    "bad_attestation_signature",
+    "bad_proposer_signature",
+    "bad_state_root",
+    "build_corrupted_stream",
+    "deep_reorg_checkpoint_restore",
+    "equivocation_traffic",
+    "forced_columnar",
+    "fork_boundary_replay",
+    "future_slot",
+    "infrastructure_faults",
+    "invalid_block_storm",
+    "malformed_operation",
+    "oracle_replay",
+    "plan_storm",
+    "run_storm",
+    "scalar_mode",
+]

@@ -1,0 +1,396 @@
+"""The five scenario families (docs/SCENARIOS.md).
+
+Each family is one callable that builds (or loads — the chains are
+disk-cached by ``tests/chain_utils.py`` with scenario parameters in the
+key) its hostile chain, drives the pipeline through it, and asserts the
+harness contract: bit-identical committed state vs the sequential
+scalar executor, exact structured-error blame, and column-cache
+consistency — after every recovery, at every fork edge.
+
+Chain scaffolding (keys, block production) lives in the repo checkout's
+``tests/chain_utils.py``; the families resolve it the same way the
+pipeline selfcheck does and fail with a clear message outside a
+checkout. Every family bumps a ``scenario.<family>.runs`` counter, so a
+bench/smoke run's metrics block shows which families actually executed.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+from pathlib import Path
+
+from ..executor import Executor
+from ..pipeline import (
+    ChainPipeline,
+    FaultInjector,
+    FlushPolicy,
+    PipelineBrokenError,
+)
+from ..telemetry import metrics
+from .harness import (
+    assert_bit_identical,
+    assert_column_consistency,
+    forced_columnar,
+    oracle_replay,
+    run_storm,
+)
+from .mutators import MUTATORS, plan_storm
+
+__all__ = [
+    "fork_boundary_replay",
+    "invalid_block_storm",
+    "equivocation_traffic",
+    "deep_reorg_checkpoint_restore",
+    "infrastructure_faults",
+    "FAMILIES",
+]
+
+
+def _chain_utils():
+    """tests/chain_utils.py — importable from a repo checkout only (the
+    pipeline selfcheck's convention, pipeline/__main__.py)."""
+    try:
+        import chain_utils  # noqa: F401 — already on sys.path (pytest)
+
+        return chain_utils
+    except ImportError:
+        pass
+    tests_dir = Path(__file__).resolve().parents[2] / "tests"
+    if (tests_dir / "chain_utils.py").is_file():
+        sys.path.insert(0, str(tests_dir))
+        import chain_utils
+
+        return chain_utils
+    raise RuntimeError(
+        "scenario families need the repo checkout's tests/chain_utils.py "
+        "chain scaffolding (keys + block production); it is not part of "
+        "the installed package"
+    )
+
+
+def _root(state) -> bytes:
+    data = getattr(state, "data", state)
+    return type(data).hash_tree_root(data)
+
+
+# ---------------------------------------------------------------------------
+# family 1 — full phase0→electra upgrade replay
+# ---------------------------------------------------------------------------
+
+
+def fork_boundary_replay(validator_count: int = 64, atts_per_block: int = 2,
+                         policy: "FlushPolicy | None" = None) -> dict:
+    """One chain through ALL FIVE fork boundaries under the pipeline,
+    attestation + withdrawal traffic live at every edge, with column and
+    participation-rotation consistency asserted at each boundary block
+    and bit-identity against the scalar oracle at the electra head."""
+    cu = _chain_utils()
+    state, ctx, blocks = cu.produce_full_upgrade_chain(
+        validator_count, atts_per_block
+    )
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    edges = {
+        int(getattr(ctx, f"{fork}_fork_epoch")) * spe
+        for fork in cu.FULL_UPGRADE_FORKS
+        if fork != "phase0"
+    }
+    oracle_ex, _ = oracle_replay(state, ctx, blocks)
+    policy = policy or FlushPolicy(window_size=4, max_in_flight=2,
+                                   checkpoint_interval=2)
+    edge_checks = 0
+    with forced_columnar():
+        ex = Executor(state.copy(), ctx)
+        pipe = ChainPipeline(ex, policy=policy)
+        for block in blocks:
+            pipe.submit(block)
+            if int(block.message.slot) in edges:
+                # the first block of the new fork just applied: the
+                # boundary epoch processing AND the participation
+                # rotation ran inside this submit — the rotated lists'
+                # caches must still agree with the literal values
+                assert_column_consistency(
+                    pipe.state,
+                    where=f"fork edge, slot {int(block.message.slot)}",
+                )
+                edge_checks += 1
+        stats = pipe.close()
+    assert edge_checks == len(edges), (
+        f"expected a block exactly on each of {sorted(edges)}, "
+        f"checked {edge_checks}"
+    )
+    assert stats.rollbacks == 0
+    assert_bit_identical(ex.state, oracle_ex.state, "full-upgrade head")
+    assert_column_consistency(ex.state, "full-upgrade head")
+    metrics.counter("scenario.fork_boundary.runs").inc()
+    return {
+        "blocks": len(blocks),
+        "edges_checked": edge_checks,
+        "stats": stats.snapshot(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# family 2 — invalid-block storms
+# ---------------------------------------------------------------------------
+
+
+def invalid_block_storm(fork: str = "deneb", validator_count: int = 64,
+                        n_blocks: int = 12, fraction: float = 0.25,
+                        seed: int = 0, mutators=None,
+                        policy: "FlushPolicy | None" = None,
+                        plan: "dict | None" = None):
+    """A chain with ``fraction`` of its blocks corrupted (all five
+    mutators round-robin unless narrowed), replayed through the pipeline
+    with recovery and the full harness contract after every failure.
+    Pass an explicit ``plan`` ({index: mutator}) to pin a storm
+    geometry (first/mid/last in window, two in one flush, checkpoint
+    edge). Returns (StormReport, final executor)."""
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork(fork, validator_count, "minimal")
+    blocks = cu.produce_chain(state, ctx, n_blocks, fork_name=fork,
+                              atts_per_block=1)
+    if plan is None:
+        plan = plan_storm(n_blocks, fraction, random.Random(seed),
+                          mutators or MUTATORS)
+    with forced_columnar():
+        report, ex = run_storm(
+            state, ctx, blocks, plan, policy=policy, sign=cu.sign_block
+        )
+    metrics.counter("scenario.storm_family.runs").inc()
+    return report, ex
+
+
+# ---------------------------------------------------------------------------
+# family 3 — equivocation / overlapping-aggregate traffic
+# ---------------------------------------------------------------------------
+
+
+def equivocation_traffic(fork: str = "altair", validator_count: int = 64,
+                         n_blocks: int = 4,
+                         policy: "FlushPolicy | None" = None) -> dict:
+    """Mainnet-gossip-shaped duplicate and intersecting attestation
+    aggregates: every block carries the slot's FULL aggregate, a 60%
+    sub-aggregate (intersecting signer set), and an exact duplicate of
+    the full one (zero new flags on the second pass) — the shape that
+    exercises the columnar fast path's flag-union and zero-delta
+    commits. Pipelined+columnar replay must be bit-identical to the
+    sequential scalar loop."""
+    if fork == "phase0":
+        raise ValueError("equivocation family targets the participation-"
+                         "flag forks (altair+)")
+    import importlib
+
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork(fork, validator_count, "minimal")
+    stm = importlib.import_module(
+        f"ethereum_consensus_tpu.models.{fork}.state_transition"
+    )
+    scratch = state.copy()
+    blocks = []
+    pending: list = []
+    for slot in range(1, n_blocks + 1):
+        block = cu.produce_block_fork(fork, scratch, slot, ctx,
+                                      attestations=pending)
+        # produce_block_fork already advanced scratch to the slot
+        stm.state_transition_block_in_slot(
+            scratch, block, stm.Validation.ENABLED, ctx
+        )
+        if fork == "electra":
+            full = cu.make_attestation_electra(scratch, slot, ctx)
+            sub = cu.make_attestation_electra(scratch, slot, ctx,
+                                              participation=0.6)
+        else:
+            full = cu.make_attestation(scratch, slot, 0, ctx)
+            sub = cu.make_attestation(scratch, slot, 0, ctx,
+                                      participation=0.6)
+        pending = [full, sub, full.copy()]
+        blocks.append(block)
+    assert any(len(b.message.body.attestations) >= 3 for b in blocks)
+
+    oracle_ex, _ = oracle_replay(state, ctx, blocks)
+    with forced_columnar():
+        ex = Executor(state.copy(), ctx)
+        stats = ex.stream(
+            blocks,
+            policy=policy or FlushPolicy(window_size=3, max_in_flight=2),
+        )
+        assert_column_consistency(ex.state, f"equivocation head ({fork})")
+    assert stats.rollbacks == 0
+    assert_bit_identical(ex.state, oracle_ex.state,
+                         f"equivocation head ({fork})")
+    metrics.counter("scenario.equivocation.runs").inc()
+    return {"blocks": len(blocks), "stats": stats.snapshot()}
+
+
+# ---------------------------------------------------------------------------
+# family 4 — deep reorg / checkpoint-restore
+# ---------------------------------------------------------------------------
+
+
+def deep_reorg_checkpoint_restore(fork: str = "deneb",
+                                  validator_count: int = 64,
+                                  prefix_len: int = 4, branch_len: int = 4,
+                                  policy: "FlushPolicy | None" = None) -> dict:
+    """Replay a prefix, checkpoint its committed state, extend with
+    branch A, then RESTORE the checkpoint and replay a divergent branch
+    B of the same depth — the reorg shape. Column caches must travel
+    the checkpoint copy copy-on-write: branch B's replay must not taint
+    head A (whose root is re-verified afterwards), and both heads must
+    be bit-identical to their scalar oracles and column-consistent."""
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork(fork, validator_count, "minimal")
+    prefix = cu.produce_chain(state, ctx, prefix_len, fork_name=fork,
+                              atts_per_block=1)
+    mid = state.copy()
+    with forced_columnar():
+        mid_ex = Executor(mid, ctx)
+        mid_ex.stream(prefix, policy=policy)
+    mid_state = getattr(mid_ex.state, "data", mid_ex.state)
+    # divergent bodies: branch A carries attestations, branch B does not
+    branch_a = cu.produce_chain(mid_state, ctx, branch_len, fork_name=fork,
+                                atts_per_block=1)
+    branch_b = cu.produce_chain(mid_state, ctx, branch_len, fork_name=fork,
+                                atts_per_block=0)
+    assert [bytes(b.signature) for b in branch_a] != [
+        bytes(b.signature) for b in branch_b
+    ], "branches did not diverge (attestation traffic identical)"
+
+    policy = policy or FlushPolicy(window_size=2, max_in_flight=2,
+                                   checkpoint_interval=1)
+    with forced_columnar():
+        ex = Executor(state.copy(), ctx)
+        ex.stream(prefix, policy=policy)
+        checkpoint = ex.state.copy()  # columns travel copy-on-write
+        ex.stream(branch_a, policy=policy)
+        head_a_root = _root(ex.state)
+        assert_column_consistency(ex.state, "head A")
+
+        restored = Executor(checkpoint.copy(), ctx)
+        restored.stream(branch_b, policy=policy)
+        assert_column_consistency(restored.state, "head B (post-restore)")
+        # copy-on-write isolation: replaying B through the restored
+        # checkpoint must leave head A untouched, cache included
+        assert _root(ex.state) == head_a_root, (
+            "branch B's replay tainted head A through a shared buffer"
+        )
+        assert_column_consistency(ex.state, "head A after B replay")
+
+    oracle_a, _ = oracle_replay(state, ctx, prefix + branch_a)
+    oracle_b, _ = oracle_replay(state, ctx, prefix + branch_b)
+    assert_bit_identical(ex.state, oracle_a.state, "head A vs scalar")
+    assert_bit_identical(restored.state, oracle_b.state, "head B vs scalar")
+    assert _root(ex.state) != _root(restored.state), (
+        "branches were supposed to diverge"
+    )
+    metrics.counter("scenario.reorg.runs").inc()
+    return {
+        "prefix": prefix_len,
+        "reorg_depth": branch_len,
+        "head_a": head_a_root.hex()[:16],
+        "head_b": _root(restored.state).hex()[:16],
+    }
+
+
+# ---------------------------------------------------------------------------
+# family 5 — injected infrastructure faults
+# ---------------------------------------------------------------------------
+
+
+def infrastructure_faults(validator_count: int = 64) -> dict:
+    """Drive the pipeline's fault hardening end-to-end on a real chain:
+
+    * transient flush faults retry (bounded backoff) and the replay
+      stays bit-identical with zero rollbacks;
+    * a verifier-worker death mid-flush degrades that window to in-line
+      host verification — detected, counted, still bit-identical;
+    * a flush delayed past ``settle_timeout_s`` raises
+      ``PipelineBrokenError`` carrying the stuck window's attribution,
+      with the state restored to the last committed position — never a
+      hang (the test's own bound is the policy timeout)."""
+    cu = _chain_utils()
+    state, ctx, blocks = cu.produce_multi_fork_chain(validator_count)
+    oracle_ex, _ = oracle_replay(state, ctx, blocks)
+    out: dict = {}
+
+    # transient faults: window 0 fails once, window 1 twice — both
+    # inside the retry budget
+    inj = FaultInjector().fail_flush(0, times=1).fail_flush(1, times=2)
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(
+        ex,
+        policy=FlushPolicy(window_size=3, max_in_flight=2,
+                           flush_retries=2, retry_backoff_s=0.01),
+        fault_injector=inj,
+    )
+    for block in blocks:
+        pipe.submit(block)
+    stats = pipe.close()
+    assert stats.rollbacks == 0
+    assert stats.fault_retries >= 3, stats.snapshot()
+    assert stats.degraded_flushes == 0
+    assert_bit_identical(ex.state, oracle_ex.state, "transient-fault replay")
+    out["transient"] = stats.snapshot()
+
+    # worker death mid-flush: window 1's worker dies; the window
+    # degrades to in-line verification and the chain still lands
+    inj = FaultInjector().kill_worker(1)
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(
+        ex,
+        policy=FlushPolicy(window_size=3, max_in_flight=2),
+        fault_injector=inj,
+    )
+    for block in blocks:
+        pipe.submit(block)
+    stats = pipe.close()
+    assert stats.rollbacks == 0
+    assert stats.degraded_flushes >= 1, stats.snapshot()
+    assert_bit_identical(ex.state, oracle_ex.state, "worker-death replay")
+    assert_column_consistency(ex.state, "worker-death replay")
+    out["worker_death"] = stats.snapshot()
+
+    # wedged verifier: window 0 stalls past the settle bound — the
+    # bounded join raises with attribution instead of deadlocking
+    inj = FaultInjector().delay_flush(0, seconds=0.8)
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(
+        ex,
+        policy=FlushPolicy(window_size=2, max_in_flight=1,
+                           settle_timeout_s=0.15, flush_retries=0),
+        fault_injector=inj,
+    )
+    caught = None
+    try:
+        for block in blocks:
+            pipe.submit(block)
+        pipe.close()
+    except PipelineBrokenError as exc:
+        caught = exc
+    assert caught is not None, "wedged verifier never raised"
+    assert caught.window_seq == 0
+    assert caught.slots, "stuck-window attribution missing its slots"
+    # committed position: nothing proved before the wedge — genesis
+    assert _root(ex.state) == _root(state), (
+        "wedged-verifier recovery did not restore the committed position"
+    )
+    try:
+        pipe.submit(blocks[0])
+        raise AssertionError("broken pipeline accepted a block")
+    except PipelineBrokenError:
+        pass
+    out["wedged"] = {
+        "window_seq": caught.window_seq,
+        "slots": list(caught.slots),
+    }
+    metrics.counter("scenario.faults.runs").inc()
+    return out
+
+
+FAMILIES = {
+    "fork_boundary": fork_boundary_replay,
+    "storm": invalid_block_storm,
+    "equivocation": equivocation_traffic,
+    "reorg": deep_reorg_checkpoint_restore,
+    "faults": infrastructure_faults,
+}

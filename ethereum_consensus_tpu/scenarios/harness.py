@@ -1,0 +1,363 @@
+"""Scenario harness core: fault-injected pipeline replay verified against
+the sequential scalar executor (docs/SCENARIOS.md).
+
+The contract every scenario family asserts, after every recovery:
+
+* **bit-identical committed state** — the pipelined replay's committed
+  position equals the sequential SCALAR executor's state (columnar
+  engine off: ``ECT_OPS_VECTOR=off``) at the same chain position, by
+  hash_tree_root AND serialized bytes;
+* **exact blame** — the structured error raised for a corrupted block
+  is the one its mutator declares, surfaced in call-site order across
+  window geometries (coalesced flushes settle FIFO, structural aborts
+  settle earlier work first — so failures always surface in CHAIN
+  order, which is what lets ``run_storm`` resume deterministically);
+* **column-cache consistency** — every ``_col_cache`` resident on the
+  recovered state's lists still agrees element-for-element with the
+  literal SSZ values, and its ``_col_dirty`` channel drains clean (the
+  delta-invalidation never leaks a stale row across rollback,
+  checkpoint-restore, or a fork boundary).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+from ..error import Error
+from ..executor import Executor
+from ..models import ops_vector
+from ..models.signature_batch import SignatureBatch, defer_flushes
+from ..pipeline import ChainPipeline, FlushPolicy
+from ..ssz.core import CachedRootList
+from ..telemetry import metrics
+from ..utils import trace
+from .mutators import MutationEnv
+
+__all__ = [
+    "scalar_mode",
+    "forced_columnar",
+    "assert_bit_identical",
+    "assert_column_consistency",
+    "oracle_replay",
+    "build_corrupted_stream",
+    "run_storm",
+    "StormReport",
+    "StormFailure",
+]
+
+
+@contextmanager
+def scalar_mode():
+    """Force every columnar path off for the scope — the sequential
+    SCALAR oracle the families diff against."""
+    old = os.environ.get(ops_vector._DISABLE_ENV)
+    os.environ[ops_vector._DISABLE_ENV] = "off"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(ops_vector._DISABLE_ENV, None)
+        else:
+            os.environ[ops_vector._DISABLE_ENV] = old
+
+
+@contextmanager
+def forced_columnar():
+    """Drop the columnar engine's registry-size threshold for the scope,
+    so toy-scale scenario chains exercise the batched attestation path
+    the way a 2^17 registry would."""
+    old = ops_vector.BATCH_MIN_VALIDATORS
+    ops_vector.BATCH_MIN_VALIDATORS = 0
+    try:
+        yield
+    finally:
+        ops_vector.BATCH_MIN_VALIDATORS = old
+
+
+def _unwrap(state):
+    """The raw fork-typed state under the Executor's polymorphic wrapper."""
+    return getattr(state, "data", state)
+
+
+def assert_bit_identical(a, b, where: str = "") -> None:
+    a, b = _unwrap(a), _unwrap(b)
+    ra = type(a).hash_tree_root(a)
+    rb = type(b).hash_tree_root(b)
+    assert ra == rb, (
+        f"{where}: state roots diverge ({ra.hex()[:16]} != {rb.hex()[:16]})"
+    )
+    assert type(a).serialize(a) == type(b).serialize(b), (
+        f"{where}: equal roots but serialized bytes diverge — "
+        "hash memo corruption"
+    )
+
+
+def assert_column_consistency(state, where: str = "") -> None:
+    """Every list-resident column cache on ``state`` must agree
+    element-for-element with the literal SSZ values, and syncing must
+    drain its ``_col_dirty`` channel. Lists without a cache are vacuously
+    consistent (nothing resident to go stale)."""
+    state = _unwrap(state)
+    cols = ops_vector.columns_for(state)
+    if cols is None:  # no numpy / engine disabled: nothing cached anywhere
+        return
+    vals = state.validators
+    if vals.__class__ is CachedRootList and vals._col_cache is not None:
+        vc = cols.validator_columns(state)  # refreshes dirty rows
+        assert vc is not None, f"{where}: resident validator columns " \
+            "became unreadable"
+        for f in ops_vector._VAL_INT_FIELDS:
+            expect = [int(getattr(v, f)) for v in vals]
+            got = [int(x) for x in vc[f]]
+            assert got == expect, (
+                f"{where}: stale validator column {f!r} "
+                f"(first divergence at index "
+                f"{next(i for i, (g, e) in enumerate(zip(got, expect)) if g != e)})"
+            )
+        assert [bool(x) for x in vc["slashed"]] == [
+            bool(v.slashed) for v in vals
+        ], f"{where}: stale slashed column"
+        assert [int(x) for x in vc["withdrawal_prefix"]] == [
+            v.withdrawal_credentials[0] for v in vals
+        ], f"{where}: stale withdrawal_prefix column"
+        assert not vals._col_dirty, (
+            f"{where}: _col_dirty not drained after sync: {vals._col_dirty}"
+        )
+    for field in ops_vector.RegistryColumns.LIST_FIELDS:
+        src = getattr(state, field, None)
+        if src is None or src.__class__ is not CachedRootList:
+            continue
+        if src._col_cache is None:
+            continue
+        arr = cols.list_column(state, field)
+        assert arr is not None, f"{where}: resident {field} column " \
+            "became unreadable"
+        got = [int(x) for x in arr]
+        expect = [int(x) for x in src]
+        assert got == expect, (
+            f"{where}: stale {field} column (first divergence at index "
+            f"{next(i for i, (g, e) in enumerate(zip(got, expect)) if g != e)})"
+        )
+        assert not src._col_dirty, (
+            f"{where}: {field} _col_dirty not drained after sync"
+        )
+    metrics.counter("scenario.column_checks").inc()
+
+
+# ---------------------------------------------------------------------------
+# the sequential scalar oracle
+# ---------------------------------------------------------------------------
+
+
+def oracle_replay(pre_state, context, blocks, capture_at=()):
+    """Sequential SCALAR replay of the honest ``blocks`` from
+    ``pre_state``. Returns (final executor, {index: state copy BEFORE
+    applying block[index]} for every index in ``capture_at``) — the
+    captured prefixes are exactly the committed positions a pipelined
+    replay must recover to when block[index] is corrupted."""
+    capture_at = set(capture_at)
+    captured: dict = {}
+    with scalar_mode():
+        ex = Executor(pre_state.copy(), context)
+        for i, block in enumerate(blocks):
+            if i in capture_at:
+                captured[i] = ex.state.copy()
+            ex.apply_block(block)
+    return ex, captured
+
+
+def _advance_to_slot(state_wrapper, slot: int, context):
+    """A copy of the wrapped state advanced to ``slot`` under its own
+    fork's rules (the mutator pre-state for proposer re-signing)."""
+    from ..types import fork_module
+
+    copied = state_wrapper.copy()
+    if int(copied.data.slot) < slot:
+        fork_module(copied.version()).slot_processing.process_slots(
+            copied.data, slot, context
+        )
+    return copied.data
+
+
+def build_corrupted_stream(pre_state, context, blocks, plan, sign=None,
+                           with_oracle: bool = True):
+    """(stream, oracle_prefixes, oracle_executor): the block list with
+    every planned corruption applied, plus the scalar oracle's
+    committed-prefix state for each corrupted index (what the pipeline
+    must roll back to).
+
+    Runs the scalar oracle once over the HONEST chain, capturing the
+    pre-block state at every corrupted index — both the recovery target
+    and the domain-correct signing state for mutators that re-sign.
+    ``with_oracle=False`` (the bench shape, which only measures) skips
+    that replay when no planned mutator needs a signing state; prefixes
+    and the oracle executor come back empty/None."""
+    if not with_oracle and any(m.needs_sign for m in plan.values()):
+        with_oracle = True  # re-signing needs the pre-block states
+    if with_oracle:
+        oracle_ex, prefixes = oracle_replay(
+            pre_state, context, blocks, capture_at=plan.keys()
+        )
+    else:
+        oracle_ex, prefixes = None, {}
+    stream = list(blocks)
+    for i, mutator in plan.items():
+        donor = blocks[(i + 1) % len(blocks)]
+        env = MutationEnv(
+            context,
+            donor=donor,
+            pre_state=(
+                _advance_to_slot(
+                    prefixes[i], int(blocks[i].message.slot), context
+                )
+                if mutator.needs_sign
+                else None
+            ),
+            sign=sign,
+        )
+        stream[i] = mutator(blocks[i], env)
+    return stream, prefixes, oracle_ex
+
+
+class StormFailure:
+    """One observed failure+recovery during a storm replay."""
+
+    __slots__ = ("index", "mutator", "error", "recovery_s")
+
+    def __init__(self, index, mutator, error, recovery_s):
+        self.index = index
+        self.mutator = mutator
+        self.error = error
+        self.recovery_s = recovery_s
+
+    def __repr__(self) -> str:
+        return (
+            f"StormFailure(#{self.index} {self.mutator.name} -> "
+            f"{type(self.error).__name__}, recovery {self.recovery_s * 1e3:.1f}ms)"
+        )
+
+
+class StormReport:
+    __slots__ = ("failures", "blocks_applied", "wall_s", "stats_snapshots")
+
+    def __init__(self):
+        self.failures: list[StormFailure] = []
+        self.blocks_applied = 0
+        self.wall_s = 0.0
+        self.stats_snapshots: list = []
+
+    @property
+    def recovery_latencies(self) -> list:
+        return [f.recovery_s for f in self.failures]
+
+
+def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
+              fault_injector=None, check_states=True, check_columns=True):
+    """Replay a storm-corrupted chain through the pipeline with recovery
+    after every failure, asserting the full contract at each one.
+
+    ``plan``: {block index -> BlockMutator} (``mutators.plan_storm``).
+    ``sign``: ``chain_utils.sign_block`` (needed by re-signing mutators).
+    ``check_states=False`` skips the per-failure bit-compare (the bench
+    shape: measure recovery, still verify blame + final state).
+
+    Failure order: coalesced flushes settle FIFO and structural aborts
+    settle earlier queued work first, so errors surface strictly in
+    chain order — each raised error is asserted against the SMALLEST
+    outstanding corrupted index, and the replay resumes there with the
+    block's honest twin substituted (a real node re-fetches the valid
+    block). Recovery latency is measured from catching the error to
+    a fresh pipeline standing ready over the recovered state (the
+    engine-internal rollback already ran inside the raising submit; the
+    measured tail is the verification + snapshot cost of coming back).
+
+    Returns (StormReport, final executor)."""
+    policy = policy or FlushPolicy(window_size=4, max_in_flight=2,
+                                   checkpoint_interval=2)
+    stream, prefixes, oracle_ex = build_corrupted_stream(
+        pre_state, context, blocks, plan, sign=sign,
+        with_oracle=check_states or check_columns,
+    )
+    remaining = sorted(plan.keys())
+    report = StormReport()
+    t_start = time.perf_counter()
+
+    ex = Executor(pre_state.copy(), context)
+    pipe = ChainPipeline(ex, policy=policy, fault_injector=fault_injector)
+    i = 0
+    with trace.span("scenario.storm", blocks=len(blocks), invalid=len(plan)):
+        while True:
+            try:
+                if i < len(stream):
+                    pipe.submit(stream[i])
+                    i += 1
+                    continue
+                pipe.close()
+                break
+            except Error as exc:
+                t_caught = time.perf_counter()
+                assert remaining, (
+                    f"unexpected failure with no corrupted block "
+                    f"outstanding: {exc!r}"
+                )
+                f = remaining.pop(0)
+                mutator = plan[f]
+                assert mutator.matches(exc), (
+                    f"block #{f} corrupted by {mutator.name} raised "
+                    f"{type(exc).__name__}: {exc} — expected "
+                    f"{mutator.expected_error.__name__}"
+                )
+                if check_states:
+                    assert_bit_identical(
+                        ex.state, prefixes[f],
+                        where=f"recovery after #{f} ({mutator.name})",
+                    )
+                if check_columns:
+                    assert_column_consistency(
+                        ex.state,
+                        where=f"recovery after #{f} ({mutator.name})",
+                    )
+                report.stats_snapshots.append(pipe.stats.snapshot())
+                metrics.counter("scenario.storm.failures").inc()
+                # resume: a broken pipeline accepts no further blocks —
+                # restart on a fresh pipeline over the SAME executor
+                # (already at the committed position), substituting the
+                # failed block's HONEST twin (a real node re-fetches the
+                # valid block for the slot; its descendants need it).
+                # A corrupted successor raises on a later iteration.
+                pipe = ChainPipeline(
+                    ex, policy=policy, fault_injector=fault_injector
+                )
+                stream[f] = blocks[f]
+                i = f
+                recovery_s = time.perf_counter() - t_caught
+                report.failures.append(
+                    StormFailure(f, mutator, exc, recovery_s)
+                )
+                metrics.counter("scenario.storm.recoveries").inc()
+    report.wall_s = time.perf_counter() - t_start
+    report.blocks_applied = len(blocks)  # honest twins replace failures
+    report.stats_snapshots.append(pipe.stats.snapshot())
+    assert not remaining, f"corrupted blocks never surfaced: {remaining}"
+    if oracle_ex is not None:
+        assert_bit_identical(ex.state, oracle_ex.state, where="storm final")
+    if check_columns:
+        assert_column_consistency(ex.state, where="storm final")
+    metrics.counter("scenario.storm.runs").inc()
+    return report, ex
+
+
+# ---------------------------------------------------------------------------
+# throwaway-sink replay (checkpoint-restore support)
+# ---------------------------------------------------------------------------
+
+
+def replay_proven(executor, blocks, validation) -> None:
+    """Re-apply already-proven blocks without re-pairing (the engine's
+    own committed-position rebuild, exposed for the reorg family)."""
+    throwaway = SignatureBatch()
+    with defer_flushes(throwaway):
+        for block in blocks:
+            executor.apply_block_with_validation(block, validation)

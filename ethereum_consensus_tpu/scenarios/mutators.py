@@ -1,0 +1,204 @@
+"""Block mutator library — the invalid-block vocabulary of the scenario
+harness (docs/SCENARIOS.md).
+
+Each mutator is a named, deterministic corruption of one signed block
+that declares the EXACT structured error the sequential path raises for
+it — the blame contract every storm geometry is asserted against. Two
+families, matching the pipeline's two failure paths:
+
+* **pairing-time** (the rollback path): the corruption survives every
+  structural check and fails only when the coalesced flush's verdicts
+  come back — ``bad_proposer_signature``, ``bad_attestation_signature``
+  (both splice a VALID G2 point that signs the wrong message, so
+  parsing succeeds).
+* **structural** (the stage-A path): the corruption aborts block
+  processing on the submitting thread — ``bad_state_root``,
+  ``malformed_operation`` (a voluntary exit naming a validator that
+  does not exist), ``future_slot`` (the slot moved past the parent
+  linkage the header checks pin).
+
+Mutators never mutate their input (they corrupt a ``copy()``), so a
+disk-cached honest chain can never be poisoned in place — the cache-key
+half of that guarantee is ``tests/chain_utils.py``'s parameterized keys.
+
+A mutator runs as ``mutator(block, env)`` where ``env`` carries what
+the corruption needs: the chain ``context``, a ``donor`` block (the
+source of wrong-message signatures), the block's honest ``pre_state``
+(advanced to the block's slot, for domain resolution), and a
+``sign(state, message) -> bytes`` callback for mutations that change
+the block body and must re-sign it as the proposer would (the scenario
+drivers inject ``tests/chain_utils.sign_block`` — key material lives in
+the test scaffolding, never in this package).
+"""
+
+from __future__ import annotations
+
+from ..error import (
+    InvalidBlock,
+    InvalidOperation,
+    InvalidStateRoot,
+    InvalidVoluntaryExit,
+)
+
+__all__ = [
+    "BlockMutator",
+    "MutationEnv",
+    "MUTATORS",
+    "bad_proposer_signature",
+    "bad_state_root",
+    "bad_attestation_signature",
+    "malformed_operation",
+    "future_slot",
+    "plan_storm",
+]
+
+
+class MutationEnv:
+    """What a mutator may draw on: the chain context, a donor block for
+    wrong-message signatures, the honest pre-state at the block's slot,
+    and the proposer re-sign callback."""
+
+    __slots__ = ("context", "donor", "pre_state", "sign")
+
+    def __init__(self, context, donor=None, pre_state=None, sign=None):
+        self.context = context
+        self.donor = donor
+        self.pre_state = pre_state
+        self.sign = sign
+
+
+class BlockMutator:
+    """A named corruption with its declared structured-error contract.
+
+    ``expected_error`` is the most specific class covering what the
+    sequential scalar path raises for this corruption — precise for the
+    crisp mutators (``InvalidStateRoot``, ``InvalidVoluntaryExit``), a
+    declared base for the ones whose first-tripped check depends on the
+    chain position (``future_slot``: header/randao/state-root are all
+    ``InvalidBlock`` arms). ``structural`` records which pipeline
+    failure path the corruption exercises (stage-A abort vs flush
+    rollback)."""
+
+    __slots__ = ("name", "expected_error", "structural", "needs_sign", "_fn")
+
+    def __init__(self, name: str, expected_error: type, fn,
+                 structural: bool = False, needs_sign: bool = True):
+        self.name = name
+        self.expected_error = expected_error
+        self.structural = structural
+        self.needs_sign = needs_sign
+        self._fn = fn
+
+    def __call__(self, signed_block, env: MutationEnv):
+        bad = signed_block.copy()
+        self._fn(bad, env)
+        return bad
+
+    def __repr__(self) -> str:
+        return f"BlockMutator({self.name})"
+
+    def matches(self, error: Exception) -> bool:
+        return isinstance(error, self.expected_error)
+
+
+def _resign(bad, env: MutationEnv) -> None:
+    if env.sign is None or env.pre_state is None:
+        raise ValueError(
+            "this mutator changes the block body and needs env.sign + "
+            "env.pre_state to re-sign as the proposer"
+        )
+    bad.signature = env.sign(env.pre_state, bad.message, env.context)
+
+
+def _bad_proposer_signature(bad, env: MutationEnv) -> None:
+    donor = env.donor
+    if donor is None or bytes(donor.signature) == bytes(bad.signature):
+        raise ValueError("bad_proposer_signature needs a distinct donor block")
+    bad.signature = bytes(donor.signature)
+
+
+def _bad_state_root(bad, env: MutationEnv) -> None:
+    bad.message.state_root = b"\x5c" * 32
+    _resign(bad, env)
+
+
+def _bad_attestation_signature(bad, env: MutationEnv) -> None:
+    atts = bad.message.body.attestations
+    if not len(atts):
+        raise ValueError("bad_attestation_signature needs a block with "
+                         "attestations")
+    # a valid G2 point over the wrong message: the proposer signature of
+    # the block itself (96 bytes, parses, never matches attestation data)
+    atts[0].signature = bytes(bad.signature)
+    _resign(bad, env)
+
+
+def _malformed_operation(bad, env: MutationEnv) -> None:
+    from ..models.phase0.containers import build as p0_build
+
+    ns = p0_build(env.context.preset)
+    bogus = ns.SignedVoluntaryExit(
+        message=ns.VoluntaryExit(epoch=0, validator_index=2**32 - 1),
+        signature=bytes(bad.signature),
+    )
+    bad.message.body.voluntary_exits = [bogus]
+    _resign(bad, env)
+
+
+def _future_slot(bad, env: MutationEnv) -> None:
+    bad.message.slot = int(bad.message.slot) + 3
+    _resign(bad, env)
+
+
+bad_proposer_signature = BlockMutator(
+    "bad_proposer_sig", InvalidBlock, _bad_proposer_signature,
+    needs_sign=False,
+)
+bad_state_root = BlockMutator(
+    "bad_state_root", InvalidStateRoot, _bad_state_root, structural=True
+)
+# structural=True: the splice changes the BODY, so the post-state's
+# latest_block_header shifts and stage A's root check trips first — the
+# transition then re-verifies the collected sets inline and raises the
+# attestation's own error (models/transition.py), exactly as the
+# sequential flush-before-root order would. Only a signature OUTSIDE the
+# body (the proposer's) reaches the pairing-time rollback path.
+bad_attestation_signature = BlockMutator(
+    "bad_attestation_sig", InvalidOperation, _bad_attestation_signature,
+    structural=True,
+)
+malformed_operation = BlockMutator(
+    "malformed_operation", InvalidVoluntaryExit, _malformed_operation,
+    structural=True,
+)
+future_slot = BlockMutator(
+    "future_slot", InvalidBlock, _future_slot, structural=True
+)
+
+MUTATORS = (
+    bad_proposer_signature,
+    bad_state_root,
+    bad_attestation_signature,
+    malformed_operation,
+    future_slot,
+)
+
+_BY_NAME = {m.name: m for m in MUTATORS}
+
+
+def plan_storm(n_blocks: int, fraction: float, rng,
+               mutators=None, protect=()) -> dict:
+    """{block index -> mutator}: corrupt ``fraction`` of an ``n_blocks``
+    chain, mutators drawn round-robin-shuffled from ``mutators`` (default
+    all five). ``protect`` indices (e.g. 0 when the genesis edge is
+    under a different scenario's control) are never corrupted. ``rng``
+    is caller-seeded — storms are reproducible by construction."""
+    pool = list(mutators or MUTATORS)
+    count = max(1, int(n_blocks * fraction))
+    eligible = [i for i in range(n_blocks) if i not in set(protect)]
+    picks = sorted(rng.sample(eligible, min(count, len(eligible))))
+    return {i: pool[k % len(pool)] for k, i in enumerate(picks)}
+
+
+def by_name(name: str) -> BlockMutator:
+    return _BY_NAME[name]

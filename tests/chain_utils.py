@@ -124,6 +124,8 @@ def _cache_source_digest() -> str:
                 "*/block_processing.py"
             )
         )
+        # fork upgrade functions shape the full-upgrade chain bundles
+        + list((repo / "ethereum_consensus_tpu" / "models").glob("*/fork.py"))
         + [repo / "ethereum_consensus_tpu" / "models" / "genesis_common.py"]
         + [repo / "ethereum_consensus_tpu" / "ssz" / "core.py"]
     )
@@ -273,10 +275,12 @@ def produce_block(state, slot: int, context, attestations=()):
 
 
 def sign_block(state, block, context) -> bytes:
-    """(Re-)sign ``block`` with its proposer's key against ``state``'s fork."""
-    ns = build(context.preset)
+    """(Re-)sign ``block`` with its proposer's key against ``state``'s
+    fork. Fork-generic: the signing root is computed with the block's
+    OWN SSZ type, so any fork's block re-signs correctly (the scenario
+    mutators re-sign altair→electra blocks through this)."""
     domain = h.get_domain(state, DomainType.BEACON_PROPOSER, None, context)
-    root = compute_signing_root(ns.BeaconBlock, block, domain)
+    root = compute_signing_root(type(block), block, domain)
     return secret_key(block.proposer_index).sign(root).to_bytes()
 
 
@@ -716,14 +720,177 @@ def produce_multi_fork_chain(validator_count: int = 64):
     return state, context, blocks
 
 
+FULL_UPGRADE_FORKS = (
+    "phase0", "altair", "bellatrix", "capella", "deneb", "electra"
+)
+
+
+def full_upgrade_context():
+    """A minimal-preset Context whose fork schedule activates one fork
+    per epoch: altair@1, bellatrix@2, capella@3, deneb@4, electra@5 —
+    the five-boundary ladder ``produce_full_upgrade_chain`` climbs."""
+    context = Context.for_minimal()
+    for epoch, fork in enumerate(FULL_UPGRADE_FORKS):
+        if fork != "phase0":
+            setattr(context, f"{fork}_fork_epoch", epoch)
+    return context
+
+
+def full_upgrade_fork_at_slot(slot: int, context) -> str:
+    epoch = int(slot) // int(context.SLOTS_PER_EPOCH)
+    return FULL_UPGRADE_FORKS[min(epoch, len(FULL_UPGRADE_FORKS) - 1)]
+
+
+def produce_full_upgrade_chain(validator_count: int = 64,
+                               atts_per_block: int = 2,
+                               eth1_credential_validators: int = 4,
+                               cache_tag: str = ""):
+    """(genesis_state, context, blocks): ONE chain crossing ALL FIVE fork
+    boundaries (phase0→altair→bellatrix→capella→deneb→electra, one epoch
+    each on the minimal preset) with live traffic at every edge:
+
+    * every block carries up to ``atts_per_block`` aggregate attestations
+      over the previous slot's committees — including the cross-edge
+      shape where attestations produced under fork F land in the first
+      block of fork F+1 (previous-fork domain resolution). The deneb
+      attestations pending at the electra edge are dropped (EIP-7549
+      changed the container) and electra's committee-spanning aggregates
+      take over.
+    * ``eth1_credential_validators`` validators get 0x01 withdrawal
+      credentials and an excess balance at genesis, so the capella/deneb/
+      electra segments produce real partial withdrawals in every sweep
+      (the balance re-accrues past the cap through attestation rewards).
+    * the first block of each fork lands EXACTLY on the upgrade slot
+      (the executor.rs:215-224 in-slot corner), five times over.
+
+    Disk-cached with every parameter — and any caller-supplied
+    ``cache_tag`` — in the key, so differently-parameterized (or
+    scenario-derived) chains can never collide."""
+    context = full_upgrade_context()
+    spe = int(context.SLOTS_PER_EPOCH)
+    p0ns = build(context.preset)
+
+    def build_chain():
+        state, _ = fresh_genesis(validator_count, "minimal")
+        # 0x01 credentials + excess balance: live withdrawal traffic on
+        # every capella+ sweep (partial withdrawals re-arm via rewards)
+        for i in range(min(eth1_credential_validators, validator_count)):
+            v = state.validators[i]
+            v.withdrawal_credentials = (
+                b"\x01" + b"\x00" * 11 + bls.hash(b"exec-addr-%d" % i)[:20]
+            )
+            state.balances[i] = int(state.balances[i]) + 10 * 10**9
+
+        scratch = state.copy()
+        blocks = []
+        pending: list = []
+        for epoch, fork in enumerate(FULL_UPGRADE_FORKS):
+            first_slot = epoch * spe
+            if fork != "phase0":
+                prev_mod = _fork_module(FULL_UPGRADE_FORKS[epoch - 1])
+                if scratch.slot < first_slot:
+                    prev_mod.slot_processing.process_slots(
+                        scratch, first_slot, context
+                    )
+                mod = _fork_module(fork)
+                scratch = getattr(mod, f"upgrade_to_{fork}")(scratch, context)
+                if fork == "electra":
+                    pending = []  # EIP-7549 changed the Attestation container
+            for slot in range(max(first_slot, 1), first_slot + spe):
+                if fork == "phase0":
+                    block = produce_block(
+                        scratch, slot, context, attestations=pending
+                    )
+                else:
+                    block = produce_block_fork(
+                        fork, scratch, slot, context, attestations=pending
+                    )
+                stm = _fork_module(fork).state_transition
+                if int(scratch.slot) == slot:
+                    stm.state_transition_block_in_slot(
+                        scratch, block, stm.Validation.ENABLED, context
+                    )
+                else:
+                    stm.state_transition(scratch, block, context)
+                if fork == "electra":
+                    pending = [make_attestation_electra(scratch, slot, context)]
+                else:
+                    per_slot = h.get_committee_count_per_slot(
+                        scratch, slot // spe, context
+                    )
+                    pending = [
+                        make_attestation(scratch, slot, index, context)
+                        for index in range(min(atts_per_block, per_slot))
+                    ]
+                blocks.append(block)
+        return state, blocks
+
+    def block_type_at(slot: int):
+        ns = _fork_module(full_upgrade_fork_at_slot(slot, context)).build(
+            context.preset
+        )
+        return ns.SignedBeaconBlock
+
+    def serialize(value):
+        state, blocks = value
+        sb = p0ns.BeaconState.serialize(state)
+        out = [len(blocks).to_bytes(4, "little"),
+               len(sb).to_bytes(8, "little"), sb]
+        for block in blocks:
+            slot = int(block.message.slot)
+            bb = block_type_at(slot).serialize(block)
+            out.append(slot.to_bytes(8, "little"))
+            out.append(len(bb).to_bytes(8, "little"))
+            out.append(bb)
+        return b"".join(out)
+
+    def deserialize(data):
+        n = int.from_bytes(data[:4], "little")
+        at = 4
+        ln = int.from_bytes(data[at: at + 8], "little")
+        at += 8
+        state = p0ns.BeaconState.deserialize(data[at: at + ln])
+        at += ln
+        blocks = []
+        for _ in range(n):
+            slot = int.from_bytes(data[at: at + 8], "little")
+            at += 8
+            ln = int.from_bytes(data[at: at + 8], "little")
+            at += 8
+            blocks.append(block_type_at(slot).deserialize(data[at: at + ln]))
+            at += ln
+        return state, blocks
+
+    tag = f"-{cache_tag}" if cache_tag else ""
+    state, blocks = _disk_cached(
+        f"fullupgrade-{validator_count}-{atts_per_block}a-"
+        f"{eth1_credential_validators}w{tag}",
+        serialize,
+        deserialize,
+        build_chain,
+    )
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root as _htr
+
+    _htr(state)  # warm the root memo (see cached_genesis)
+    _strip_spec_caches(state)
+    return state.copy(), context, blocks
+
+
 def mainnet_chain_bundle(fork_name: str, validator_count: int,
-                         n_blocks: int, atts: int):
+                         n_blocks: int, atts: int, cache_tag: str = ""):
     """(pre_state, context, signed_blocks): ``n_blocks`` consecutive
     valid blocks at mainnet committee structure on a ``validator_count``
     registry, each carrying up to ``atts`` aggregate attestations plus a
     full sync aggregate / execution payload on altair+/bellatrix+ —
     the replay stream the pipeline bench drives. Disk-cached (the
-    signing cost at 2^20 is minutes; the bench pays one deserialize)."""
+    signing cost at 2^20 is minutes; the bench pays one deserialize).
+
+    ``cache_tag`` MUST name any scenario/mutator parameterization a
+    caller derives a non-honest stream from AND THEN re-caches: it is
+    folded into the disk key, so an adversarial bundle can never collide
+    with (or be served as) the honest one. In-memory corruption of the
+    returned blocks needs no tag — the cached bytes are never mutated
+    (mutators copy, scenarios/mutators.py)."""
     context = Context.for_mainnet()
     mod = _fork_module(fork_name)
     ns = mod.build(context.preset)
@@ -797,9 +964,10 @@ def mainnet_chain_bundle(fork_name: str, validator_count: int,
             at += ln
         return state, blocks
 
+    tag = f"-{cache_tag}" if cache_tag else ""
     state, blocks = _disk_cached(
         f"chainbundle-{_FASTREG_VERSION}-{fork_name}-mainnet-"
-        f"{validator_count}-{n_blocks}x{atts}",
+        f"{validator_count}-{n_blocks}x{atts}{tag}",
         serialize,
         deserialize,
         build,

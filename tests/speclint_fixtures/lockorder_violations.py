@@ -1,0 +1,59 @@
+"""Lock-order fixture: one seeded acquisition-order reversal + twins
+that must NOT flag. Parsed, never imported."""
+
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+_LOCK_C = threading.Lock()
+
+
+def ok_forward_path():
+    with _LOCK_A:
+        with _LOCK_B:  # establishes the A -> B order
+            pass
+
+
+def bad_reversed_path():
+    with _LOCK_B:
+        with _LOCK_A:  # seeded: lockorder/inconsistent-acquisition-order
+            pass
+
+
+def ok_same_order_again():
+    with _LOCK_A:
+        with _LOCK_B:  # sanctioned: consistent with ok_forward_path
+            pass
+
+
+def ok_disjoint_nesting():
+    with _LOCK_A:
+        with _LOCK_C:  # sanctioned: C never nests with A reversed
+            pass
+
+
+def ok_sequential_not_nested():
+    with _LOCK_B:
+        pass
+    with _LOCK_A:  # sanctioned: sequential acquisition, no edge
+        pass
+
+
+def ok_closure_resets_stack():
+    with _LOCK_C:
+        def later():
+            # runs after the with exits — NOT a C -> A edge
+            with _LOCK_A:
+                pass
+
+        return later
+
+
+class Nested:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def ok_instance_under_module(self):
+        with _LOCK_A:
+            with self._lock:  # one consistent order, never reversed
+                pass

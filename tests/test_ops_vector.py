@@ -170,6 +170,81 @@ def test_batch_commits_partial_state_on_invalid_attestation(monkeypatch):
     assert type(vec).hash_tree_root(vec) == type(scalar).hash_tree_root(scalar)
 
 
+@pytest.mark.parametrize("fork", ["altair", "deneb", "electra"])
+def test_partial_commit_at_fork_boundary(fork, monkeypatch):
+    """The mid-block invalid-attestation partial-commit path ON a fork
+    boundary: the state has JUST crossed the fork's upgrade slot (the
+    participation lists freshly rotated, column caches traveled through
+    the upgrade), attestation 0 is valid, attestation 1 structurally
+    invalid — the earlier partial state must commit before the error
+    propagates, and the columnar engine must agree with the scalar loop
+    on it byte-for-byte."""
+    from ethereum_consensus_tpu.error import InvalidAttestation
+    from ethereum_consensus_tpu.executor import Executor
+
+    state, ctx, blocks = chain_utils.produce_full_upgrade_chain(64)
+    bp = __import__(
+        f"ethereum_consensus_tpu.models.{fork}.block_processing",
+        fromlist=["process_operations"],
+    )
+    stmod = _st(fork)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    edge_slot = int(getattr(ctx, f"{fork}_fork_epoch")) * spe
+    ex = Executor(state.copy(), ctx)
+    for b in blocks:
+        ex.apply_block(b)
+        if int(b.message.slot) == edge_slot:
+            break  # the first block of the new fork just applied
+    st = ex.state.data
+    assert int(st.slot) == edge_slot
+
+    sc = st.copy()
+    stmod.process_slots(sc, int(st.slot) + 1, ctx)
+    slot = int(st.slot) + 1 - int(ctx.MIN_ATTESTATION_INCLUSION_DELAY)
+    if fork == "electra":
+        good = chain_utils.make_attestation_electra(
+            sc, slot, ctx, participation=0.9
+        )
+        bad = chain_utils.make_attestation_electra(
+            sc, slot, ctx, participation=0.5
+        )
+        bad.data.index = 7  # EIP-7549: attestation data index must be 0
+    else:
+        good = chain_utils.make_attestation(sc, slot, 0, ctx,
+                                            participation=0.9)
+        bad = chain_utils.make_attestation(sc, slot, 0, ctx,
+                                           participation=0.5)
+        bad.data.index = 10**6  # no such committee
+    pre_participation = list(sc.current_epoch_participation) + list(
+        sc.previous_epoch_participation
+    )
+
+    def run(force):
+        # sc (one slot past the edge) satisfies the inclusion delay for
+        # an attestation over the upgrade slot itself
+        s = sc.copy()
+        monkeypatch.setattr(
+            ops_vector, "BATCH_MIN_VALIDATORS", 0 if force else 1 << 60
+        )
+        with pytest.raises(InvalidAttestation):
+            bp.process_operations(s, _FakeBody([good, bad]), ctx)
+        return s
+
+    vec, scalar = run(True), run(False)
+    assert type(vec).hash_tree_root(vec) == type(scalar).hash_tree_root(
+        scalar
+    ), f"{fork}: partial-commit state diverged at the fork edge"
+    assert type(vec).serialize(vec) == type(scalar).serialize(scalar)
+    # the good attestation really landed flags (non-vacuous partiality)
+    post_participation = list(vec.current_epoch_participation) + list(
+        vec.previous_epoch_participation
+    )
+    assert post_participation != pre_participation, (
+        f"{fork}: the valid attestation set no flags — the partial-"
+        "commit path was not exercised"
+    )
+
+
 class _FakeBody:
     """Minimal operations body: only attestations populated."""
 

@@ -28,7 +28,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools import speclint
-from tools.speclint import aliasflow, concurrency, forkdiff, mutation
+from tools.speclint import aliasflow, concurrency, forkdiff, lockorder, mutation
 from tools.speclint.allowlist import Allowlist, AllowlistError
 
 REPO_ROOT = speclint.REPO_ROOT
@@ -254,6 +254,70 @@ def test_concurrency_locked_twins_not_flagged(concurrency_findings):
         f.symbol.startswith("SharedCounter.__init__")
         for f in concurrency_findings
     )
+
+
+# ---------------------------------------------------------------------------
+# lockorder self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lockorder_findings():
+    return lockorder.analyze(
+        [os.path.join(FIXTURES, "lockorder_violations.py")], REPO_ROOT
+    )
+
+
+def test_lockorder_catches_reversed_acquisition(lockorder_findings):
+    assert len(lockorder_findings) == 1, lockorder_findings
+    f = lockorder_findings[0]
+    assert f.rule == "lockorder/inconsistent-acquisition-order"
+    assert f.symbol == "_LOCK_B->_LOCK_A"
+    assert "bad_reversed_path" in f.message
+    assert "ok_forward_path" in f.message  # names the opposite-order site
+
+
+def test_lockorder_sanctioned_shapes_not_flagged(lockorder_findings):
+    listing = " ".join(f.message for f in lockorder_findings)
+    for sym in ("ok_same_order_again", "ok_disjoint_nesting",
+                "ok_sequential_not_nested", "ok_closure_resets_stack",
+                "Nested.ok_instance_under_module"):
+        assert sym not in listing, sym
+
+
+def test_lockorder_same_name_different_modules_not_aliased(tmp_path):
+    """Two modules each defining their own `_LOCK` must not fold into
+    one identity (a false cross-module cycle)."""
+    a = tmp_path / "mod_a.py"
+    b = tmp_path / "mod_b.py"
+    a.write_text(
+        "import threading\n_LOCK = threading.Lock()\n_OTHER = threading.Lock()\n"
+        "def f():\n    with _LOCK:\n        with _OTHER:\n            pass\n"
+    )
+    b.write_text(
+        "import threading\n_LOCK = threading.Lock()\n_OTHER = threading.Lock()\n"
+        "def g():\n    with _OTHER:\n        with _LOCK:\n            pass\n"
+    )
+    findings = lockorder.analyze([str(a), str(b)], str(tmp_path))
+    assert findings == [], [f.format_text() for f in findings]
+
+
+def test_lockorder_scope_covers_pipeline_and_scenarios():
+    """The deadlock check must see every file the concurrency rules see
+    — pipeline/ (where the second lock landed) and scenarios/ included,
+    with zero allowlist entries for either."""
+    targets = speclint._default_targets(REPO_ROOT)
+    paths = targets["concurrency_paths"]
+    pkg = os.path.join(REPO_ROOT, "ethereum_consensus_tpu")
+    assert os.path.join(pkg, "pipeline", "faults.py") in paths
+    assert os.path.join(pkg, "scenarios", "harness.py") in paths
+    assert os.path.join(pkg, "scenarios", "families.py") in paths
+    allow = Allowlist.load(speclint.ALLOWLIST_PATH)
+    assert not any(
+        e.get("rule", "").startswith("lockorder/")
+        or "scenarios/" in e.get("path", "")
+        for e in allow.entries
+    ), "the lockorder/scenarios widening must land with zero allowlist entries"
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,11 @@ Four analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
                    through the stale alias, and in-place mutation of a
                    registry-column cache buffer (the ROADMAP-noted gap
                    the columnar engine made load-bearing).
+* ``lockorder``  — lock acquisition ORDER over the concurrency scope:
+                   a pair of locks taken in opposite orders on two
+                   paths deadlocks the pipeline's two threads (the
+                   ROADMAP-noted gap closed when the scenario
+                   FaultInjector added a second lock to pipeline/).
 
 Run: ``python -m tools.speclint [--format text|json] [paths...]`` — or
 through the tier-1 gate ``tests/test_speclint.py`` (zero non-allowlisted
@@ -30,7 +35,7 @@ from __future__ import annotations
 
 import os
 
-from . import aliasflow, concurrency, forkdiff, mutation
+from . import aliasflow, concurrency, forkdiff, lockorder, mutation
 from .allowlist import ALLOWLIST_PATH, Allowlist, AllowlistError
 from .base import Finding, iter_py_files
 
@@ -53,6 +58,9 @@ def _default_targets(root: str) -> dict:
         "mutation_paths": iter_py_files(
             os.path.join(root, _PKG, "models"),
             os.path.join(root, _PKG, "pipeline"),
+            # scenario mutators corrupt SSZ blocks — through sanctioned
+            # channels only, or incremental roots would serve stale bytes
+            os.path.join(root, _PKG, "scenarios"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -62,6 +70,9 @@ def _default_targets(root: str) -> dict:
             # the columnar engine keeps process-wide state (one-shot
             # fallback events, the preparer registry) — lock-checked
             os.path.join(root, _PKG, "models", "ops_vector.py"),
+            # the scenario harness drives the pipeline from test/driver
+            # threads while the FaultInjector is read on the worker
+            os.path.join(root, _PKG, "scenarios"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
@@ -86,6 +97,9 @@ def run(
     )
     findings.extend(concurrency.analyze(targets["concurrency_paths"], root))
     findings.extend(aliasflow.analyze(targets["mutation_paths"], root))
+    # lock order aggregates over the SAME scope the concurrency rules
+    # police — both halves of a deadlock rarely sit in one file
+    findings.extend(lockorder.analyze(targets["concurrency_paths"], root))
 
     if paths:
         wanted = [

@@ -1,0 +1,221 @@
+"""Lock-ORDER analyzer — the deadlock-shape check the concurrency rules
+don't cover.
+
+The concurrency analyzer proves writes are lock-DOMINATED; it says
+nothing about the ORDER locks nest in. Two call paths that acquire the
+same two locks in opposite orders can deadlock the moment they run on
+the pipeline's two threads — exactly the failure the ROADMAP flagged as
+a known gap "once a second lock joins pipeline/" (the scenario
+FaultInjector did: its instance lock now coexists with the bls verify-
+pool lock and the telemetry metric locks).
+
+Lexical model, matching the repo's discipline:
+
+* a LOCK is a module-level ``threading.Lock()``/``RLock()`` assignment
+  (identity: the global's name) or a ``self.<attr> = threading.Lock()``
+  in a class body (identity: ``ClassName.<attr>``);
+* an EDGE ``A -> B`` is a ``with`` acquiring ``B`` lexically inside a
+  ``with`` holding ``A`` — in the same function, including through the
+  tracked with-stack of nested statements (closures deliberately reset
+  the stack: they run later, outside the enclosing acquisition);
+* ``lockorder/inconsistent-acquisition-order`` fires when both
+  ``A -> B`` and ``B -> A`` edges exist ANYWHERE in the analyzed scope
+  (edges aggregate across files — the two halves of a deadlock rarely
+  sit in one function).
+
+Same-name locks in different modules are deliberately DISTINCT
+(identity carries the defining path for module locks), so an
+over-common name like ``_LOCK`` cannot alias two unrelated modules into
+a false cycle.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Finding, SourceModule
+
+__all__ = ["analyze", "analyze_file_edges"]
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr in ("Lock", "RLock")
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "threading"
+    ) or (isinstance(func, ast.Name) and func.id in ("Lock", "RLock"))
+
+
+class _Edge:
+    __slots__ = ("held", "acquired", "path", "line", "func")
+
+    def __init__(self, held, acquired, path, line, func):
+        self.held = held
+        self.acquired = acquired
+        self.path = path
+        self.line = line
+        self.func = func
+
+
+class _LockScan:
+    """Per-module lock identities: module globals + instance-lock attrs
+    keyed by class name."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.path = path
+        self.module_locks: set = set()   # global name
+        self.class_locks: dict = {}      # class name -> {attr}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.module_locks.add(target.id)
+            elif isinstance(node, ast.ClassDef):
+                attrs = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                        for target in sub.targets:
+                            if (
+                                isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)
+                                and target.value.id == "self"
+                            ):
+                                attrs.add(target.attr)
+                if attrs:
+                    self.class_locks[node.name] = attrs
+
+    def identify(self, expr: ast.AST, class_name: "str | None") -> "str | None":
+        """The lock identity a with-item context expression acquires, or
+        None when it names no known lock."""
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"{self.path}:{expr.id}"
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and class_name is not None
+            and expr.attr in self.class_locks.get(class_name, ())
+        ):
+            return f"{self.path}:{class_name}.{expr.attr}"
+        return None
+
+
+class _EdgeCollector(ast.NodeVisitor):
+    def __init__(self, scan: _LockScan, qualname: str,
+                 class_name: "str | None", edges: list):
+        self.scan = scan
+        self.qualname = qualname
+        self.class_name = class_name
+        self.edges = edges
+        self.held: list = []  # stack of lock identities
+
+    def visit_FunctionDef(self, node):
+        # a closure body runs LATER, outside the lexically enclosing
+        # acquisition — fresh stack
+        inner = _EdgeCollector(
+            self.scan, f"{self.qualname}.{node.name}", self.class_name,
+            self.edges,
+        )
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node):
+        acquired = []
+        for item in node.items:
+            ident = self.scan.identify(item.context_expr, self.class_name)
+            if ident is not None:
+                for held in self.held:
+                    if held != ident:
+                        self.edges.append(
+                            _Edge(held, ident, self.scan.path,
+                                  node.lineno, self.qualname)
+                        )
+                acquired.append(ident)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        if acquired:
+            del self.held[-len(acquired):]
+
+    visit_AsyncWith = visit_With
+
+
+def analyze_file_edges(abspath: str, root: str) -> list:
+    """Every held->acquired lock edge of one file."""
+    src = SourceModule.load(abspath, root)
+    scan = _LockScan(src.tree, src.path)
+    edges: list = []
+
+    def walk_function(node, qualname, class_name):
+        collector = _EdgeCollector(scan, qualname, class_name, edges)
+        for stmt in node.body:
+            collector.visit(stmt)
+
+    for node in src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_function(node, node.name, None)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_function(
+                        item, f"{node.name}.{item.name}", node.name
+                    )
+    return edges
+
+
+def _short(ident: str) -> str:
+    return ident.split(":", 1)[1] if ":" in ident else ident
+
+
+def analyze(paths: list, root: str) -> list:
+    """Aggregate edges over the whole scope, then flag every lock pair
+    acquired in both orders. One finding per conflicting pair, anchored
+    at the reversal edge (the direction whose first acquisition appears
+    later in the scope walk), naming both sites."""
+    edges: list = []
+    for path in paths:
+        edges.extend(analyze_file_edges(path, root))
+    by_direction: dict = {}
+    for edge in edges:
+        by_direction.setdefault((edge.held, edge.acquired), []).append(edge)
+
+    findings: list = []
+    seen_pairs: set = set()
+    for (a, b), forward in by_direction.items():
+        reverse = by_direction.get((b, a))
+        if not reverse:
+            continue
+        pair = tuple(sorted((a, b)))
+        if pair in seen_pairs:
+            continue
+        seen_pairs.add(pair)
+        first, second = forward[0], reverse[0]
+        findings.append(
+            Finding(
+                rule="lockorder/inconsistent-acquisition-order",
+                path=second.path,
+                line=second.line,
+                symbol=f"{_short(second.held)}->{_short(second.acquired)}",
+                message=(
+                    f"lock acquisition order reversal: {second.func} "
+                    f"takes {_short(second.held)} then "
+                    f"{_short(second.acquired)}, but {first.func} "
+                    f"({first.path}:{first.line}) takes them in the "
+                    "opposite order — two threads interleaving these "
+                    "paths deadlock"
+                ),
+                hint=(
+                    "pick one global acquisition order for this lock "
+                    "pair and rewrite the reversed site (or allowlist "
+                    "with the reason the paths can never run "
+                    "concurrently)"
+                ),
+            )
+        )
+    return findings
