@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff chaos pipeline-selfcheck trace metrics
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos pipeline-selfcheck trace metrics serve server-smoke
 
 help:  ## list targets
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
@@ -37,6 +37,9 @@ chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundar
 bench-diff:  ## per-phase diff of two bench evidence files: make bench-diff A=old.json B=new.json
 	$(PY) bench_compare.py $(A) $(B)
 
+bench-trend:  ## per-phase seconds across every BENCH_r*.json as a markdown table
+	$(PY) bench_compare.py --trend $(sort $(wildcard BENCH_r*.json))
+
 pipeline-selfcheck:  ## pipeline smoke: seq-vs-pipelined bit identity
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck
 
@@ -47,3 +50,9 @@ trace:  ## record a pipeline run as Chrome trace JSON (open in Perfetto)
 metrics:  ## dump the telemetry metrics registry after a pipeline run
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --metrics-out metrics.json
 	@cat metrics.json
+
+serve:  ## pipeline selfcheck with the live introspection server up (held 30s: curl /metrics /healthz /blocks /events)
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --serve 8799 --hold 30
+
+server-smoke:  ## tier-1-adjacent: scrape /metrics + /blocks during a short pipelined replay
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flight_server.py -q -m server_smoke

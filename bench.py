@@ -91,6 +91,7 @@ PROGRESS_ENV = "EC_BENCH_PROGRESS"
 DEGRADED_ENV = "EC_BENCH_DEGRADED"
 TRACE_OUT_ENV = "EC_BENCH_TRACE_OUT"      # --trace-out (child records spans)
 METRICS_OUT_ENV = "EC_BENCH_METRICS_OUT"  # --metrics-out (registry snapshot)
+SERVE_PORT_ENV = "EC_BENCH_SERVE_PORT"    # --serve-port (introspection server)
 
 PROBE_TIMEOUT_S = 150       # TPU init is ~20-40s healthy; a hang never ends
 CHILD_TIMEOUT_S = 900       # hard parent-side budget for the whole child
@@ -1369,6 +1370,18 @@ def child_main() -> None:
     trace_out = os.environ.get(TRACE_OUT_ENV)
     if trace_out:
         tel_spans.start_recording(capacity=1 << 18)
+    server = None
+    serve_port = os.environ.get(SERVE_PORT_ENV)
+    if serve_port:
+        # live introspection for the whole bench run: /metrics scrapes
+        # every config's counters mid-flight, /blocks + /events follow
+        # the pipeline configs' replays (docs/OBSERVABILITY.md)
+        from ethereum_consensus_tpu.telemetry.server import (
+            IntrospectionServer,
+        )
+
+        server = IntrospectionServer(port=int(serve_port)).start()
+        _note(f"introspection server on {server.url()}")
 
     def checkpoint():
         tmp = progress_path + ".tmp"
@@ -1420,6 +1433,8 @@ def child_main() -> None:
         with open(metrics_out, "w") as f:
             json.dump(tel_metrics.snapshot(), f, indent=1, sort_keys=True)
         _note(f"metrics snapshot written: {metrics_out}")
+    if server is not None:
+        server.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -1511,6 +1526,12 @@ def main() -> None:
                 print(f"{flag} requires a path argument", file=sys.stderr)
                 sys.exit(2)
             os.environ[env_key] = os.path.abspath(argv[at + 1])
+    if "--serve-port" in argv:
+        at = argv.index("--serve-port")
+        if at + 1 >= len(argv):
+            print("--serve-port requires a port argument", file=sys.stderr)
+            sys.exit(2)
+        os.environ[SERVE_PORT_ENV] = argv[at + 1]
 
     healthy, note, probe_transcript = probe_default_backend()
     _note(f"backend probe: healthy={healthy} ({note})")
@@ -1526,7 +1547,7 @@ def main() -> None:
 
         env = cpu_mesh_env(1, repo_root=REPO)
         env[DEGRADED_ENV] = note
-        for env_key in (TRACE_OUT_ENV, METRICS_OUT_ENV):
+        for env_key in (TRACE_OUT_ENV, METRICS_OUT_ENV, SERVE_PORT_ENV):
             if os.environ.get(env_key):  # survive the hermetic scrub
                 env[env_key] = os.environ[env_key]
     env[CHILD_ENV] = "1"

@@ -1,24 +1,51 @@
 """Diff two bench evidence files per phase/metric — regressions in one
-command.
+command — or walk the whole BENCH_r01..rNN trajectory as one table.
 
     python bench_compare.py BENCH_A.json BENCH_B.json [--threshold 0.05]
+    python bench_compare.py --trend BENCH_r*.json
     make bench-diff A=BENCH_A.json B=BENCH_B.json
+    make bench-trend
 
-Accepts ``BENCH_FULL.json``-shaped files (a ``configs`` dict, as written
-next to bench.py) or a bare per-config dict. Every numeric leaf shared
-by both files is compared; seconds-like keys (``*_s``, ``*_s_per_*``)
-are flagged as REGRESSED/IMPROVED beyond the threshold, with the
-``phases`` split (sig batch / state HTR / committees / operations —
+Diff mode accepts ``BENCH_FULL.json``-shaped files (a ``configs`` dict,
+as written next to bench.py) or a bare per-config dict. Every numeric
+leaf shared by both files is compared; seconds-like keys (``*_s``,
+``*_s_per_*``) are flagged as REGRESSED/IMPROVED, with the ``phases``
+split (sig batch / state HTR / committees / operations —
 docs/OBSERVABILITY.md) listed first so an operations-term regression is
-the first line you read, not bench archaeology. Exit status 1 when any
-seconds-like metric regressed beyond the threshold (CI-friendly).
+the first line you read, not bench archaeology.
+
+The regression gate is noise-aware: a seconds metric REGRESSES only when
+it moved by BOTH the relative threshold (``--threshold``, default 5%)
+AND the absolute floor (``--floor``, default 2 ms) — a 0.0004 s →
+0.0006 s jitter on a microsecond-scale term is 50% relative but pure
+noise, while a 0.30 s → 0.33 s operations term is real. Exit status 1
+when any seconds-like metric regressed beyond the gate (CI-friendly).
+
+Trend mode (``--trend``) renders the per-phase seconds of every config
+across the given evidence files (column label = the ``rNN`` tail of the
+filename) as a markdown table — the PR-over-PR trajectory the ROADMAP
+quotes, generated instead of hand-maintained.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
+
+# non-phase seconds leaves worth a trend row (when a config carries them)
+_TREND_HEADLINE = (
+    "block_s",
+    "warm_s",
+    "sequential_block_s",
+    "pipelined_block_s",
+    "s_per_epoch",
+    "warm_s_per_epoch",
+    "adversarial_s",
+    "recovery_latency_mean_s",
+)
 
 
 def _configs(doc: dict) -> dict:
@@ -48,9 +75,11 @@ def _seconds_like(key: str) -> bool:
     return leaf.endswith("_s") or "_s_per_" in leaf or leaf.endswith("_ms")
 
 
-def compare(a: dict, b: dict, threshold: float) -> "tuple[list, int]":
+def compare(a: dict, b: dict, threshold: float,
+            floor: float = 0.002) -> "tuple[list, int]":
     """Rows of (config, metric, old, new, ratio, verdict); count of
-    seconds-like regressions beyond the threshold."""
+    seconds-like regressions beyond the noise gate (relative threshold
+    AND absolute floor — see the module docstring)."""
     rows: list = []
     regressions = 0
     shared_configs = sorted(set(_configs(a)) & set(_configs(b)))
@@ -71,22 +100,92 @@ def compare(a: dict, b: dict, threshold: float) -> "tuple[list, int]":
             ratio = (new / old) if old else None
             verdict = ""
             if _seconds_like(key) and ratio is not None:
-                if ratio > 1 + threshold:
+                if ratio > 1 + threshold and (new - old) > floor:
                     verdict = "REGRESSED"
                     regressions += 1
-                elif ratio < 1 - threshold:
+                elif ratio < 1 - threshold and (old - new) > floor:
                     verdict = "improved"
             rows.append((name, key, old, new, ratio, verdict))
     return rows, regressions
 
 
+# ---------------------------------------------------------------------------
+# trend mode
+# ---------------------------------------------------------------------------
+
+
+def _trend_label(path: str) -> str:
+    """BENCH_r07.json -> r07 (falls back to the basename)."""
+    base = os.path.basename(path)
+    match = re.search(r"(r\d+)", base)
+    return match.group(1) if match else base.rsplit(".", 1)[0]
+
+
+def _trend_keys(leaves: dict) -> list:
+    keys = sorted(k for k in leaves if k.startswith("phases."))
+    keys.extend(k for k in _TREND_HEADLINE if k in leaves)
+    return keys
+
+
+def trend(paths: "list[str]") -> str:
+    """One markdown document: per config, a table of phase (and
+    headline) seconds across the given evidence files, oldest column
+    first (the given order)."""
+    docs = []
+    for path in paths:
+        with open(path) as f:
+            docs.append((_trend_label(path), _configs(json.load(f))))
+    config_names: list = []
+    for _, configs in docs:
+        for name in configs:
+            if name not in config_names and isinstance(configs[name], dict):
+                config_names.append(name)
+    lines = ["# bench trend — per-phase seconds over PRs", ""]
+    lines.append(
+        "columns = evidence files in the given order; `–` = the config "
+        "or metric is absent in that file (config not yet landed, or "
+        "skipped)."
+    )
+    for name in config_names:
+        per_file = [
+            (label, _numeric_leaves(configs.get(name, {})))
+            for label, configs in docs
+        ]
+        keys: list = []
+        for _, leaves in per_file:
+            for key in _trend_keys(leaves):
+                if key not in keys:
+                    keys.append(key)
+        if not keys:
+            continue
+        lines.append("")
+        lines.append(f"## {name}")
+        lines.append("")
+        header = "| metric | " + " | ".join(label for label, _ in per_file)
+        lines.append(header + " |")
+        lines.append("|---" * (len(per_file) + 1) + "|")
+        for key in keys:
+            cells = []
+            for _, leaves in per_file:
+                value = leaves.get(key)
+                cells.append("–" if value is None else f"{value:.4f}")
+            lines.append(f"| {key} | " + " | ".join(cells) + " |")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python bench_compare.py",
-        description="per-phase diff of two BENCH_*.json evidence files",
+        description="per-phase diff of two BENCH_*.json evidence files, "
+        "or (--trend) the whole trajectory as a markdown table",
     )
-    parser.add_argument("old")
-    parser.add_argument("new")
+    parser.add_argument("files", nargs="+", metavar="BENCH.json")
+    parser.add_argument(
+        "--trend",
+        action="store_true",
+        help="render the per-phase trajectory over ALL given files as "
+        "markdown instead of diffing a pair",
+    )
     parser.add_argument(
         "--threshold",
         type=float,
@@ -95,18 +194,31 @@ def main(argv=None) -> int:
         "(default 0.05)",
     )
     parser.add_argument(
+        "--floor",
+        type=float,
+        default=0.002,
+        help="absolute seconds change below which a seconds metric is "
+        "noise regardless of ratio (default 0.002)",
+    )
+    parser.add_argument(
         "--all",
         action="store_true",
         help="also print unchanged-verdict (non-seconds) metric changes",
     )
     args = parser.parse_args(argv)
 
-    with open(args.old) as f:
+    if args.trend:
+        sys.stdout.write(trend(args.files))
+        return 0
+    if len(args.files) != 2:
+        parser.error("diff mode takes exactly two files (or use --trend)")
+
+    with open(args.files[0]) as f:
         a = json.load(f)
-    with open(args.new) as f:
+    with open(args.files[1]) as f:
         b = json.load(f)
 
-    rows, regressions = compare(a, b, args.threshold)
+    rows, regressions = compare(a, b, args.threshold, args.floor)
     current = None
     shown = 0
     for name, key, old, new, ratio, verdict in rows:
@@ -120,11 +232,11 @@ def main(argv=None) -> int:
         print(f"  {key:<44} {old:>12.4f} -> {new:>12.4f}  {ratio_s}{tag}")
         shown += 1
     if not shown:
-        print("no metric changes beyond threshold "
-              f"({args.threshold:.0%}) in shared configs")
+        print("no metric changes beyond the noise gate "
+              f"({args.threshold:.0%} and {args.floor}s) in shared configs")
     print(
         f"\n{regressions} seconds-metric regression(s) beyond "
-        f"{args.threshold:.0%}"
+        f"{args.threshold:.0%} + {args.floor}s"
     )
     return 1 if regressions else 0
 

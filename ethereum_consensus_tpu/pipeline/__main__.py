@@ -20,6 +20,11 @@ Telemetry exports (docs/OBSERVABILITY.md):
   flush dispatch/verify/settle windows and rollbacks visible.
 * ``--metrics-out PATH`` — dump the process-wide metrics registry
   snapshot (digests, pubkey-cache hit rates, flush shapes, ...) as JSON.
+* ``--serve PORT``       — run the live introspection server
+  (``telemetry/server.py``: /metrics Prometheus exposition, /healthz,
+  /blocks lineage, /events SSE) for the selfcheck's duration; 0 picks
+  an ephemeral port. ``--hold SECONDS`` keeps it up after the checks
+  finish so you can scrape/curl around (``make serve``).
 
 Exit code 0 = all checks passed; any failure prints the reason and
 exits 1.
@@ -156,11 +161,22 @@ def _flag_value(argv: "list[str]", flag: str) -> "str | None":
 def main(argv: "list[str]") -> int:
     trace_out = _flag_value(argv, "--trace-out")
     metrics_out = _flag_value(argv, "--metrics-out")
+    serve_port = _flag_value(argv, "--serve")
+    hold_s = _flag_value(argv, "--hold")
     if "--selfcheck" not in argv:
         print(__doc__)
         return 2
     from ..telemetry import metrics, spans
 
+    server = None
+    if serve_port is not None:
+        from ..telemetry.server import IntrospectionServer
+
+        server = IntrospectionServer(port=int(serve_port)).start()
+        print(
+            f"introspection server on {server.url()} "
+            "(/metrics /healthz /blocks /events)"
+        )
     if trace_out:
         spans.start_recording()
     try:
@@ -169,6 +185,8 @@ def main(argv: "list[str]") -> int:
         _selfcheck_window()
     except Exception as exc:  # noqa: BLE001 — smoke must report, not crash
         print(f"SELFCHECK FAILED: {type(exc).__name__}: {exc}")
+        if server is not None:
+            server.stop()
         return 1
     finally:
         if trace_out:
@@ -182,6 +200,16 @@ def main(argv: "list[str]") -> int:
                 json.dump(metrics.snapshot(), f, indent=1, sort_keys=True)
             print(f"metrics snapshot written: {metrics_out}")
     print("selfcheck OK")
+    if server is not None:
+        if hold_s is not None and float(hold_s) > 0:
+            import time as _time
+
+            print(
+                f"holding the introspection server for {hold_s}s "
+                f"({server.url('/blocks')} has the selfcheck's lineage)"
+            )
+            _time.sleep(float(hold_s))
+        server.stop()
     return 0
 
 

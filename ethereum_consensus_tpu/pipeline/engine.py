@@ -60,6 +60,10 @@ import time
 from ..error import Error
 from ..models.signature_batch import SignatureBatch, defer_flushes
 from ..models.transition import Validation
+from ..telemetry import flight as _flight
+from ..telemetry import metrics as _metrics
+from ..telemetry import phases as _phases
+from ..telemetry import spans as _spans
 from ..utils import trace
 from .errors import PipelineBrokenError
 from .scheduler import FlushPolicy, VerifyScheduler, Window
@@ -68,16 +72,32 @@ from .stats import PipelineStats
 __all__ = ["ChainPipeline", "PipelineBrokenError"]
 
 
+def _state_root_hex(signed_block) -> str:
+    """The block's claimed post-state root — a free field read, so the
+    lineage root costs no hashing."""
+    return bytes(signed_block.message.state_root).hex()
+
+
 class _Entry:
     """One speculatively applied block: the block itself (kept for the
-    rollback re-application) and its collected signature batch."""
+    rollback re-application), its collected signature batch, and — when
+    the flight-recorder hook is active — the stage-A timing stamps the
+    lineage record is assembled from (telemetry/flight.py)."""
 
-    __slots__ = ("signed_block", "slot", "batch")
+    __slots__ = (
+        "signed_block", "slot", "batch",
+        "t_start", "t_applied", "stage_a_s", "fork", "phases",
+    )
 
     def __init__(self, signed_block, slot: int, batch: SignatureBatch):
         self.signed_block = signed_block
         self.slot = slot
         self.batch = batch
+        self.t_start = None
+        self.t_applied = None
+        self.stage_a_s = None
+        self.fork = None
+        self.phases = None
 
 
 class ChainPipeline:
@@ -152,6 +172,14 @@ class ChainPipeline:
         last committed position."""
         self._check_usable()
         self.stats.start()
+        # zero-overhead guard: one bool read when no flight recorder or
+        # introspection server is attached (tests/test_flight_server.py)
+        hooked = _flight.HOOK.active
+        mark = (
+            _spans.RECORDER.mark()
+            if hooked and _spans.RECORDER.enabled
+            else None
+        )
         t0 = time.perf_counter()
         sink = SignatureBatch()
         slot = int(signed_block.message.slot)
@@ -162,10 +190,29 @@ class ChainPipeline:
                         signed_block, self._validation
                     )
         except Error as exc:
-            self.stats.block_submitted(time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.stats.block_submitted(t1 - t0)
+            if hooked:
+                failed = self._make_entry(signed_block, slot, sink, t0, t1,
+                                          mark)
+                self._emit_block(failed, "rolled-back", blame=exc)
+                _flight.HOOK.emit(
+                    "rollback",
+                    {
+                        "slot": slot,
+                        "seq": None,
+                        "structural": True,
+                        "error": type(exc).__name__,
+                    },
+                )
             self._fail_structural(exc)  # never returns
-        self._pending.append(_Entry(signed_block, slot, sink))
-        self.stats.block_submitted(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        if hooked:
+            entry = self._make_entry(signed_block, slot, sink, t0, t1, mark)
+        else:
+            entry = _Entry(signed_block, slot, sink)
+        self._pending.append(entry)
+        self.stats.block_submitted(t1 - t0)
         if len(self._pending) >= self.policy.window_size:
             self._dispatch_pending()
 
@@ -189,8 +236,10 @@ class ChainPipeline:
         state (the context-manager exit path when the body raised)."""
         if self._closed:
             return
-        self._sched.drop_all()
-        self._pending.clear()
+        dropped = self._sched.drop_all()
+        pending, self._pending = self._pending, []
+        if _flight.HOOK.active:
+            self._emit_discards(dropped, pending)
         self._materialize_committed()
         if self._broken is None:
             self._broken = PipelineBrokenError("pipeline aborted")
@@ -205,6 +254,97 @@ class ChainPipeline:
             self.close()
         else:
             self.abort()
+
+    # -- flight-recorder lineage assembly ------------------------------------
+    def _make_entry(self, signed_block, slot: int, sink, t0: float,
+                    t1: float, mark) -> _Entry:
+        """An entry carrying the stage-A stamps the lineage record needs
+        (hook-active path only): apply window, post-apply fork, and the
+        span-derived phase split when the span recorder is live."""
+        entry = _Entry(signed_block, slot, sink)
+        entry.t_start = t0
+        entry.t_applied = t1
+        entry.stage_a_s = t1 - t0
+        entry.fork = self._executor.state.version().name.lower()
+        if mark is not None:
+            entry.phases = _phases.attribution(
+                _spans.RECORDER.records_since(mark)
+            )
+        return entry
+
+    def _emit_block(self, entry: _Entry, outcome: str, window=None,
+                    blame=None, degraded=None) -> None:
+        """Assemble one ``BlockLineage`` from the entry's stage-A stamps
+        and its window's stage-B stamps, and publish it on the commit
+        hook. Callers guard with ``_flight.HOOK.active``."""
+        now = time.perf_counter()
+        queue_wait = 0.0
+        settle_s = None
+        if window is not None and window.t_dispatch is not None:
+            if entry.t_applied is not None:
+                queue_wait = max(0.0, window.t_dispatch - entry.t_applied)
+            if window.t_settled is not None:
+                settle_s = max(0.0, window.t_settled - window.t_dispatch)
+        if degraded is None:
+            degraded = bool(window.degraded) if window is not None else False
+        _flight.HOOK.emit(
+            "block",
+            _flight.BlockLineage(
+                slot=entry.slot,
+                root=_state_root_hex(entry.signed_block),
+                fork=entry.fork,
+                outcome=outcome,
+                stage_a_s=entry.stage_a_s,
+                phases=entry.phases,
+                queue_wait_s=queue_wait,
+                flush_seq=window.seq if window is not None else None,
+                flush_slots=(
+                    tuple(e.slot for e in window.entries)
+                    if window is not None
+                    else ()
+                ),
+                flush_sets=len(window.batch) if window is not None else 0,
+                verify_s=window.verify_s if window is not None else None,
+                settle_s=settle_s,
+                total_s=(
+                    now - entry.t_start
+                    if entry.t_start is not None
+                    else None
+                ),
+                retries=(
+                    max(0, window.attempts - 1) if window is not None else 0
+                ),
+                degraded=degraded,
+                blame=(
+                    {"error": type(blame).__name__, "detail": str(blame)}
+                    if blame is not None
+                    else None
+                ),
+            ),
+        )
+
+    def _emit_discards(self, dropped_windows, pending_entries,
+                       blame=None) -> None:
+        """Lineage for speculative work abandoned by someone else's
+        failure: every block of every dropped in-flight window plus the
+        never-dispatched pending entries."""
+        for window in dropped_windows:
+            for entry in window.entries:
+                self._emit_block(entry, "discarded", window=window,
+                                 blame=blame)
+        for entry in pending_entries:
+            self._emit_block(entry, "discarded", blame=blame)
+
+    def _emit_head(self, entry: _Entry, blocks: int, seq=None) -> None:
+        _flight.HOOK.emit(
+            "head",
+            {
+                "slot": entry.slot,
+                "root": _state_root_hex(entry.signed_block),
+                "blocks": blocks,
+                "seq": seq,
+            },
+        )
 
     # -- internals -----------------------------------------------------------
     def _check_usable(self) -> None:
@@ -231,7 +371,7 @@ class ChainPipeline:
             self.stats.checkpoint()
         if not len(merged) and not self.policy.flush_empty:
             # a window that deferred zero sets has nothing to prove
-            self._commit(entries, candidate)
+            self._commit(entries, candidate, window=None)
             return
         window = Window(entries, merged, candidate, self._seq)
         self._seq += 1
@@ -249,24 +389,57 @@ class ChainPipeline:
             # a bounded settle expired (verifier wedged): abandon every
             # in-flight window, restore the committed position, and break
             # the pipeline — the submitter gets attribution, not a hang
-            self._sched.drop_all()
-            self._pending.clear()
+            _metrics.gauge("pipeline.broken").set(1)
+            dropped = self._sched.drop_all()
+            pending, self._pending = self._pending, []
+            if _flight.HOOK.active:
+                stuck = getattr(exc, "stuck_window", None)
+                if stuck is not None:
+                    dropped = [stuck] + dropped
+                self._emit_discards(dropped, pending, blame=exc)
+                _flight.HOOK.emit(
+                    "broken",
+                    {
+                        "window_seq": exc.window_seq,
+                        "slots": list(exc.slots),
+                        "detail": str(exc),
+                    },
+                )
             self._materialize_committed()
             self._broken = exc
             self.stats.stop()
             raise
         if all(verdicts):
-            self._commit(window.entries, window.post_state)
+            self._commit(window.entries, window.post_state, window=window)
             return
         self._rollback(window, verdicts)  # raises
 
-    def _commit(self, entries, checkpoint) -> None:
+    def _commit(self, entries, checkpoint, window=None) -> None:
         if checkpoint is not None:
             self._checkpoint = checkpoint
             self._since_checkpoint = []
         else:
             self._since_checkpoint.extend(e.signed_block for e in entries)
         self.stats.blocks_were_committed(len(entries))
+        if _flight.HOOK.active and entries:
+            for entry in entries:
+                self._emit_block(entry, "committed", window=window)
+            self._emit_head(
+                entries[-1], len(entries),
+                seq=window.seq if window is not None else None,
+            )
+            _flight.HOOK.emit(
+                "commit",
+                {
+                    "seq": window.seq if window is not None else None,
+                    "slots": [e.slot for e in entries],
+                    "sets": len(window.batch) if window is not None else 0,
+                    "checkpoint": checkpoint is not None,
+                    "degraded": (
+                        bool(window.degraded) if window is not None else False
+                    ),
+                },
+            )
         trace.event(
             "pipeline.commit",
             blocks=len(entries),
@@ -315,8 +488,34 @@ class ChainPipeline:
             committed_blocks=fail_block,
             error=type(error).__name__,
         )
-        self._sched.drop_all()
-        self._pending.clear()
+        hooked = _flight.HOOK.active
+        if hooked:
+            # disposition of every block the failed window carried: the
+            # proven prefix commits (re-applied below without re-pairing),
+            # the blamed block rolls back, the rest of the speculative
+            # window is discarded
+            for entry in window.entries[:fail_block]:
+                self._emit_block(entry, "committed", window=window)
+            self._emit_block(
+                window.entries[fail_block], "rolled-back", window=window,
+                blame=error,
+            )
+            for entry in window.entries[fail_block + 1:]:
+                self._emit_block(entry, "discarded", window=window)
+            _flight.HOOK.emit(
+                "rollback",
+                {
+                    "seq": window.seq,
+                    "slot": window.entries[fail_block].slot,
+                    "structural": False,
+                    "error": type(error).__name__,
+                    "committed_blocks": fail_block,
+                },
+            )
+        dropped = self._sched.drop_all()
+        pending, self._pending = self._pending, []
+        if hooked:
+            self._emit_discards(dropped, pending)
         self._materialize_committed()
         if fail_block > 0:
             proven = window.entries[:fail_block]
@@ -328,6 +527,8 @@ class ChainPipeline:
                     )
             self._since_checkpoint.extend(e.signed_block for e in proven)
             self.stats.blocks_were_committed(fail_block)
+            if hooked:
+                self._emit_head(proven[-1], fail_block, seq=window.seq)
         self._broken = error
         self.stats.stop()
         raise error
@@ -342,6 +543,7 @@ class ChainPipeline:
         re-verify). Then the structural error propagates with the state
         at the last committed position."""
         pending, self._pending = self._pending, []
+        hooked = _flight.HOOK.active
         try:
             while not self._sched.idle:
                 self._settle_oldest()  # an earlier window failure raises
@@ -349,11 +551,25 @@ class ChainPipeline:
             if pending:
                 self.stats.sequential_reverify()
                 for entry in pending:
-                    self._executor.apply_block_with_validation(
-                        entry.signed_block, self._validation
-                    )
+                    try:
+                        self._executor.apply_block_with_validation(
+                            entry.signed_block, self._validation
+                        )
+                    except Error as inline_exc:
+                        if hooked:
+                            self._emit_block(
+                                entry, "rolled-back", blame=inline_exc
+                            )
+                        raise
                     self._since_checkpoint.append(entry.signed_block)
                     self.stats.blocks_were_committed(1)
+                    if hooked:
+                        # committed, but verified IN-LINE on the host (the
+                        # terminal sequential re-verify) — the lineage
+                        # marks the lost overlap like a degraded window
+                        self._emit_block(entry, "committed", degraded=True)
+                if hooked:
+                    self._emit_head(pending[-1], len(pending))
         except Error as earlier:
             if self._broken is None:  # a pending inline re-apply failed
                 self._materialize_committed()

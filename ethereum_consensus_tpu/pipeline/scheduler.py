@@ -120,9 +120,21 @@ class Window:
     post-window state snapshot the engine installs as the new checkpoint
     when the verdicts come back clean (``post_state`` is None
     otherwise; the committed position is then checkpoint + blocks).
-    ``attempts`` counts dispatches (retries of transient faults)."""
+    ``attempts`` counts dispatches (retries of transient faults).
 
-    __slots__ = ("entries", "batch", "post_state", "future", "seq", "attempts")
+    The timing stamps are the flight recorder's raw material
+    (telemetry/flight.py): ``t_dispatch``/``t_settled`` bound the settle
+    wall, ``verify_s`` accumulates the worker's busy seconds across
+    attempts (plus any in-line re-verification), and ``degraded``
+    latches when the window fell back to host verification. They are
+    written by the scheduler/worker strictly BEFORE the engine reads
+    them at commit/rollback (the future's result is the happens-before
+    edge), so no lock is needed."""
+
+    __slots__ = (
+        "entries", "batch", "post_state", "future", "seq", "attempts",
+        "t_dispatch", "t_settled", "verify_s", "degraded",
+    )
 
     def __init__(self, entries, batch, post_state, seq: int):
         self.entries = entries
@@ -131,6 +143,10 @@ class Window:
         self.future = None
         self.seq = seq
         self.attempts = 0
+        self.t_dispatch = None
+        self.t_settled = None
+        self.verify_s = 0.0
+        self.degraded = False
 
 
 class VerifyScheduler:
@@ -171,9 +187,17 @@ class VerifyScheduler:
         if self.fault_injector is not None:
             pre = self.fault_injector.hook_for(window.seq, window.attempts)
         window.attempts += 1
+        stats = self.stats
+
+        def timer(seconds, _w=window):
+            # runs on the worker; the engine reads verify_s only after
+            # the future resolves, so the write needs no lock
+            stats.stage_b_busy(seconds)
+            _w.verify_s += seconds
+
         try:
             window.future = bls.verify_signature_sets_async(
-                window.batch.sets, timer=self.stats.stage_b_busy, pre=pre
+                window.batch.sets, timer=timer, pre=pre
             )
         except RuntimeError:
             _metrics.counter("pipeline.fault.dispatch_failure").inc()
@@ -195,6 +219,7 @@ class VerifyScheduler:
             sets=n_sets,
             in_flight=len(self._in_flight) + 1,
         )
+        window.t_dispatch = time.perf_counter()
         self._submit(window)
         self._in_flight.append(window)
         self.stats.flush_dispatched(n_sets)
@@ -209,12 +234,17 @@ class VerifyScheduler:
         # the stats mutator owns the pipeline.degraded_flushes registry
         # counter; only the latched gauge is set here
         _metrics.gauge("pipeline.degraded").set(1)
+        window.degraded = True
         self.stats.degraded_flush()
         trace.event(
             "pipeline.degraded", seq=window.seq, sets=len(window.batch)
         )
-        with trace.span("pipeline.flush.verify_inline", seq=window.seq):
-            return bls.verify_signature_sets(window.batch.sets)
+        t0 = time.perf_counter()
+        try:
+            with trace.span("pipeline.flush.verify_inline", seq=window.seq):
+                return bls.verify_signature_sets(window.batch.sets)
+        finally:
+            window.verify_s += time.perf_counter() - t0
 
     def settle_oldest(self) -> "tuple[Window, list[bool]]":
         """Block until the oldest in-flight window's verdicts are in;
@@ -236,12 +266,13 @@ class VerifyScheduler:
                     verdicts = window.future.result(
                         timeout=policy.settle_timeout_s
                     )
+                    window.t_settled = time.perf_counter()
                     return window, verdicts
                 except (_FutureTimeout, TimeoutError):
                     _metrics.counter("pipeline.fault.settle_timeout").inc()
                     window.future.cancel()
                     slots = self._window_slots(window)
-                    raise PipelineBrokenError(
+                    broken = PipelineBrokenError(
                         f"flush window {window.seq} (slots {list(slots)}, "
                         f"{len(window.batch)} sets) did not settle within "
                         f"{policy.settle_timeout_s}s — verifier wedged; "
@@ -249,7 +280,12 @@ class VerifyScheduler:
                         "committed position",
                         window_seq=window.seq,
                         slots=slots,
-                    ) from None
+                    )
+                    # the engine emits `discarded` lineage for the stuck
+                    # window's blocks (it was popped off the queue here,
+                    # so the error is the only path that still names it)
+                    broken.stuck_window = window
+                    raise broken from None
                 except TransientFlushError as exc:
                     _metrics.counter("pipeline.fault.transient").inc()
                     if window.attempts > policy.flush_retries:
@@ -261,7 +297,9 @@ class VerifyScheduler:
                             attempts=window.attempts,
                             error=repr(exc),
                         )
-                        return window, self._verify_inline(window)
+                        verdicts = self._verify_inline(window)
+                        window.t_settled = time.perf_counter()
+                        return window, verdicts
                     _metrics.counter("pipeline.fault.retries").inc()
                     self.stats.fault_retry()
                     backoff = window.attempts * policy.retry_backoff_s
@@ -285,14 +323,20 @@ class VerifyScheduler:
                         seq=window.seq,
                         error=repr(exc),
                     )
-                    return window, self._verify_inline(window)
+                    verdicts = self._verify_inline(window)
+                    window.t_settled = time.perf_counter()
+                    return window, verdicts
 
-    def drop_all(self) -> None:
+    def drop_all(self) -> "list[Window]":
         """Abandon every in-flight window (rollback path): the futures
         run to completion on the worker — the single-thread pool keeps
         FIFO order, and a later submit would queue behind them anyway —
-        but their verdicts are no longer consulted."""
-        self._in_flight.clear()
+        but their verdicts are no longer consulted. Returns the dropped
+        windows (the engine emits ``discarded`` lineage for their
+        speculative blocks)."""
+        dropped = self._in_flight
+        self._in_flight = []
+        return dropped
 
 
 class _InlineFuture:

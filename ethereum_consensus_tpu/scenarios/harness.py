@@ -31,6 +31,7 @@ from ..models import ops_vector
 from ..models.signature_batch import SignatureBatch, defer_flushes
 from ..pipeline import ChainPipeline, FlushPolicy
 from ..ssz.core import CachedRootList
+from ..telemetry import flight as _flight
 from ..telemetry import metrics
 from ..utils import trace
 from .mutators import MutationEnv
@@ -254,7 +255,8 @@ class StormReport:
 
 
 def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
-              fault_injector=None, check_states=True, check_columns=True):
+              fault_injector=None, check_states=True, check_columns=True,
+              serve_port=None):
     """Replay a storm-corrupted chain through the pipeline with recovery
     after every failure, asserting the full contract at each one.
 
@@ -262,6 +264,18 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
     ``sign``: ``chain_utils.sign_block`` (needed by re-signing mutators).
     ``check_states=False`` skips the per-failure bit-compare (the bench
     shape: measure recovery, still verify blame + final state).
+    ``serve_port``: when set, an introspection server
+    (``telemetry/server.py``) runs on 127.0.0.1:<port> for the storm's
+    duration (0 = ephemeral), so an adversarial replay is observable
+    live — ``/events`` streams every rollback, ``/blocks`` shows blame
+    + recovery latency per corrupted slot.
+
+    Observability (beyond the returned report): every failure observes
+    ``scenario.recovery_latency_s`` (registry histogram — it shows up in
+    ``/metrics`` and bench deltas) and bumps the per-mutator blame
+    counter ``scenario.blame.<mutator name>``; when a flight recording
+    is live, the corrupted block's lineage record is annotated with the
+    measured recovery latency (``BlockLineage.recovery_s``).
 
     Failure order: coalesced flushes settle FIFO and structural aborts
     settle earlier queued work first, so errors surface strictly in
@@ -276,6 +290,21 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
     Returns (StormReport, final executor)."""
     policy = policy or FlushPolicy(window_size=4, max_in_flight=2,
                                    checkpoint_interval=2)
+    server = None
+    if serve_port is not None:
+        from ..telemetry.server import IntrospectionServer
+
+        server = IntrospectionServer(port=serve_port).start()
+    try:
+        return _run_storm(pre_state, context, blocks, plan, policy, sign,
+                          fault_injector, check_states, check_columns)
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def _run_storm(pre_state, context, blocks, plan, policy, sign,
+               fault_injector, check_states, check_columns):
     stream, prefixes, oracle_ex = build_corrupted_stream(
         pre_state, context, blocks, plan, sign=sign,
         with_oracle=check_states or check_columns,
@@ -337,6 +366,16 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
                     StormFailure(f, mutator, exc, recovery_s)
                 )
                 metrics.counter("scenario.storm.recoveries").inc()
+                # recovery latency + blame into the registry (visible in
+                # /metrics and bench metric deltas, not just this report)
+                metrics.histogram("scenario.recovery_latency_s").observe(
+                    recovery_s
+                )
+                metrics.counter(f"scenario.blame.{mutator.name}").inc()
+                if _flight.is_recording():
+                    _flight.RECORDER.annotate_recovery(
+                        int(blocks[f].message.slot), recovery_s
+                    )
     report.wall_s = time.perf_counter() - t_start
     report.blocks_applied = len(blocks)  # honest twins replace failures
     report.stats_snapshots.append(pipe.stats.snapshot())

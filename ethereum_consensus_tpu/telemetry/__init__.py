@@ -13,12 +13,19 @@ pure-host ``ssz``/``crypto`` paths — nothing here touches jax):
 * ``phases``  — derives the bench's per-block phase attribution
   (sig batch / state HTR / committees / operations) from recorded
   transition spans.
+* ``flight``  — the chain flight recorder: a bounded ring journal of
+  per-block ``BlockLineage`` records assembled by the pipeline's
+  commit/rollback hook, with JSONL export and a query API.
+* ``server``  — the live introspection server (``/metrics`` Prometheus
+  exposition, ``/healthz``, ``/blocks``, ``/events`` SSE). NOT imported
+  here: it pulls in ``http.server``, which no pure-compute layer needs
+  — import ``ethereum_consensus_tpu.telemetry.server`` explicitly.
 
 Conventions and export formats: docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
 
-from . import metrics, phases, spans
+from . import flight, metrics, phases, spans
 
-__all__ = ["metrics", "phases", "spans"]
+__all__ = ["flight", "metrics", "phases", "spans", "server"]

@@ -30,7 +30,9 @@ counters end in ``_s``.
 
 from __future__ import annotations
 
+import random
 import threading
+import zlib
 
 __all__ = [
     "Counter",
@@ -40,6 +42,7 @@ __all__ = [
     "gauge",
     "histogram",
     "registered",
+    "registered_metrics",
     "snapshot",
     "delta",
 ]
@@ -95,12 +98,20 @@ class Gauge:
 
 
 class Histogram:
-    """Counted observations with exact count/sum/min/max and a bounded
-    sample of raw values (the newest ``sample_limit`` observations) —
-    meant for low-rate shapes like flush sizes, not per-digest rates."""
+    """Counted observations with exact streaming count/sum/min/max and a
+    FIXED-SIZE uniform reservoir of raw values (Vitter's algorithm R):
+    after ``sample_limit`` observations, each new value replaces a
+    random slot with probability ``sample_limit / count``, so the sample
+    stays a uniform draw over the whole stream and memory is bounded no
+    matter how many observations arrive (a 2^17 replay can't grow it
+    linearly the way an append-only sample would). The exact aggregates
+    are never sampled — ``summary()``/``snapshot()``/``delta()`` keep
+    their semantics; only ``values()``/``quantiles()`` read the
+    reservoir. The per-histogram RNG is seeded from the metric name, so
+    a replay's reservoir is reproducible."""
 
     __slots__ = ("name", "_lock", "_count", "_sum", "_min", "_max", "_values",
-                 "sample_limit")
+                 "_rng", "sample_limit")
 
     def __init__(self, name: str, sample_limit: int = 1 << 12):
         self.name = name
@@ -111,6 +122,7 @@ class Histogram:
         self._min = None
         self._max = None
         self._values: list = []
+        self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
 
     def observe(self, v) -> None:
         with self._lock:
@@ -120,9 +132,12 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
-            self._values.append(v)
-            if len(self._values) > self.sample_limit:
-                del self._values[: len(self._values) - self.sample_limit]
+            if len(self._values) < self.sample_limit:
+                self._values.append(v)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self.sample_limit:
+                    self._values[j] = v
 
     def summary(self) -> dict:
         with self._lock:
@@ -137,9 +152,23 @@ class Histogram:
         }
 
     def values(self) -> list:
-        """The newest observations (up to ``sample_limit``), oldest first."""
+        """The bounded reservoir sample (uniform over the stream once it
+        exceeds ``sample_limit``; the full stream in arrival order
+        before that)."""
         with self._lock:
             return list(self._values)
+
+    def quantiles(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """{q: value} estimated from the reservoir (nearest-rank over
+        the sorted sample); empty when nothing has been observed."""
+        with self._lock:
+            sample = sorted(self._values)
+        if not sample:
+            return {}
+        top = len(sample) - 1
+        return {
+            q: sample[min(top, max(0, round(q * top)))] for q in qs
+        }
 
     def __repr__(self) -> str:
         return f"Histogram({self.name}, n={self._count})"
@@ -183,6 +212,12 @@ def histogram(name: str) -> Histogram:
 def registered() -> "list[str]":
     """Registered metric names, sorted."""
     return sorted(_REGISTRY)
+
+
+def registered_metrics() -> list:
+    """The registered metric OBJECTS, sorted by name (the introspection
+    server's exposition walk — ``telemetry/server.py``)."""
+    return [_REGISTRY[name] for name in sorted(_REGISTRY)]
 
 
 def snapshot() -> dict:

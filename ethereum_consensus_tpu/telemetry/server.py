@@ -1,0 +1,421 @@
+"""Live introspection server: /metrics, /healthz, /blocks, /events.
+
+A stdlib-only threaded HTTP server over the telemetry substrate — the
+read side of the ROADMAP's serving layer, landed first so every later
+consumer (the Beacon-API read path, the device-pairing re-measure) ships
+on instrumented ground:
+
+* ``/metrics``  — the WHOLE metrics registry in Prometheus text
+  exposition format 0.0.4: counters and gauges verbatim, histograms as
+  summaries (``{quantile="..."}`` gauges from the bounded reservoir +
+  ``_sum``/``_count``) with ``_min``/``_max`` companion gauges.
+* ``/healthz``  — pipeline liveness: ``ok`` / ``degraded`` (the latched
+  ``pipeline.degraded`` gauge) / ``broken`` (the latched
+  ``pipeline.broken`` gauge, with the stuck window's seq + slots from
+  the flight recorder's ``broken`` event).
+* ``/blocks``   — recent ``BlockLineage`` records as JSON; filter by
+  ``?outcome=``, ``?min_slot=``/``?max_slot=``, rank by
+  ``?worst=<latency field>``, cap with ``?n=``.
+* ``/events``   — Server-Sent Events off the pipeline commit hook:
+  ``head`` / ``commit`` / ``rollback`` / ``broken`` (add ``block`` for
+  full lineage records with ``?kinds=head,block``). Commit order on the
+  wire IS chain order — the submitting thread emits.
+
+Concurrency model (speclint's newest scope): the accept loop runs on a
+single-worker ``ThreadPoolExecutor`` (the repo's sanctioned way to own a
+background worker); per-request threads come from
+``ThreadingHTTPServer`` with ``daemon_threads`` set; every
+``IntrospectionServer`` state write holds its instance lock; SSE
+fan-out rides the ``CommitHook``'s lock-free tuple snapshot with one
+bounded ``queue.Queue`` per client (a slow client drops events rather
+than backpressuring the pipeline — counted in
+``flight.sse_dropped_events``).
+
+Zero overhead when off: nothing here is imported by the pipeline; the
+engine's only coupling is the ``flight.HOOK.active`` bool.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import flight as _flight
+from . import metrics as _metrics
+
+__all__ = [
+    "IntrospectionServer",
+    "render_prometheus",
+    "prometheus_name",
+    "escape_label_value",
+    "health_view",
+]
+
+_QUANTILES = (0.5, 0.9, 0.99)
+_SSE_DEFAULT_KINDS = ("head", "commit", "rollback", "broken")
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format 0.0.4)
+# ---------------------------------------------------------------------------
+
+
+def prometheus_name(name: str) -> str:
+    """The registry's dotted name as a valid Prometheus metric name:
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` — dots (and anything else outside the
+    alphabet) become underscores, a leading digit gets a prefix."""
+    out = [
+        ch if (ch.isascii() and (ch.isalnum() or ch in "_:")) else "_"
+        for ch in name
+    ]
+    rendered = "".join(out) or "_"
+    if rendered[0].isdigit():
+        rendered = "_" + rendered
+    return rendered
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the text format: backslash, double
+    quote, and newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render_prometheus(metric_objects=None) -> str:
+    """The registry (or an explicit metric-object list — the golden
+    test's seam) as one exposition document. Counters/gauges render
+    verbatim; a ``Histogram`` renders as a summary — reservoir-derived
+    ``{quantile="0.5|0.9|0.99"}`` samples plus exact ``_sum``/``_count``
+    — with ``_min``/``_max`` companion gauges."""
+    if metric_objects is None:
+        metric_objects = _metrics.registered_metrics()
+    lines: list = []
+    for metric in metric_objects:
+        name = prometheus_name(metric.name)
+        lines.append(f"# HELP {name} {escape_help(metric.name)}")
+        if isinstance(metric, _metrics.Counter):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {_fmt(metric.value())}")
+        elif isinstance(metric, _metrics.Gauge):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(metric.value())}")
+        elif isinstance(metric, _metrics.Histogram):
+            summary = metric.summary()
+            lines.append(f"# TYPE {name} summary")
+            for q, value in sorted(metric.quantiles(_QUANTILES).items()):
+                label = escape_label_value(f"{q:g}")
+                lines.append(f'{name}{{quantile="{label}"}} {_fmt(value)}')
+            lines.append(f"{name}_sum {_fmt(summary['sum'])}")
+            lines.append(f"{name}_count {_fmt(summary['count'])}")
+            for bound in ("min", "max"):
+                if summary[bound] is not None:
+                    lines.append(f"# TYPE {name}_{bound} gauge")
+                    lines.append(
+                        f"{name}_{bound} {_fmt(summary[bound])}"
+                    )
+    return "\n".join(lines) + "\n"
+
+
+def escape_help(text: str) -> str:
+    """HELP-line escaping: backslash and newline (the text format does
+    not escape quotes there)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+# ---------------------------------------------------------------------------
+# health view
+# ---------------------------------------------------------------------------
+
+
+def health_view() -> dict:
+    """The /healthz document: pipeline alive / degraded / broken, with
+    the latched gauges and the stuck-window attribution when a bounded
+    settle expired."""
+    degraded = bool(_metrics.gauge("pipeline.degraded").value())
+    broken_gauge = bool(_metrics.gauge("pipeline.broken").value())
+    stuck = _flight.RECORDER.last_broken
+    broken = broken_gauge or stuck is not None
+    status = "broken" if broken else ("degraded" if degraded else "ok")
+    return {
+        "status": status,
+        "pipeline_alive": not broken,
+        "degraded": degraded,
+        "degraded_flushes": _metrics.counter(
+            "pipeline.degraded_flushes"
+        ).value(),
+        "fault_retries": _metrics.counter("pipeline.fault_retries").value(),
+        "blocks_committed": _metrics.counter(
+            "pipeline.blocks_committed"
+        ).value(),
+        "rollbacks": _metrics.counter("pipeline.rollbacks").value(),
+        "stuck_window": stuck,
+        "flight_records": len(_flight.RECORDER),
+    }
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "ect-introspect/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+    def _send(self, status: int, content_type: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, doc, status: int = 200) -> None:
+        body = json.dumps(doc, sort_keys=True, indent=1).encode("utf-8")
+        self._send(status, "application/json; charset=utf-8", body)
+
+    def _query(self) -> dict:
+        return parse_qs(urlparse(self.path).query)
+
+    def _param(self, params: dict, key: str, default=None):
+        values = params.get(key)
+        return values[0] if values else default
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        route = urlparse(self.path).path
+        try:
+            if route == "/metrics":
+                self._send(
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    render_prometheus().encode("utf-8"),
+                )
+            elif route == "/healthz":
+                view = health_view()
+                self._send_json(
+                    view, status=200 if view["pipeline_alive"] else 503
+                )
+            elif route == "/blocks":
+                self._serve_blocks()
+            elif route == "/events":
+                self._serve_events()
+            elif route == "/":
+                self._send_json(
+                    {
+                        "service": "ethereum_consensus_tpu introspection",
+                        "endpoints": [
+                            "/metrics",
+                            "/healthz",
+                            "/blocks",
+                            "/events",
+                        ],
+                        "docs": "docs/OBSERVABILITY.md",
+                    }
+                )
+            else:
+                self._send_json({"error": f"no route {route}"}, status=404)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response
+
+    def _serve_blocks(self) -> None:
+        params = self._query()
+        recorder = _flight.RECORDER
+        worst = self._param(params, "worst")
+        outcome = self._param(params, "outcome")
+        n = int(self._param(params, "n", "128"))
+        try:
+            if worst is not None:
+                records = recorder.worst(n, field=worst)
+            else:
+                records = recorder.records()
+                min_slot = self._param(params, "min_slot")
+                max_slot = self._param(params, "max_slot")
+                if min_slot is not None or max_slot is not None:
+                    lo = int(min_slot) if min_slot is not None else 0
+                    hi = (
+                        int(max_slot)
+                        if max_slot is not None
+                        else (1 << 62)
+                    )
+                    records = [r for r in records if lo <= r.slot <= hi]
+                if outcome is not None:
+                    records = [
+                        r
+                        for r in records
+                        if r.outcome == outcome or r.disposition == outcome
+                    ]
+                records = records[-n:]
+        except ValueError as exc:
+            self._send_json({"error": str(exc)}, status=400)
+            return
+        self._send_json(
+            {
+                "count": len(records),
+                "recording": _flight.is_recording(),
+                "capacity": recorder.capacity,
+                "blocks": [r.to_dict() for r in records],
+            }
+        )
+
+    def _serve_events(self) -> None:
+        params = self._query()
+        kinds_param = self._param(params, "kinds")
+        kinds = (
+            tuple(k.strip() for k in kinds_param.split(",") if k.strip())
+            if kinds_param
+            else _SSE_DEFAULT_KINDS
+        )
+        inbox: queue.Queue = queue.Queue(maxsize=1024)
+
+        def push(kind, payload):
+            if kind not in kinds:
+                return
+            try:
+                inbox.put_nowait((kind, payload))
+            except queue.Full:
+                # a slow client drops events; it must never backpressure
+                # the pipeline through the hook
+                _metrics.counter("flight.sse_dropped_events").inc()
+
+        _flight.HOOK.subscribe(push)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(b": ect introspection event stream\n\n")
+            self.wfile.flush()
+            while not getattr(self.server, "stopping", False):
+                try:
+                    kind, payload = inbox.get(timeout=0.25)
+                except queue.Empty:
+                    # heartbeat comment: keeps intermediaries from timing
+                    # the stream out and surfaces dead clients promptly
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                if isinstance(payload, _flight.BlockLineage):
+                    payload = payload.to_dict()
+                data = json.dumps(payload, sort_keys=True)
+                self.wfile.write(
+                    f"event: {kind}\ndata: {data}\n\n".encode("utf-8")
+                )
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            _flight.HOOK.unsubscribe(push)
+
+
+class IntrospectionServer:
+    """Start/stoppable introspection endpoint over the process-wide
+    telemetry state.
+
+    Usage::
+
+        server = IntrospectionServer(port=0).start()   # 0 = ephemeral
+        ... replay ...
+        server.stop()
+
+    or as a context manager. ``start`` also begins a flight recording
+    (``flight.start()``) unless told not to, so ``/blocks`` is live the
+    moment the server is."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        self._host = host
+        self._requested_port = port
+        self._httpd = None
+        self._pool = None
+        self._flight_started = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, start_flight: bool = True) -> "IntrospectionServer":
+        with self._lock:
+            if self._httpd is not None:
+                return self
+            httpd = ThreadingHTTPServer(
+                (self._host, self._requested_port), _Handler
+            )
+            # non-daemon handler threads + block_on_close: server_close()
+            # JOINS every in-flight handler, so stop() returns only after
+            # SSE subscribers have detached from the commit hook (their
+            # loops exit within one `stopping` poll, so the join is
+            # bounded at ~0.25s)
+            httpd.daemon_threads = False
+            httpd.stopping = False
+            pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="introspection-accept"
+            )
+            pool.submit(httpd.serve_forever, 0.1)
+            self._httpd = httpd
+            self._pool = pool
+            self._flight_started = bool(
+                start_flight and not _flight.is_recording()
+            )
+        if self._flight_started:
+            _flight.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            httpd, pool = self._httpd, self._pool
+            flight_started = self._flight_started
+            self._httpd = None
+            self._pool = None
+            self._flight_started = False
+        if httpd is None:
+            return
+        httpd.stopping = True  # SSE loops exit at their next poll
+        httpd.shutdown()
+        httpd.server_close()
+        pool.shutdown(wait=False)
+        if flight_started:
+            _flight.stop()
+
+    def __enter__(self) -> "IntrospectionServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- addressing ----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    @property
+    def port(self) -> int:
+        httpd = self._httpd
+        if httpd is None:
+            raise RuntimeError("server is not running")
+        return httpd.server_address[1]
+
+    def url(self, path: str = "/") -> str:
+        return f"http://{self._host}:{self.port}{path}"
+
+    def __repr__(self) -> str:
+        if self.running:
+            return f"IntrospectionServer(on {self.url()})"
+        return "IntrospectionServer(stopped)"

@@ -202,6 +202,17 @@ class SpanRecorder:
         with self._lock:
             return list(self._spans)
 
+    def mark(self) -> int:
+        """A watermark for ``records_since``: consumes one span id, so
+        every span begun after the mark has ``span_id > mark``. Cheap
+        (no lock) — the pipeline's per-block phase-split probe."""
+        return next(self._ids)
+
+    def records_since(self, mark: int) -> "list[SpanRecord]":
+        """Completed spans begun after ``mark`` (consistent copy)."""
+        with self._lock:
+            return [r for r in self._spans if r.span_id > mark]
+
     def chrome_trace(self) -> dict:
         """The buffer as a Chrome trace-event JSON document
         (Perfetto / ``chrome://tracing`` loadable). Timestamps are

@@ -1,0 +1,74 @@
+"""bench_compare: the noise-aware regression gate (relative threshold
+AND absolute floor) and the --trend trajectory table."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_compare", Path(__file__).parent.parent / "bench_compare.py"
+)
+bench_compare = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_compare)
+
+
+def _doc(**phases_and_leaves):
+    return {"configs": {"cfg": dict(phases_and_leaves)}}
+
+
+def test_gate_requires_both_threshold_and_floor():
+    # 50% relative jump on a microsecond-scale term: noise (under floor)
+    a = _doc(tiny_s=0.0004, big_s=0.300)
+    b = _doc(tiny_s=0.0006, big_s=0.330)
+    rows, regressions = bench_compare.compare(a, b, threshold=0.05,
+                                              floor=0.002)
+    verdicts = {key: verdict for _, key, _, _, _, verdict in rows}
+    assert verdicts["tiny_s"] == ""        # 50% but only +0.2ms: noise
+    assert verdicts["big_s"] == "REGRESSED"  # 10% and +30ms: real
+    assert regressions == 1
+
+
+def test_gate_improvement_also_floor_filtered():
+    a = _doc(tiny_s=0.0006, big_s=0.330)
+    b = _doc(tiny_s=0.0004, big_s=0.300)
+    rows, regressions = bench_compare.compare(a, b, threshold=0.05,
+                                              floor=0.002)
+    verdicts = {key: verdict for _, key, _, _, _, verdict in rows}
+    assert verdicts["tiny_s"] == ""
+    assert verdicts["big_s"] == "improved"
+    assert regressions == 0
+
+
+def test_phases_sort_first_in_diff_rows():
+    a = {"configs": {"cfg": {"zz_s": 1.0, "phases": {"operations_s": 0.2}}}}
+    b = {"configs": {"cfg": {"zz_s": 2.0, "phases": {"operations_s": 0.4}}}}
+    rows, _ = bench_compare.compare(a, b, threshold=0.05)
+    assert rows[0][1] == "phases.operations_s"
+
+
+def test_trend_renders_markdown_across_files(tmp_path):
+    r1 = tmp_path / "BENCH_r01.json"
+    r2 = tmp_path / "BENCH_r02.json"
+    r1.write_text(json.dumps({"configs": {"cfg": {
+        "block_s": 0.30, "phases": {"operations_s": 0.23},
+    }}}))
+    r2.write_text(json.dumps({"configs": {
+        "cfg": {"block_s": 0.11, "phases": {"operations_s": 0.04}},
+        "newcfg": {"phases": {"sig_batch_s": 0.04}},
+    }}))
+    out = bench_compare.trend([str(r1), str(r2)])
+    assert "## cfg" in out and "## newcfg" in out
+    assert "| metric | r01 | r02 |" in out
+    assert "| phases.operations_s | 0.2300 | 0.0400 |" in out
+    assert "| block_s | 0.3000 | 0.1100 |" in out
+    # a config absent from an older file renders the absent marker
+    assert "| phases.sig_batch_s | – | 0.0400 |" in out
+
+
+def test_trend_cli_exit_zero(tmp_path, capsys):
+    path = tmp_path / "BENCH_r09.json"
+    path.write_text(json.dumps({"configs": {"cfg": {
+        "phases": {"operations_s": 0.1}}}}))
+    rc = bench_compare.main(["--trend", str(path)])
+    assert rc == 0
+    assert "bench trend" in capsys.readouterr().out

@@ -1,0 +1,570 @@
+"""Flight recorder + introspection server: per-block lineage off the
+pipeline commit hook, Prometheus text exposition, /healthz transitions,
+SSE commit ordering, ring eviction + JSONL roundtrip, and the
+zero-overhead-when-off contract (docs/OBSERVABILITY.md).
+"""
+
+import json
+import sys
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import chain_utils  # noqa: E402
+from chain_utils import fresh_genesis, produce_chain  # noqa: E402
+
+from ethereum_consensus_tpu.error import InvalidBlock  # noqa: E402
+from ethereum_consensus_tpu.executor import Executor  # noqa: E402
+from ethereum_consensus_tpu.pipeline import (  # noqa: E402
+    ChainPipeline,
+    FlushPolicy,
+    PipelineBrokenError,
+)
+from ethereum_consensus_tpu.pipeline.faults import FaultInjector  # noqa: E402
+from ethereum_consensus_tpu.scenarios import (  # noqa: E402
+    bad_proposer_signature,
+    bad_state_root,
+    run_storm,
+)
+from ethereum_consensus_tpu.telemetry import (  # noqa: E402
+    flight,
+    metrics,
+    server as tel_server,
+)
+
+
+@pytest.fixture()
+def recording():
+    """A fresh flight recording for the test's duration, with the
+    process-latched health gauges reset."""
+    metrics.gauge("pipeline.degraded").set(0)
+    metrics.gauge("pipeline.broken").set(0)
+    rec = flight.start()
+    try:
+        yield rec
+    finally:
+        flight.stop()
+        rec.clear()
+
+
+@pytest.fixture()
+def live_server(recording):
+    srv = tel_server.IntrospectionServer(port=0).start(start_flight=False)
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def _get(url, timeout=10):
+    return urllib.request.urlopen(url, timeout=timeout).read()
+
+
+def _get_json(url, timeout=10):
+    return json.loads(_get(url, timeout))
+
+
+@pytest.fixture(scope="module")
+def chain32():
+    state, ctx = fresh_genesis(64, "minimal")
+    blocks = produce_chain(state, ctx, 32)
+    return state, ctx, blocks
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_golden_rendering():
+    """Exact text-format output for one counter, one gauge, and one
+    histogram — name sanitization, summary quantiles, exact _sum/_count,
+    min/max companion gauges."""
+    c = metrics.Counter("golden.requests")
+    c.inc(3)
+    g = metrics.Gauge("golden.queue-depth")  # '-' must sanitize
+    g.set(2)
+    h = metrics.Histogram("golden.latency_s", sample_limit=64)
+    for v in (1, 2, 3, 4):
+        h.observe(v)
+    text = tel_server.render_prometheus([c, g, h])
+    assert text.splitlines() == [
+        "# HELP golden_requests golden.requests",
+        "# TYPE golden_requests counter",
+        "golden_requests 3",
+        "# HELP golden_queue_depth golden.queue-depth",
+        "# TYPE golden_queue_depth gauge",
+        "golden_queue_depth 2",
+        "# HELP golden_latency_s golden.latency_s",
+        "# TYPE golden_latency_s summary",
+        'golden_latency_s{quantile="0.5"} 3',
+        'golden_latency_s{quantile="0.9"} 4',
+        'golden_latency_s{quantile="0.99"} 4',
+        "golden_latency_s_sum 10",
+        "golden_latency_s_count 4",
+        "# TYPE golden_latency_s_min gauge",
+        "golden_latency_s_min 1",
+        "# TYPE golden_latency_s_max gauge",
+        "golden_latency_s_max 4",
+    ]
+
+
+def test_prometheus_name_sanitization_and_label_escaping():
+    assert tel_server.prometheus_name("a.b.c_s") == "a_b_c_s"
+    assert tel_server.prometheus_name("3startswithdigit") == "_3startswithdigit"
+    assert tel_server.prometheus_name("weird séance") == "weird_s_ance"
+    assert (
+        tel_server.escape_label_value('say "hi"\nback\\slash')
+        == 'say \\"hi\\"\\nback\\\\slash'
+    )
+    assert tel_server.escape_help("line\nbreak\\x") == "line\\nbreak\\\\x"
+
+
+def test_metrics_endpoint_scrapes_whole_registry(live_server):
+    metrics.counter("flighttest.scrape_marker").inc(7)
+    metrics.counter("pipeline.blocks_committed")  # get-or-create
+    metrics.histogram("pipeline.flush_size")
+    body = _get(live_server.url("/metrics")).decode()
+    assert "flighttest_scrape_marker 7" in body
+    # pipeline registry counters render under sanitized names
+    assert "# TYPE pipeline_blocks_committed counter" in body
+    # histograms render as summaries
+    assert "pipeline_flush_size_count" in body
+
+
+# ---------------------------------------------------------------------------
+# histogram reservoir (the bounded-memory satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_reservoir_bounds_memory_exact_aggregates():
+    h = metrics.Histogram("flighttest.reservoir", sample_limit=256)
+    n = 50_000
+    for i in range(n):
+        h.observe(i)
+    assert len(h.values()) == 256  # bounded no matter the stream length
+    s = h.summary()
+    assert s["count"] == n
+    assert s["sum"] == n * (n - 1) // 2  # exact, never sampled
+    assert s["min"] == 0 and s["max"] == n - 1
+    q = h.quantiles((0.5, 0.99))
+    # a 256-sample uniform reservoir over 0..49999: loose sanity bands
+    assert 0.3 * n < q[0.5] < 0.7 * n
+    assert q[0.99] > 0.8 * n
+
+
+def test_histogram_reservoir_keeps_delta_semantics():
+    h = metrics.histogram("flighttest.delta_hist")
+    before = metrics.snapshot()
+    h.observe(10)
+    h.observe(30)
+    d = metrics.delta(before)
+    assert d["flighttest.delta_hist"] == {"count": 2, "sum": 40, "mean": 20}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, queries, JSONL
+# ---------------------------------------------------------------------------
+
+
+def _fake_lineage(slot, outcome="committed", **kw):
+    return flight.BlockLineage(
+        slot=slot, root=f"{slot:064x}", fork="phase0", outcome=outcome, **kw
+    )
+
+
+def test_ring_eviction_and_jsonl_roundtrip(tmp_path):
+    rec = flight.FlightRecorder(capacity=4)
+    for slot in range(10):
+        rec.handle("block", _fake_lineage(slot, total_s=float(slot)))
+    assert len(rec) == 4
+    assert [r.slot for r in rec.records()] == [6, 7, 8, 9]  # newest survive
+
+    path = str(tmp_path / "flight.jsonl")
+    assert rec.write_jsonl(path) == 4
+    loaded = flight.read_jsonl(path)
+    assert [r.to_dict() for r in loaded] == [r.to_dict() for r in rec.records()]
+
+
+def test_query_api_slot_range_outcome_worst():
+    rec = flight.FlightRecorder(capacity=64)
+    rec.handle("block", _fake_lineage(1, total_s=0.5))
+    rec.handle("block", _fake_lineage(2, outcome="rolled-back", total_s=2.0,
+                                      blame={"error": "InvalidBlock",
+                                             "detail": "x"}))
+    rec.handle("block", _fake_lineage(3, total_s=1.0, degraded=True))
+    rec.handle("block", _fake_lineage(4, outcome="discarded"))
+
+    assert [r.slot for r in rec.by_slot_range(2, 3)] == [2, 3]
+    assert [r.slot for r in rec.by_outcome("rolled-back")] == [2]
+    # disposition strings are queryable too
+    assert [r.slot for r in rec.by_outcome("degraded-inline")] == [3]
+    assert [r.slot for r in rec.worst(2, field="total_s")] == [2, 3]
+    with pytest.raises(ValueError):
+        rec.worst(1, field="not_a_latency")
+    assert rec.records()[1].disposition == "rolled-back"
+    assert rec.records()[2].disposition == "degraded-inline"
+
+
+def test_annotate_recovery_backfills_newest_failure():
+    rec = flight.FlightRecorder(capacity=8)
+    rec.handle("block", _fake_lineage(5, outcome="rolled-back"))
+    rec.handle("block", _fake_lineage(5))  # the honest twin, committed
+    assert rec.annotate_recovery(5, 0.25)
+    failures = rec.by_outcome("rolled-back")
+    assert failures[0].recovery_s == 0.25
+    assert rec.by_outcome("committed")[0].recovery_s is None
+    assert not rec.annotate_recovery(999, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance replay: 32 blocks, server up, lineage + SSE + scrape
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.server_smoke
+def test_pipelined_32_block_replay_lineage_sse_and_scrapes(
+    chain32, live_server
+):
+    """The ISSUE acceptance shape: a pipelined 32-block replay with the
+    server running yields a lineage record for every block whose latency
+    fields sum to within 10% of its measured wall time, /metrics is
+    scrape-able mid-replay, and an SSE client observes every commit in
+    order."""
+    state, ctx, blocks = chain32
+
+    sse_events = []
+    scrapes = []
+    expected_commits = len(blocks) // 8  # one commit event per window
+    # get-or-create so the FIRST scrape (possibly before any
+    # PipelineStats exists in this process) already sees the counter
+    metrics.counter("pipeline.blocks_committed")
+
+    def sse_read(url):
+        req = urllib.request.urlopen(url, timeout=30)
+        payload = None
+        for raw in req:
+            line = raw.decode().strip()
+            if line.startswith("event: "):
+                payload = line.split(": ", 1)[1]
+            elif line.startswith("data: ") and payload is not None:
+                sse_events.append((payload, json.loads(line[len("data: "):])))
+                payload = None
+                if (
+                    sum(1 for k, _ in sse_events if k == "commit")
+                    >= expected_commits
+                ):
+                    return
+
+    def scrape_during_replay(url):
+        for _ in range(20):
+            scrapes.append(_get(url).decode())
+            time.sleep(0.01)
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        sse_fut = pool.submit(sse_read, live_server.url("/events"))
+        scrape_fut = pool.submit(
+            scrape_during_replay, live_server.url("/metrics")
+        )
+        time.sleep(0.2)  # both clients attached before the replay starts
+        ex = Executor(state.copy(), ctx)
+        t0 = time.perf_counter()
+        stats = ex.stream(
+            blocks, policy=FlushPolicy(window_size=8, max_in_flight=2)
+        )
+        wall_s = time.perf_counter() - t0
+        sse_fut.result(timeout=30)
+        scrape_fut.result(timeout=30)
+
+    assert stats.blocks_committed == 32
+
+    # one lineage record per block, all committed, chain-complete
+    records = flight.RECORDER.records()
+    by_slot = {r.slot: r for r in records}
+    assert sorted(by_slot) == [int(b.message.slot) for b in blocks]
+    assert all(r.outcome == "committed" for r in records)
+
+    # latency decomposition: stage_a + queue_wait + settle ≈ total per
+    # block, and the per-block totals stay inside the replay's wall
+    for r in records:
+        parts = r.stage_a_s + r.queue_wait_s + (r.settle_s or 0.0)
+        assert abs(parts - r.total_s) <= max(0.1 * r.total_s, 0.002), (
+            f"slot {r.slot}: {parts} vs total {r.total_s}"
+        )
+        assert r.total_s <= wall_s * 1.1
+        assert r.flush_seq is not None
+        assert r.slot in r.flush_slots  # window membership includes self
+        assert r.flush_sets >= len(r.flush_slots)  # ≥1 set per block
+
+    # /metrics was scrape-able mid-replay, in Prometheus text format
+    assert scrapes and all(
+        "# TYPE pipeline_blocks_committed counter" in s for s in scrapes
+    )
+
+    # the SSE client saw every commit in chain order
+    commit_slots = [
+        slot
+        for kind, data in sse_events
+        if kind == "commit"
+        for slot in data["slots"]
+    ]
+    assert commit_slots == [int(b.message.slot) for b in blocks]
+    head_slots = [d["slot"] for k, d in sse_events if k == "head"]
+    assert head_slots == sorted(head_slots)
+
+    # /blocks agrees with the recorder
+    doc = _get_json(live_server.url("/blocks?n=64"))
+    assert doc["count"] == 32
+    assert [b["slot"] for b in doc["blocks"]] == sorted(by_slot)
+    worst = _get_json(live_server.url("/blocks?worst=total_s&n=3"))
+    totals = [b["total_s"] for b in worst["blocks"]]
+    assert totals == sorted(totals, reverse=True)
+
+
+def test_phase_split_rides_lineage_when_spans_recording(recording):
+    from ethereum_consensus_tpu.telemetry import spans
+
+    state, ctx = fresh_genesis(64, "minimal")
+    blocks = produce_chain(state, ctx, 3)
+    with spans.recording():
+        ex = Executor(state.copy(), ctx)
+        ex.stream(blocks, policy=FlushPolicy(window_size=2))
+    records = recording.records()
+    assert len(records) == 3
+    for r in records:
+        assert r.phases is not None
+        assert r.phases["block_apply_s"] > 0
+        # the phase split decomposes the measured stage-A apply time
+        assert r.phases["slot_advance_s"] + r.phases["block_apply_s"] <= (
+            r.stage_a_s * 1.5 + 0.005
+        )
+
+
+# ---------------------------------------------------------------------------
+# failure lineage: rollback blame, storm recovery, healthz transitions
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_lineage_blames_the_failing_block(recording):
+    state, ctx = fresh_genesis(64, "minimal")
+    blocks = produce_chain(state, ctx, 6)
+    bad = blocks[3].copy()
+    bad.signature = bytes(blocks[0].signature)  # pairing-time corruption
+
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(ex, policy=FlushPolicy(window_size=3))
+    with pytest.raises(InvalidBlock):
+        for b in blocks[:3] + [bad] + blocks[4:]:
+            pipe.submit(b)
+        pipe.close()
+
+    failed = recording.by_outcome("rolled-back")
+    assert [r.slot for r in failed] == [int(bad.message.slot)]
+    assert failed[0].blame["error"] == "InvalidBlock"
+    assert failed[0].flush_seq is not None  # it reached a flush window
+    committed = {r.slot for r in recording.by_outcome("committed")}
+    assert committed == {int(b.message.slot) for b in blocks[:3]}
+    # blocks 5..6 rode the failed window or the dropped queue: discarded
+    discarded = {r.slot for r in recording.by_outcome("discarded")}
+    assert discarded == {int(b.message.slot) for b in blocks[4:]}
+
+
+def test_storm_lineage_blame_and_recovery_latency(recording):
+    """run_storm lineage: exact blame + a recovery latency for every
+    injected failure, and the registry carries the recovery histogram
+    and per-mutator blame counters."""
+    state, ctx, blocks = chain_utils.produce_multi_fork_chain(64)
+    plan = {1: bad_proposer_signature, 4: bad_state_root}
+    hist_before = metrics.histogram(
+        "scenario.recovery_latency_s"
+    ).summary()["count"]
+    blame_before = {
+        m.name: metrics.counter(f"scenario.blame.{m.name}").value()
+        for m in plan.values()
+    }
+    report, ex = run_storm(
+        state, ctx, blocks, plan,
+        policy=FlushPolicy(window_size=3, max_in_flight=2,
+                           checkpoint_interval=2),
+        sign=chain_utils.sign_block,
+    )
+    assert [f.index for f in report.failures] == [1, 4]
+
+    for idx, mutator in plan.items():
+        slot = int(blocks[idx].message.slot)
+        failures = [
+            r for r in recording.for_slot(slot) if r.outcome == "rolled-back"
+        ]
+        assert failures, f"no rolled-back lineage for corrupted slot {slot}"
+        assert failures[-1].blame["error"] == type(
+            next(f.error for f in report.failures if f.index == idx)
+        ).__name__
+        assert failures[-1].recovery_s is not None
+        assert failures[-1].recovery_s > 0
+    # the honest twins landed: newest record per corrupted slot commits
+    for idx in plan:
+        slot = int(blocks[idx].message.slot)
+        assert recording.for_slot(slot)[-1].outcome == "committed"
+
+    assert metrics.histogram("scenario.recovery_latency_s").summary()[
+        "count"
+    ] - hist_before == len(plan)
+    for m in plan.values():
+        assert metrics.counter(
+            f"scenario.blame.{m.name}"
+        ).value() - blame_before[m.name] == 1
+
+
+def test_run_storm_serve_port_observable_live():
+    """run_storm(serve_port=0): the introspection server (and the flight
+    recording it attaches) rides the storm's whole duration and detaches
+    cleanly — the adversarial replay's lineage survives for post-mortem
+    queries."""
+    assert not flight.is_recording()
+    state, ctx, blocks = chain_utils.produce_multi_fork_chain(64)
+    plan = {2: bad_proposer_signature}
+    try:
+        report, _ = run_storm(
+            state, ctx, blocks, plan,
+            policy=FlushPolicy(window_size=3, max_in_flight=2,
+                               checkpoint_interval=2),
+            sign=chain_utils.sign_block,
+            serve_port=0,
+        )
+        assert [f.index for f in report.failures] == [2]
+        assert not flight.is_recording()  # server detached its recording
+        failed_slot = int(blocks[2].message.slot)
+        failures = [
+            r
+            for r in flight.RECORDER.for_slot(failed_slot)
+            if r.outcome == "rolled-back"
+        ]
+        assert failures and failures[-1].recovery_s is not None
+    finally:
+        flight.RECORDER.clear()
+
+
+def test_healthz_transitions_ok_degraded_broken(live_server):
+    state, ctx, blocks = chain_utils.produce_multi_fork_chain(64)
+
+    view = _get_json(live_server.url("/healthz"))
+    assert view["status"] == "ok" and view["pipeline_alive"]
+
+    # degrade: a killed worker falls back to in-line verification and
+    # latches the pipeline.degraded gauge
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(
+        ex,
+        policy=FlushPolicy(window_size=3, max_in_flight=2,
+                           settle_timeout_s=60.0),
+        fault_injector=FaultInjector().kill_worker(0),
+    )
+    for b in blocks:
+        pipe.submit(b)
+    stats = pipe.close()
+    assert stats.degraded_flushes >= 1
+    view = _get_json(live_server.url("/healthz"))
+    assert view["status"] == "degraded"
+    assert view["pipeline_alive"] and view["degraded_flushes"] >= 1
+    # the degraded window's lineage says so too
+    degraded = flight.RECORDER.by_outcome("degraded-inline")
+    assert degraded and all(r.degraded for r in degraded)
+
+    # break: a wedged verifier past the settle bound
+    ex2 = Executor(state.copy(), ctx)
+    pipe2 = ChainPipeline(
+        ex2,
+        policy=FlushPolicy(window_size=2, max_in_flight=1,
+                           settle_timeout_s=0.1, flush_retries=0),
+        fault_injector=FaultInjector().delay_flush(0, seconds=0.8),
+    )
+    with pytest.raises(PipelineBrokenError):
+        for b in blocks:
+            pipe2.submit(b)
+        pipe2.close()
+    try:
+        resp = urllib.request.urlopen(
+            live_server.url("/healthz"), timeout=10
+        )
+        status_code = resp.status
+        view = json.loads(resp.read())
+    except urllib.error.HTTPError as err:  # 503 raises through urllib
+        status_code = err.code
+        view = json.loads(err.read())
+    assert status_code == 503
+    assert view["status"] == "broken" and not view["pipeline_alive"]
+    assert view["stuck_window"]["window_seq"] == 0
+    assert view["stuck_window"]["slots"] == [
+        int(b.message.slot) for b in blocks[:2]
+    ]
+    # the stuck window's speculative blocks are discarded in the journal
+    discarded = {r.slot for r in flight.RECORDER.by_outcome("discarded")}
+    assert {int(b.message.slot) for b in blocks[:2]} <= discarded
+
+
+def test_retried_window_lineage_counts_attempts(recording):
+    state, ctx, blocks = chain_utils.produce_multi_fork_chain(64)
+    inj = FaultInjector().fail_flush(0, times=1)
+    ex = Executor(state.copy(), ctx)
+    pipe = ChainPipeline(
+        ex,
+        policy=FlushPolicy(window_size=3, max_in_flight=2,
+                           flush_retries=2, retry_backoff_s=0.01),
+        fault_injector=inj,
+    )
+    for b in blocks:
+        pipe.submit(b)
+    stats = pipe.close()
+    assert stats.fault_retries == 1
+    retried = [r for r in recording.records() if r.retries > 0]
+    assert retried and retried[0].disposition.startswith("retried-")
+    assert all(r.outcome == "committed" for r in retried)
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off
+# ---------------------------------------------------------------------------
+
+
+def test_hook_off_records_nothing_and_stays_cheap():
+    """Server off ⇒ zero observable work: no lineage, no hook activity,
+    and the engine's guard is one bool read (bounded like the
+    disabled-span fast path)."""
+    assert not flight.HOOK.active
+    state, ctx = fresh_genesis(64, "minimal")
+    blocks = produce_chain(state, ctx, 4)
+    before = len(flight.RECORDER)
+    ex = Executor(state.copy(), ctx)
+    ex.stream(blocks, policy=FlushPolicy(window_size=2))
+    assert len(flight.RECORDER) == before  # nothing recorded
+    # the inactive guard itself: sub-microsecond per read
+    hook = flight.HOOK
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if hook.active:  # pragma: no cover - never true here
+            raise AssertionError
+    per_read = (time.perf_counter() - t0) / n
+    assert per_read < 5e-6, f"{per_read * 1e6:.2f}µs per inactive-hook check"
+
+
+def test_server_start_stop_idempotent_and_flight_lifecycle():
+    srv = tel_server.IntrospectionServer(port=0)
+    assert not srv.running
+    srv.start()
+    try:
+        assert srv.running
+        assert flight.is_recording()  # start_flight default
+        srv.start()  # idempotent
+        port = srv.port
+        assert _get_json(f"http://127.0.0.1:{port}/")["endpoints"]
+    finally:
+        srv.stop()
+    assert not srv.running
+    assert not flight.is_recording()  # the server detaches what it attached
+    srv.stop()  # idempotent
