@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos pipeline-selfcheck trace metrics serve server-smoke
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos pipeline-selfcheck trace metrics serve serve-data server-smoke serving-smoke
 
 help:  ## list targets
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
@@ -28,8 +28,8 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + the scenario smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_scenarios.py -q -m 'bench_smoke or chaos_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + the scenario smoke + the serving smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_scenarios.py tests/test_serving.py -q -m 'bench_smoke or chaos_smoke or serving_smoke'
 
 chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundary chain (minutes)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q -m chaos_smoke
@@ -53,6 +53,12 @@ metrics:  ## dump the telemetry metrics registry after a pipeline run
 
 serve:  ## pipeline selfcheck with the live introspection server up (held 30s: curl /metrics /healthz /blocks /events)
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --serve 8799 --hold 30
+
+serve-data:  ## selfcheck + the Beacon-API read data plane mounted (held 60s: curl /eth/v1/beacon/states/head/validators?id=0)
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --serve 8799 --serve-data --hold 60
+
+serving-smoke:  ## tier-1-adjacent: client<->server round-trip vs the scalar oracle on a short pipelined replay
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serving.py -q -m serving_smoke
 
 server-smoke:  ## tier-1-adjacent: scrape /metrics + /blocks during a short pipelined replay
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_flight_server.py -q -m server_smoke

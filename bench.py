@@ -1229,6 +1229,229 @@ def bench_adversarial_replay(validators: int = 1 << 17, n_blocks: int = 32,
     }
 
 
+def bench_serving_queries(validators: int = 1 << 17, n_blocks: int = 16,
+                          atts: int = 8):
+    """Beacon-API read data plane throughput (serving/, docs/SERVING.md):
+    queries/s against a live ``HeadStore`` + ``BeaconDataPlane`` mounted
+    on the introspection server, measured WHILE a chain-pipeline replay
+    loops in the background — every window commit rotates the served
+    head, so the numbers include real snapshot churn, not a frozen
+    cache.
+
+    Three read shapes at the 2^17 registry: single-validator
+    (``/validators/{id}``), a 1k-id batch (``validator_balances?id=`` —
+    one columnar gather per request), and a full-committee-slot read
+    (``/committees?slot=`` — 32 mainnet committees, the shuffle memoized
+    per snapshot). The acceptance comparison times the resolution core
+    in-process: the columnar batch resolve (one ``gather_rows`` + one
+    vectorized status mask) vs the per-validator scalar walk
+    (``serving/oracle.py``) over the SAME ids on the SAME snapshot —
+    ``ok`` requires ≥10x, bit-identical documents both ways, and exactly
+    one ``serving.gathers`` increment per batched request."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.executor import Executor
+    from ethereum_consensus_tpu.pipeline import FlushPolicy
+    from ethereum_consensus_tpu.serving import BeaconDataPlane, HeadStore
+    from ethereum_consensus_tpu.telemetry.server import IntrospectionServer
+
+    if _fast_test():
+        validators = min(validators, 1 << 14)
+        n_blocks = min(n_blocks, 8)
+        atts = min(atts, 8)
+    elif _degraded():
+        # the acceptance shape is the 2^17 registry: degrade traffic only
+        n_blocks = min(n_blocks, 16)
+        atts = min(atts, 8)
+    validators = _cache_scaled(
+        "chainbundle-" + chain_utils._FASTREG_VERSION
+        + f"-deneb-mainnet-{{validators}}-{n_blocks}x{atts}",
+        validators,
+        budget_s=120.0,
+    )
+    state, ctx, blocks = chain_utils.mainnet_chain_bundle(
+        "deneb", validators, n_blocks, atts
+    )
+    _prime_warm_state("deneb", state, ctx)
+
+    store = HeadStore().attach()
+    server = IntrospectionServer(port=0).start(start_flight=False)
+    server.mount(BeaconDataPlane(store))
+    policy = FlushPolicy(window_size=8, max_in_flight=2)
+    stop = threading.Lock()  # held = keep replaying
+    stop.acquire()
+
+    def replay_forever():
+        # concurrent pipeline replay: publishes a fresh snapshot per
+        # committed window until the measurement releases the lock
+        while stop.locked():
+            ex = Executor(state.copy(), ctx)
+            ex.stream(blocks, policy=policy)
+
+    pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="replayer")
+    replay_future = pool.submit(replay_forever)
+    try:
+        return _serving_queries_measure(
+            store, server, stop, replay_future, pool, state, ctx, blocks,
+            validators, n_blocks,
+        )
+    finally:
+        if stop.locked():
+            stop.release()
+        pool.shutdown(wait=True)
+        store.detach()
+        server.stop()
+
+
+def _serving_queries_measure(store, server, stop, replay_future, pool,
+                             state, ctx, blocks, validators, n_blocks):
+    import json as _json
+    import urllib.request
+
+    from ethereum_consensus_tpu.serving import oracle, views
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    t_wait = time.perf_counter()
+    while store.head is None and time.perf_counter() - t_wait < 120:
+        time.sleep(0.05)
+    assert store.head is not None, "pipeline never published a snapshot"
+
+    import random as _random
+
+    rng = _random.Random(0x5E21)
+    ids_1k = sorted(rng.sample(range(validators), min(1000, validators)))
+    ids_param = ",".join(str(i) for i in ids_1k)
+    head_slot = store.head.slot
+
+    def qps(path: str, seconds: float = 2.0) -> "tuple[float, int]":
+        url = server.url(path)
+        count = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            with urllib.request.urlopen(url, timeout=30) as response:
+                response.read()
+            count += 1
+        return count / (time.perf_counter() - t0), count
+
+    single_qps, _ = qps(f"/eth/v1/beacon/states/head/validators/{ids_1k[0]}")
+    batch_qps, _ = qps(
+        f"/eth/v1/beacon/states/head/validator_balances?id={ids_param}"
+    )
+    committee_qps, _ = qps(
+        f"/eth/v1/beacon/states/head/committees?slot={head_slot}"
+    )
+
+    # gather discipline: one batched request == exactly one columnar
+    # gather (measured on a quiesced counter window)
+    before_g = tel_metrics.counter("serving.gathers").value()
+    before_r = tel_metrics.counter("serving.requests").value()
+    with urllib.request.urlopen(
+        server.url(
+            f"/eth/v1/beacon/states/head/validator_balances?id={ids_param}"
+        ),
+        timeout=30,
+    ) as response:
+        _json.loads(response.read())  # parse like a real client would
+    gathers_per_batch = (
+        tel_metrics.counter("serving.gathers").value() - before_g
+    )
+    requests_seen = tel_metrics.counter("serving.requests").value() - before_r
+
+    # the ≥10x core: columnar batch resolve vs the per-validator scalar
+    # walk, same ids, same (now-quiesced) snapshot
+    stop.release()  # let the replayer drain so the snapshot stays put
+    replay_future.result(timeout=600)
+    pool.shutdown(wait=True)
+    snap = store.head
+    bundle = views.snapshot_bundle(snap)
+    assert bundle is not None, "columnar bundle unavailable at bench scale"
+    reps = 3
+
+    def best(fn):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    columnar_s = best(
+        lambda: views.resolve_validators(bundle, ids_1k)
+    )
+    scalar_s = best(
+        lambda: [
+            (
+                index,
+                int(snap.raw.balances[index]),
+                oracle.validator_status(
+                    snap.raw.validators[index],
+                    int(snap.raw.balances[index]),
+                    bundle["epoch"],
+                ),
+            )
+            for index in ids_1k
+        ]
+    )
+    speedup = scalar_s / columnar_s if columnar_s else float("inf")
+    # bit-identity of the documents both engines serve for the batch
+    idx, balances, codes = views.resolve_validators(bundle, ids_1k)
+    columnar_rows = [
+        {"index": str(i), "balance": str(int(b))}
+        for i, b in zip(idx.tolist(), balances.tolist())
+    ]
+    scalar_rows = oracle.balances_data(snap.raw, ids_1k)
+    identical = _json.dumps(columnar_rows, sort_keys=True) == _json.dumps(
+        scalar_rows, sort_keys=True
+    )
+    statuses_identical = [
+        views.STATUS_NAMES[c] for c in codes.tolist()
+    ] == [
+        oracle.validator_status(
+            snap.raw.validators[i], int(snap.raw.balances[i]), bundle["epoch"]
+        )
+        for i in ids_1k
+    ]
+    snapshots_published = tel_metrics.counter(
+        "serving.snapshots.published"
+    ).value()
+    return {
+        "ok": bool(
+            speedup >= 10.0
+            and identical
+            and statuses_identical
+            and gathers_per_batch == 1
+            and requests_seen == 1
+        ),
+        "fork": "deneb",
+        "validators": validators,
+        "blocks": n_blocks,
+        "single_validator_qps": single_qps,
+        "batch_1k_qps": batch_qps,
+        "committee_slot_qps": committee_qps,
+        "batch_size": len(ids_1k),
+        "batch_rows_per_s": batch_qps * len(ids_1k),
+        "gathers_per_batch_request": gathers_per_batch,
+        "columnar_batch_resolve_s": columnar_s,
+        "scalar_walk_resolve_s": scalar_s,
+        "columnar_vs_scalar_speedup": speedup,
+        "bit_identical": bool(identical and statuses_identical),
+        "snapshots_published": snapshots_published,
+        "served_head_slot": snap.slot,
+        "note": (
+            "qps measured over HTTP against state_id=head WHILE a "
+            "pipelined replay loops (head rotates per committed "
+            "window); the >=10x acceptance compares the in-process "
+            "resolution core — one columnar gather + vectorized status "
+            "vs the per-validator scalar walk — on the same ids and "
+            "snapshot, excluding identical JSON/HTTP assembly costs"
+        ),
+    }
+
+
 def bench_process_block():
     """Full block application incl. batched signature verification and the
     per-slot state HTR (minimal preset — the Python orchestration floor;
@@ -1282,6 +1505,7 @@ CONFIGS = [
     ("process_block_electra", bench_process_block_electra),
     ("pipeline_blocks", bench_pipeline_blocks),
     ("adversarial_replay", bench_adversarial_replay),
+    ("serving_queries", bench_serving_queries),
     ("epoch_mainnet", bench_epoch_mainnet),
     ("epoch_deneb", bench_epoch_deneb),
     ("epoch_electra", bench_epoch_electra),
@@ -1390,6 +1614,12 @@ def child_main() -> None:
         os.replace(tmp, progress_path)
 
     configs = CONFIGS[:1] if _fast_test() else CONFIGS
+    only = os.environ.get("EC_BENCH_ONLY")
+    if only:
+        # comma-separated config allowlist: targeted re-measures without
+        # paying the whole battery (e.g. EC_BENCH_ONLY=serving_queries)
+        wanted = {name.strip() for name in only.split(",") if name.strip()}
+        configs = [(name, fn) for name, fn in configs if name in wanted]
     for name, fn in configs:
         elapsed = time.monotonic() - t_start
         if elapsed > CONFIG_DEADLINE_S:
@@ -1547,7 +1777,9 @@ def main() -> None:
 
         env = cpu_mesh_env(1, repo_root=REPO)
         env[DEGRADED_ENV] = note
-        for env_key in (TRACE_OUT_ENV, METRICS_OUT_ENV, SERVE_PORT_ENV):
+        for env_key in (
+            TRACE_OUT_ENV, METRICS_OUT_ENV, SERVE_PORT_ENV, "EC_BENCH_ONLY",
+        ):
             if os.environ.get(env_key):  # survive the hermetic scrub
                 env[env_key] = os.environ[env_key]
     env[CHILD_ENV] = "1"

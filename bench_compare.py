@@ -45,6 +45,14 @@ _TREND_HEADLINE = (
     "warm_s_per_epoch",
     "adversarial_s",
     "recovery_latency_mean_s",
+    # the serving data plane's trend axes (PR 8): gather core seconds
+    # and the three queries/s shapes (not seconds, but the serving
+    # throughput story lives or dies on them)
+    "columnar_batch_resolve_s",
+    "scalar_walk_resolve_s",
+    "single_validator_qps",
+    "batch_1k_qps",
+    "committee_slot_qps",
 )
 
 

@@ -61,6 +61,7 @@ from ..utils import trace
 __all__ = [
     "RegistryColumns",
     "columns_for",
+    "gather_rows",
     "pack_registry_cached",
     "process_attestations_batch",
     "register_attestation_preparer",
@@ -320,12 +321,53 @@ class RegistryColumns:
             return None
         return _readonly(arr)
 
+    def registry_snapshot(self, state=None) -> "dict | None":
+        """One read-only column bundle for the serving data plane
+        (serving/headstore.py): the validator columns plus the balances
+        column, synced in one pass. The HeadStore freezes exactly this
+        dict per committed snapshot; every array is a ``writeable=False``
+        view, so a reader thread can gather from it but never corrupt
+        the cache. None → the caller's scalar fallback (no numpy /
+        exotic values / engine disabled).
+
+        Thread contract: building/syncing mutates the list-resident
+        cache records, so the FIRST call on a given state must be
+        serialized by the caller (the HeadStore builds under its
+        snapshot lock); the returned views are then safe to share."""
+        state = self._state if state is None else state
+        vc = self.validator_columns(state)
+        if vc is None:
+            return None
+        balances = self.list_column(state, "balances")
+        if balances is None or balances.shape[0] != next(
+            iter(vc.values())
+        ).shape[0]:
+            return None
+        out = dict(vc)
+        out["balances"] = balances
+        return out
+
 
 def columns_for(state) -> "RegistryColumns | None":
     """Column accessor for ``state`` (None when disabled / no numpy)."""
     if _disabled() or _np() is None:
         return None
     return RegistryColumns(state)
+
+
+def gather_rows(bundle: dict, indices, fields=None) -> "dict | None":
+    """ONE vectorized gather over a ``registry_snapshot`` bundle: fancy-
+    index every requested column (default: all) at ``indices`` in a
+    single pass — the serving data plane's per-request-batch unit (the
+    bench asserts exactly one of these per batched read). The outputs
+    are fresh arrays owned by the caller; the bundle stays untouched."""
+    np = _np()
+    if np is None:
+        return None
+    idx = np.asarray(indices, dtype=np.int64)
+    return {
+        f: bundle[f][idx] for f in (fields if fields is not None else bundle)
+    }
 
 
 def pack_registry_cached(state, previous_epoch: int,

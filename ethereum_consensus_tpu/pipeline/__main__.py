@@ -25,6 +25,10 @@ Telemetry exports (docs/OBSERVABILITY.md):
   /blocks lineage, /events SSE) for the selfcheck's duration; 0 picks
   an ephemeral port. ``--hold SECONDS`` keeps it up after the checks
   finish so you can scrape/curl around (``make serve``).
+* ``--serve-data``       — additionally mount the Beacon-API read data
+  plane (``serving/``: validators, balances, committees, duties, ...)
+  fed by the selfcheck replay's commits (``make serve-data``); requires
+  ``--serve``.
 
 Exit code 0 = all checks passed; any failure prints the reason and
 exits 1.
@@ -169,6 +173,7 @@ def main(argv: "list[str]") -> int:
     from ..telemetry import metrics, spans
 
     server = None
+    store = None
     if serve_port is not None:
         from ..telemetry.server import IntrospectionServer
 
@@ -177,6 +182,18 @@ def main(argv: "list[str]") -> int:
             f"introspection server on {server.url()} "
             "(/metrics /healthz /blocks /events)"
         )
+        if "--serve-data" in argv:
+            from ..serving import BeaconDataPlane, HeadStore
+
+            store = HeadStore().attach()
+            server.mount(BeaconDataPlane(store))
+            print(
+                f"beacon data plane mounted on {server.url('/eth/')} "
+                "(validators, balances, committees, duties — fed by the "
+                "selfcheck replay's commits)"
+            )
+    elif "--serve-data" in argv:
+        raise SystemExit("--serve-data requires --serve PORT")
     if trace_out:
         spans.start_recording()
     try:
@@ -185,6 +202,8 @@ def main(argv: "list[str]") -> int:
         _selfcheck_window()
     except Exception as exc:  # noqa: BLE001 — smoke must report, not crash
         print(f"SELFCHECK FAILED: {type(exc).__name__}: {exc}")
+        if store is not None:
+            store.detach()
         if server is not None:
             server.stop()
         return 1
@@ -208,7 +227,14 @@ def main(argv: "list[str]") -> int:
                 f"holding the introspection server for {hold_s}s "
                 f"({server.url('/blocks')} has the selfcheck's lineage)"
             )
+            if store is not None and store.head is not None:
+                print(
+                    f"data plane head: slot {store.head.slot} — try "
+                    f"{server.url('/eth/v1/beacon/states/head/validators?id=0,1,2')}"
+                )
             _time.sleep(float(hold_s))
+        if store is not None:
+            store.detach()
         server.stop()
     return 0
 

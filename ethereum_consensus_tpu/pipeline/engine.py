@@ -335,6 +335,24 @@ class ChainPipeline:
         for entry in pending_entries:
             self._emit_block(entry, "discarded", blame=blame)
 
+    def _publish_state(self, entries, snap, seq=None) -> None:
+        """Hand the serving layer an immutable snapshot of the committed
+        state these entries produced (the commit hook's STATE channel —
+        telemetry/flight.py). ``snap`` must be a state copy that nothing
+        will mutate again: either a window's dispatch-time ``snap_state``
+        or a copy taken while the live state IS the committed position.
+        Callers guard with ``_flight.HOOK.state_active``."""
+        last = entries[-1]
+        _flight.HOOK.emit_state(
+            {
+                "state": snap,
+                "context": self._executor.context,
+                "slot": last.slot,
+                "root": _state_root_hex(last.signed_block),
+                "seq": seq,
+            }
+        )
+
     def _emit_head(self, entry: _Entry, blocks: int, seq=None) -> None:
         _flight.HOOK.emit(
             "head",
@@ -374,6 +392,15 @@ class ChainPipeline:
             self._commit(entries, candidate, window=None)
             return
         window = Window(entries, merged, candidate, self._seq)
+        if _flight.HOOK.state_active:
+            # serving data plane attached (telemetry/flight.py state
+            # channel): copy the post-window state NOW, while the live
+            # state is exactly it — the copy is published at commit and
+            # never reused by the engine, so readers can't be torn by
+            # later speculative applies. Deliberately NOT the checkpoint
+            # object: the engine copy-shares checkpoints on failure
+            # paths, which would race reader-side column syncs.
+            window.snap_state = self._executor.state.copy()
         self._seq += 1
         # backpressure: the bounded queue admits a new window only after
         # the oldest one settles — this wait is where an over-eager
@@ -421,6 +448,18 @@ class ChainPipeline:
         else:
             self._since_checkpoint.extend(e.signed_block for e in entries)
         self.stats.blocks_were_committed(len(entries))
+        if _flight.HOOK.state_active and entries:
+            if window is None:
+                # the empty-flush path commits synchronously inside
+                # dispatch: the live state IS the committed position
+                self._publish_state(entries, self._executor.state.copy())
+            elif window.snap_state is not None:
+                self._publish_state(
+                    entries, window.snap_state, seq=window.seq
+                )
+            # a window dispatched before the store attached has no
+            # snapshot (and the live state may be speculatively ahead):
+            # skip — the next dispatched window publishes the new head
         if _flight.HOOK.active and entries:
             for entry in entries:
                 self._emit_block(entry, "committed", window=window)
@@ -527,6 +566,15 @@ class ChainPipeline:
                     )
             self._since_checkpoint.extend(e.signed_block for e in proven)
             self.stats.blocks_were_committed(fail_block)
+            if _flight.HOOK.state_active:
+                # the live state IS the rolled-back committed position
+                # (checkpoint + proven prefix, just re-applied): publish
+                # it so the serving head lands exactly at the failure
+                # boundary — the rolled-back state itself is never
+                # published (it was discarded above, pre-commit)
+                self._publish_state(
+                    proven, self._executor.state.copy(), seq=window.seq
+                )
             if hooked:
                 self._emit_head(proven[-1], fail_block, seq=window.seq)
         self._broken = error
@@ -563,6 +611,13 @@ class ChainPipeline:
                         raise
                     self._since_checkpoint.append(entry.signed_block)
                     self.stats.blocks_were_committed(1)
+                    if _flight.HOOK.state_active:
+                        # each inline re-apply advances the committed
+                        # position with the live state sitting exactly on
+                        # it (rare path: structural abort drain)
+                        self._publish_state(
+                            [entry], self._executor.state.copy()
+                        )
                     if hooked:
                         # committed, but verified IN-LINE on the host (the
                         # terminal sequential re-verify) — the lineage

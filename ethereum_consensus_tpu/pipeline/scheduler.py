@@ -132,14 +132,19 @@ class Window:
     edge), so no lock is needed."""
 
     __slots__ = (
-        "entries", "batch", "post_state", "future", "seq", "attempts",
-        "t_dispatch", "t_settled", "verify_s", "degraded",
+        "entries", "batch", "post_state", "snap_state", "future", "seq",
+        "attempts", "t_dispatch", "t_settled", "verify_s", "degraded",
     )
 
     def __init__(self, entries, batch, post_state, seq: int):
         self.entries = entries
         self.batch = batch
         self.post_state = post_state
+        # serving-layer copy of the post-window state, taken at dispatch
+        # when the live state IS the post-window state; published on the
+        # commit hook's state channel when the verdicts come back clean
+        # (None unless a HeadStore is attached — HOOK.state_active)
+        self.snap_state = None
         self.future = None
         self.seq = seq
         self.attempts = 0

@@ -22,7 +22,9 @@ The contract every scenario family asserts, after every recovery:
 from __future__ import annotations
 
 import os
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 
 from ..error import Error
@@ -34,6 +36,7 @@ from ..ssz.core import CachedRootList
 from ..telemetry import flight as _flight
 from ..telemetry import metrics
 from ..utils import trace
+from ..serving import oracle as oracle_mod
 from .mutators import MutationEnv
 
 __all__ = [
@@ -46,6 +49,7 @@ __all__ = [
     "run_storm",
     "StormReport",
     "StormFailure",
+    "ReaderSwarm",
 ]
 
 
@@ -241,22 +245,147 @@ class StormFailure:
 
 
 class StormReport:
-    __slots__ = ("failures", "blocks_applied", "wall_s", "stats_snapshots")
+    __slots__ = ("failures", "blocks_applied", "wall_s", "stats_snapshots",
+                 "reader_samples", "reader_roots")
 
     def __init__(self):
         self.failures: list[StormFailure] = []
         self.blocks_applied = 0
         self.wall_s = 0.0
         self.stats_snapshots: list = []
+        # reader-chaos evidence (run_storm(readers=N)): verified
+        # response samples and the distinct snapshot roots they pinned
+        self.reader_samples = 0
+        self.reader_roots = 0
 
     @property
     def recovery_latencies(self) -> list:
         return [f.recovery_s for f in self.failures]
 
 
+class ReaderSwarm:
+    """N reader threads hammering the serving data plane while a storm
+    replays — the concurrent-reader chaos family (PR 6 residue).
+
+    Each reader loops over the read endpoints (validators / balances /
+    single validator / root) against ``state_id=head``, recording every
+    response together with the ``snapshot_root`` the data plane pins it
+    to. ``verify`` then asserts the torn-read contract offline:
+
+    * every sampled root is a COMMITTED honest chain position (the map
+      of scalar-oracle states per position) — a rolled-back or partially
+      applied state can never be served, because the engine publishes
+      snapshots only after a window's signatures prove;
+    * every response body is bit-identical to the scalar oracle's answer
+      recomputed on that exact state — a response torn across two
+      snapshots cannot equal any single state's document.
+
+    Threads come from a ``ThreadPoolExecutor`` (the repo's sanctioned
+    worker primitive); stop is a lock-held flag."""
+
+    def __init__(self, base_url: str, n_readers: int = 2, ids=(0, 1, 2, 3)):
+        self._lock = threading.Lock()
+        self._base = base_url.rstrip("/")
+        self._ids = tuple(int(i) for i in ids)
+        self._stop = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, n_readers), thread_name_prefix="chaos-reader"
+        )
+        self._futures = [
+            self._pool.submit(self._reader_loop, i) for i in range(n_readers)
+        ]
+        self.samples: list = []  # (endpoint, root_hex, data) — lock-held
+        self.errors: list = []
+
+    def _should_stop(self) -> bool:
+        with self._lock:
+            return self._stop
+
+    def _record(self, endpoint: str, doc) -> None:
+        with self._lock:
+            self.samples.append((endpoint, doc.get("snapshot_root"),
+                                 doc.get("data")))
+
+    def _reader_loop(self, seed: int) -> None:
+        import json as _json
+        import urllib.request
+
+        ids = ",".join(str(i) for i in self._ids)
+        endpoints = (
+            f"/eth/v1/beacon/states/head/validators?id={ids}",
+            f"/eth/v1/beacon/states/head/validator_balances?id={ids}",
+            f"/eth/v1/beacon/states/head/validators/{self._ids[seed % len(self._ids)]}",
+            "/eth/v1/beacon/states/head/root",
+        )
+        at = seed  # stagger the swarm across the endpoint mix
+        while not self._should_stop():
+            endpoint = endpoints[at % len(endpoints)]
+            at += 1
+            try:
+                with urllib.request.urlopen(
+                    self._base + endpoint, timeout=10
+                ) as response:
+                    doc = _json.loads(response.read())
+            except OSError as exc:
+                # 404 pre-first-commit is expected; anything else is
+                # evidence
+                code = getattr(exc, "code", None)
+                if code != 404:
+                    with self._lock:
+                        self.errors.append((endpoint, repr(exc)))
+                continue
+            self._record(endpoint, doc)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+        for future in self._futures:
+            future.result(timeout=30)  # surface reader crashes
+        self._pool.shutdown(wait=True)
+
+    def verify(self, states_by_root: dict, context) -> int:
+        """Assert every sample against the committed-position oracle
+        map; returns the number of distinct snapshot roots observed."""
+        import json as _json
+
+        assert not self.errors, f"reader errors: {self.errors[:3]}"
+        roots = set()
+        for endpoint, root_hex, data in self.samples:
+            assert root_hex is not None, f"{endpoint}: no snapshot_root"
+            state = states_by_root.get(root_hex)
+            assert state is not None, (
+                f"{endpoint}: served root {root_hex} is not a committed "
+                "honest chain position — a rolled-back or torn state "
+                "leaked into the data plane"
+            )
+            roots.add(root_hex)
+            raw = getattr(state, "data", state)
+            if "validator_balances" in endpoint:
+                expect = oracle_mod.balances_data(raw, list(self._ids))
+            elif "validators?" in endpoint:
+                expect = oracle_mod.validators_data(
+                    raw, context, list(self._ids)
+                )
+            elif "/validators/" in endpoint:
+                index = int(endpoint.rsplit("/", 1)[1])
+                expect = oracle_mod.validators_data(raw, context, [index])[0]
+            else:  # /root
+                expect = {
+                    "root": "0x"
+                    + type(raw).hash_tree_root(raw).hex()
+                }
+            assert _json.dumps(data, sort_keys=True) == _json.dumps(
+                expect, sort_keys=True
+            ), (
+                f"{endpoint}: response for {root_hex} diverges from the "
+                "scalar oracle on that state — torn read"
+            )
+        return len(roots)
+
+
 def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
               fault_injector=None, check_states=True, check_columns=True,
-              serve_port=None):
+              serve_port=None, readers: int = 0):
     """Replay a storm-corrupted chain through the pipeline with recovery
     after every failure, asserting the full contract at each one.
 
@@ -287,18 +416,63 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
     engine-internal rollback already ran inside the raising submit; the
     measured tail is the verification + snapshot cost of coming back).
 
+    ``readers``: N > 0 spawns the concurrent-reader chaos swarm
+    (``ReaderSwarm``): the serving data plane (serving/handlers.py over
+    a pipeline-fed ``HeadStore``) is mounted on the storm's server and N
+    reader threads hammer the read endpoints THROUGH the storm — every
+    rollback, recovery, and commit happening under live read traffic.
+    After the replay, every sampled response is verified against the
+    scalar oracle at its pinned snapshot root: no torn reads (each
+    response internally consistent with exactly one committed snapshot)
+    and no rolled-back state ever served. Implies a server
+    (``serve_port=0`` when none was requested); verified sample counts
+    land in ``report.reader_samples`` / ``report.reader_roots``.
+
     Returns (StormReport, final executor)."""
     policy = policy or FlushPolicy(window_size=4, max_in_flight=2,
                                    checkpoint_interval=2)
+    if readers and serve_port is None:
+        serve_port = 0  # chaos readers need a wire to hammer
     server = None
+    store = swarm = None
     if serve_port is not None:
         from ..telemetry.server import IntrospectionServer
 
         server = IntrospectionServer(port=serve_port).start()
+        if readers:
+            from ..serving import BeaconDataPlane, HeadStore
+
+            store = HeadStore().attach()
+            server.mount(BeaconDataPlane(store))
+            swarm = ReaderSwarm(server.url(), n_readers=readers)
     try:
-        return _run_storm(pre_state, context, blocks, plan, policy, sign,
-                          fault_injector, check_states, check_columns)
+        report, ex = _run_storm(pre_state, context, blocks, plan, policy,
+                                sign, fault_injector, check_states,
+                                check_columns)
+        if swarm is not None:
+            swarm.stop()
+            # committed-position oracle: the scalar state AFTER each
+            # honest block (rollback resumes substitute honest twins, so
+            # every published snapshot is one of these positions)
+            oracle_ex, pre_states = oracle_replay(
+                pre_state, context, blocks, capture_at=range(len(blocks))
+            )
+            states_by_root = {}
+            for state in list(pre_states.values()) + [oracle_ex.state]:
+                raw = getattr(state, "data", state)
+                root = "0x" + type(raw).hash_tree_root(raw).hex()
+                states_by_root[root] = state
+            report.reader_roots = swarm.verify(states_by_root, context)
+            report.reader_samples = len(swarm.samples)
+            metrics.counter("scenario.reader_chaos.samples").inc(
+                report.reader_samples
+            )
+        return report, ex
     finally:
+        if swarm is not None:
+            swarm.stop()
+        if store is not None:
+            store.detach()
         if server is not None:
             server.stop()
 

@@ -1,0 +1,35 @@
+"""serving — the hot-state Beacon-API read data plane (docs/SERVING.md).
+
+The ROADMAP's "heavy traffic" axis: Beacon-API READ endpoints served
+straight from columnar snapshots of pipeline-committed states, mounted
+on the PR 7 introspection server.
+
+* ``headstore``  — ``HeadStore``/``Snapshot``: bounded history of
+  immutable per-commit state snapshots off the pipeline commit hook's
+  state channel, with ``state_id`` (head/slot/root/finalized/justified)
+  resolution and copy-on-write isolation from the live pipeline.
+* ``views``      — columnar resolution: status codes, batch gathers,
+  status-filter masks, the vectorized rewards summary. One columnar
+  gather per request batch.
+* ``oracle``     — the scalar per-validator twin of every document:
+  fallback path AND differential oracle (tests/test_serving.py).
+* ``handlers``   — ``BeaconDataPlane``: the mountable route table
+  (validators, balances, committees, sync committees, duties, rewards,
+  root/fork/finality/randao/genesis) in standard Beacon-API wire
+  format, round-tripped by the repo's own ``api/client.py``.
+
+Quickstart::
+
+    store = HeadStore().attach()            # feed from pipeline commits
+    server = IntrospectionServer(port=8799).start()
+    server.mount(BeaconDataPlane(store))
+    ... pipeline replay ...                 # every commit publishes
+    Client(server.url()).get_validators("head", indices=[1, 2, 3])
+
+or ``make serve-data`` / ``run_storm(serve_port=0, readers=4)``.
+"""
+
+from .handlers import BeaconDataPlane
+from .headstore import HeadStore, Snapshot
+
+__all__ = ["BeaconDataPlane", "HeadStore", "Snapshot"]

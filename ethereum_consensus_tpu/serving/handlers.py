@@ -1,0 +1,370 @@
+"""Beacon-API read handlers — the data plane mounted on the PR 7
+introspection server (docs/SERVING.md).
+
+``BeaconDataPlane`` is a tiny WSGI-shaped app the telemetry server
+routes ``/eth/...`` requests into: ``handle(method, path, params, body)
+→ (status, document)``. Every request resolves exactly ONE ``HeadStore``
+snapshot at entry and serves entirely from it — the snapshot-isolation
+contract the reader-chaos scenario hammers — and every batched registry
+read is one columnar gather (``serving/views.py``) with the scalar
+oracle (``serving/oracle.py``) as fallback and differential twin.
+
+Wire format: the standard Beacon-API envelopes (``data`` payloads,
+string-encoded integers, 0x-hex bytes), chosen so the repo's own
+``api/client.py`` round-trips every endpoint; responses additionally
+carry ``snapshot_root`` (the served snapshot's state root) so a chaos
+reader can pin each response to the exact committed state it came from.
+
+Endpoint catalog: see ``ROUTES`` below / docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..telemetry import metrics as _metrics
+from . import oracle, views
+
+__all__ = ["BeaconDataPlane"]
+
+
+def _error(status: int, message: str):
+    _metrics.counter("serving.errors").inc()
+    return status, {"code": status, "message": message}
+
+
+class BeaconDataPlane:
+    """The mountable read plane over a ``HeadStore``.
+
+    Stateless beyond the store reference: all request-scoped work lives
+    on the resolved snapshot (bundle, memoized documents), so concurrent
+    handler threads share nothing mutable here — speclint's concurrency
+    scope covers the module to keep it that way."""
+
+    prefix = "/eth/"
+
+    ROUTES = (
+        "GET  /eth/v1/beacon/genesis",
+        "GET  /eth/v1/beacon/states/{state_id}/root",
+        "GET  /eth/v1/beacon/states/{state_id}/fork",
+        "GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints",
+        "GET  /eth/v1/beacon/states/{state_id}/randao?epoch=",
+        "GET  /eth/v1/beacon/states/{state_id}/validators?id=&status=",
+        "GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}",
+        "GET  /eth/v1/beacon/states/{state_id}/validator_balances?id=",
+        "GET  /eth/v1/beacon/states/{state_id}/committees?epoch=&index=&slot=",
+        "GET  /eth/v1/beacon/states/{state_id}/sync_committees?epoch=",
+        "GET  /eth/v1/beacon/states/{state_id}/epoch_rewards",
+        "POST /eth/v1/validator/duties/attester/{epoch}",
+        "GET  /eth/v1/validator/duties/proposer/{epoch}",
+    )
+
+    def __init__(self, store):
+        self.store = store
+
+    # -- plumbing ------------------------------------------------------------
+    def _param(self, params: dict, key: str):
+        values = params.get(key)
+        return values[0] if values else None
+
+    def _list_param(self, params: dict, key: str) -> list:
+        out: list = []
+        for chunk in params.get(key, ()):
+            out.extend(v for v in chunk.split(",") if v)
+        return out
+
+    def _resolve(self, state_id: str):
+        snap = self.store.resolve(state_id)
+        if snap is None:
+            raise _NotFound(
+                f"state {state_id!r} is not retained "
+                f"({len(self.store)} snapshots held)"
+            )
+        return snap
+
+    def _envelope(self, snap, data, extra=None) -> dict:
+        doc = {
+            "execution_optimistic": False,
+            "finalized": False,
+            "snapshot_root": snap.root_hex(),
+            "data": data,
+        }
+        if extra:
+            doc.update(extra)
+        return doc
+
+    # -- dispatch ------------------------------------------------------------
+    def handle(self, method: str, path: str, params: dict, body):
+        """(status, JSON document) for one request; never raises — the
+        server thread must always get a response to write."""
+        t0 = time.perf_counter()
+        route = "?"
+        try:
+            route, response = self._dispatch(method, path, params, body)
+            return response
+        except _NotFound as exc:
+            return _error(404, str(exc))
+        except (oracle.BadRequest, ValueError) as exc:
+            return _error(400, str(exc))
+        except Exception as exc:  # noqa: BLE001 — a reader must get a reply
+            return _error(500, f"{type(exc).__name__}: {exc}")
+        finally:
+            _metrics.counter("serving.requests").inc()
+            _metrics.counter(f"serving.requests.{route}").inc()
+            _metrics.histogram("serving.request_s").observe(
+                time.perf_counter() - t0
+            )
+
+    def _dispatch(self, method: str, path: str, params: dict, body):
+        parts = [p for p in path.split("/") if p]
+        # parts[0] == "eth" guaranteed by the mount prefix
+        if parts[1:3] == ["v1", "beacon"]:
+            if parts[3:] == ["genesis"] and method == "GET":
+                return "genesis", self._genesis()
+            if len(parts) >= 6 and parts[3] == "states":
+                return self._dispatch_state(method, parts[4], parts[5:], params)
+        if parts[1:4] == ["v1", "validator", "duties"] and len(parts) == 6:
+            if parts[4] == "attester" and method == "POST":
+                return "duties_attester", self._attester_duties(
+                    int(parts[5]), body
+                )
+            if parts[4] == "proposer" and method == "GET":
+                return "duties_proposer", self._proposer_duties(int(parts[5]))
+        raise _NotFound(f"no data-plane route {method} {path}")
+
+    def _dispatch_state(self, method, state_id, rest, params):
+        if method != "GET":
+            raise _NotFound(f"no data-plane route {method} for states")
+        if rest == ["root"]:
+            return "root", self._root(state_id)
+        if rest == ["fork"]:
+            return "fork", self._fork(state_id)
+        if rest == ["finality_checkpoints"]:
+            return "finality", self._finality(state_id)
+        if rest == ["randao"]:
+            return "randao", self._randao(state_id, params)
+        if rest == ["validators"]:
+            return "validators", self._validators(state_id, params)
+        if len(rest) == 2 and rest[0] == "validators":
+            return "validator", self._one_validator(state_id, rest[1])
+        if rest == ["validator_balances"]:
+            return "balances", self._balances(state_id, params)
+        if rest == ["committees"]:
+            return "committees", self._committees(state_id, params)
+        if rest == ["sync_committees"]:
+            return "sync_committees", self._sync_committees(state_id, params)
+        if rest == ["epoch_rewards"]:
+            return "rewards", self._epoch_rewards(state_id)
+        raise _NotFound(f"no data-plane route GET states/{'/'.join(rest)}")
+
+    # -- scalar-metadata endpoints -------------------------------------------
+    def _genesis(self):
+        snap = self._resolve("head")
+        state = snap.raw
+        return 200, self._envelope(
+            snap,
+            {
+                "genesis_time": str(int(state.genesis_time)),
+                "genesis_validators_root": "0x"
+                + bytes(state.genesis_validators_root).hex(),
+                "genesis_fork_version": "0x"
+                + bytes(snap.context.genesis_fork_version).hex(),
+            },
+        )
+
+    def _root(self, state_id):
+        snap = self._resolve(state_id)
+        return 200, self._envelope(snap, {"root": snap.root_hex()})
+
+    def _fork(self, state_id):
+        snap = self._resolve(state_id)
+        fork = snap.raw.fork
+        return 200, self._envelope(snap, type(fork).to_json(fork))
+
+    def _finality(self, state_id):
+        snap = self._resolve(state_id)
+        state = snap.raw
+        return 200, self._envelope(
+            snap,
+            {
+                name: type(cp).to_json(cp)
+                for name, cp in (
+                    ("previous_justified", state.previous_justified_checkpoint),
+                    ("current_justified", state.current_justified_checkpoint),
+                    ("finalized", state.finalized_checkpoint),
+                )
+            },
+        )
+
+    def _randao(self, state_id, params):
+        snap = self._resolve(state_id)
+        from ..models.phase0.helpers import get_randao_mix
+
+        epoch_raw = self._param(params, "epoch")
+        epoch = (
+            int(epoch_raw)
+            if epoch_raw is not None
+            else oracle.current_epoch(snap.raw, snap.context)
+        )
+        mix = snap.memo(
+            ("randao", epoch), lambda: get_randao_mix(snap.raw, epoch)
+        )
+        return 200, self._envelope(snap, {"randao": "0x" + bytes(mix).hex()})
+
+    # -- columnar registry endpoints -----------------------------------------
+    def _validators(self, state_id, params):
+        snap = self._resolve(state_id)
+        ids = self._list_param(params, "id")
+        statuses = self._list_param(params, "status")
+        allowed = views.parse_statuses(statuses)
+        indices = (
+            oracle.resolve_validator_ids(snap.raw, ids) if ids else None
+        )
+        bundle = views.snapshot_bundle(snap)
+        if bundle is None:
+            _metrics.counter("serving.fallback").inc()
+            rows = oracle.validators_data(
+                snap.raw,
+                snap.context,
+                indices,
+                None
+                if allowed is None
+                else {views.STATUS_NAMES[c] for c in allowed},
+            )
+        else:
+            idx, balances, codes = views.resolve_validators(
+                bundle, indices, allowed
+            )
+            vals = snap.raw.validators
+            rows = [
+                {
+                    "index": str(i),
+                    "balance": str(int(b)),
+                    "status": views.STATUS_NAMES[c],
+                    "validator": type(vals[i]).to_json(vals[i]),
+                }
+                for i, b, c in zip(idx.tolist(), balances.tolist(), codes.tolist())
+            ]
+        return 200, self._envelope(snap, rows)
+
+    def _one_validator(self, state_id, validator_id):
+        snap = self._resolve(state_id)
+        indices = oracle.resolve_validator_ids(snap.raw, [validator_id])
+        if not indices:
+            raise _NotFound(f"validator {validator_id!r} not found")
+        index = indices[0]
+        bundle = views.snapshot_bundle(snap)
+        if bundle is None:
+            _metrics.counter("serving.fallback").inc()
+            row = oracle.validators_data(snap.raw, snap.context, [index])[0]
+        else:
+            idx, balances, codes = views.resolve_validators(bundle, [index])
+            validator = snap.raw.validators[index]
+            row = {
+                "index": str(index),
+                "balance": str(int(balances[0])),
+                "status": views.STATUS_NAMES[int(codes[0])],
+                "validator": type(validator).to_json(validator),
+            }
+        return 200, self._envelope(snap, row)
+
+    def _balances(self, state_id, params):
+        snap = self._resolve(state_id)
+        ids = self._list_param(params, "id")
+        indices = (
+            oracle.resolve_validator_ids(snap.raw, ids) if ids else None
+        )
+        bundle = views.snapshot_bundle(snap)
+        if bundle is None:
+            _metrics.counter("serving.fallback").inc()
+            rows = oracle.balances_data(snap.raw, indices)
+        else:
+            if indices is None:
+                balances = bundle["balances"]
+                index_list = range(balances.shape[0])
+                _metrics.counter("serving.gathers").inc()
+            else:
+                gathered = views.gather(bundle, indices, ("balances",))
+                balances = gathered["balances"]
+                index_list = indices
+            rows = [
+                {"index": str(i), "balance": str(int(b))}
+                for i, b in zip(index_list, balances.tolist())
+            ]
+        return 200, self._envelope(snap, rows)
+
+    # -- committee machinery endpoints ---------------------------------------
+    def _committees(self, state_id, params):
+        snap = self._resolve(state_id)
+        epoch = self._param(params, "epoch")
+        index = self._param(params, "index")
+        slot = self._param(params, "slot")
+        key = ("committees", epoch, index, slot)
+        rows = snap.memo(
+            key,
+            lambda: oracle.committees_data(
+                snap.raw,
+                snap.context,
+                epoch=None if epoch is None else int(epoch),
+                index=None if index is None else int(index),
+                slot=None if slot is None else int(slot),
+            ),
+        )
+        return 200, self._envelope(snap, rows)
+
+    def _sync_committees(self, state_id, params):
+        snap = self._resolve(state_id)
+        epoch = self._param(params, "epoch")
+        doc = snap.memo(
+            ("sync_committees", epoch),
+            lambda: oracle.sync_committees_data(
+                snap.raw,
+                snap.context,
+                epoch=None if epoch is None else int(epoch),
+            ),
+        )
+        return 200, self._envelope(snap, doc)
+
+    def _attester_duties(self, epoch: int, body):
+        if not isinstance(body, list):
+            raise oracle.BadRequest(
+                "attester duties take a JSON list of validator indices"
+            )
+        snap = self._resolve("head")
+        indices = oracle.resolve_validator_ids(
+            snap.raw, [str(v) for v in body]
+        )
+        duty_map = snap.memo(
+            ("duty_map", epoch),
+            lambda: oracle.attester_duty_map(snap.raw, snap.context, epoch),
+        )
+        rows = oracle.attester_duties_data(snap.raw, duty_map, indices)
+        return 200, self._envelope(
+            snap, rows, extra={"dependent_root": snap.root_hex()}
+        )
+
+    def _proposer_duties(self, epoch: int):
+        snap = self._resolve("head")
+        rows = snap.memo(
+            ("proposer_duties", epoch),
+            lambda: oracle.proposer_duties_data(snap.raw, snap.context, epoch),
+        )
+        return 200, self._envelope(
+            snap, rows, extra={"dependent_root": snap.root_hex()}
+        )
+
+    def _epoch_rewards(self, state_id):
+        snap = self._resolve(state_id)
+
+        def build():
+            doc = views.rewards_summary_columnar(snap)
+            if doc is None:
+                _metrics.counter("serving.fallback").inc()
+                doc = oracle.rewards_summary_data(snap.raw, snap.context)
+            return doc
+
+        return 200, self._envelope(snap, snap.memo(("rewards",), build))
+
+
+class _NotFound(Exception):
+    """Maps to HTTP 404 in ``handle``."""

@@ -194,12 +194,23 @@ class CommitHook:
     True exactly while at least one subscriber is attached — the hot
     path reads it without a call or a lock. Subscribers must never
     raise into the pipeline; a raising subscriber is dropped from the
-    fan-out for the event and counted (``flight.hook_errors``)."""
+    fan-out for the event and counted (``flight.hook_errors``).
+
+    The STATE channel (``subscribe_states``/``emit_state``) is the
+    serving data plane's feed and deliberately separate from the event
+    channel: its payloads carry live state handles (a committed
+    ``BeaconState`` copy — not JSON-ready, never put on an SSE wire),
+    and its guard ``state_active`` gates an O(registry) state copy per
+    flush window in the engine, a cost only a mounted ``HeadStore``
+    should ever switch on. Same contracts otherwise: lock-free tuple
+    snapshot fan-out, subscribers never raise into the pipeline."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._subs: tuple = ()
+        self._state_subs: tuple = ()
         self.active = False
+        self.state_active = False
 
     def subscribe(self, fn) -> None:
         with self._lock:
@@ -214,10 +225,33 @@ class CommitHook:
             self._subs = tuple(s for s in self._subs if s != fn)
             self.active = bool(self._subs)
 
+    def subscribe_states(self, fn) -> None:
+        with self._lock:
+            if fn not in self._state_subs:
+                self._state_subs = self._state_subs + (fn,)
+            self.state_active = True
+
+    def unsubscribe_states(self, fn) -> None:
+        with self._lock:
+            self._state_subs = tuple(s for s in self._state_subs if s != fn)
+            self.state_active = bool(self._state_subs)
+
     def emit(self, kind: str, payload) -> None:
         for fn in self._subs:  # tuple snapshot: safe without the lock
             try:
                 fn(kind, payload)
+            except Exception:  # noqa: BLE001 — never break the pipeline
+                from . import metrics as _metrics
+
+                _metrics.counter("flight.hook_errors").inc()
+
+    def emit_state(self, payload: dict) -> None:
+        """Fan a committed-state snapshot out to the data plane:
+        ``payload`` carries ``state`` (an immutable-by-convention copy),
+        ``context``, ``slot``, ``root`` (hex), ``seq``."""
+        for fn in self._state_subs:  # tuple snapshot, same as emit
+            try:
+                fn(payload)
             except Exception:  # noqa: BLE001 — never break the pipeline
                 from . import metrics as _metrics
 

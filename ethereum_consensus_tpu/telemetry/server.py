@@ -177,6 +177,13 @@ def health_view() -> dict:
 class _Handler(BaseHTTPRequestHandler):
     server_version = "ect-introspect/1"
     protocol_version = "HTTP/1.1"
+    # bounded keep-alive idle: HTTP/1.1 clients (requests.Session on the
+    # Beacon-API data plane) hold persistent connections, parking a
+    # non-daemon handler thread in a blocking read between requests —
+    # without a socket timeout, stop()'s server_close join would wait on
+    # the CLIENT's goodwill. One second bounds the join; an idle-expired
+    # connection just reconnects on its next request.
+    timeout = 1
 
     def log_message(self, fmt, *args):  # noqa: D102 — silence stderr
         pass
@@ -200,7 +207,38 @@ class _Handler(BaseHTTPRequestHandler):
         values = params.get(key)
         return values[0] if values else default
 
+    def _try_apps(self, method: str, route: str, params: dict, body) -> bool:
+        """Route into a mounted app (the serving data plane) when one
+        claims the path prefix; apps return (status, JSON document) and
+        never raise. False → no app claimed the route."""
+        for app in getattr(self.server, "apps", ()):
+            if route.startswith(app.prefix):
+                status, doc = app.handle(method, route, params, body)
+                self._send_json(doc, status=status)
+                return True
+        return False
+
     # -- routes --------------------------------------------------------------
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        route = urlparse(self.path).path
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                body = json.loads(raw) if raw else None
+            except ValueError:
+                self._send_json(
+                    {"code": 400, "message": "request body is not JSON"},
+                    status=400,
+                )
+                return
+            if not self._try_apps("POST", route, self._query(), body):
+                self._send_json(
+                    {"error": f"no route POST {route}"}, status=404
+                )
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response
+
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         route = urlparse(self.path).path
         try:
@@ -220,6 +258,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/events":
                 self._serve_events()
             elif route == "/":
+                apps = getattr(self.server, "apps", ())
                 self._send_json(
                     {
                         "service": "ethereum_consensus_tpu introspection",
@@ -228,10 +267,14 @@ class _Handler(BaseHTTPRequestHandler):
                             "/healthz",
                             "/blocks",
                             "/events",
-                        ],
+                        ]
+                        + [app.prefix + "..." for app in apps],
+                        "apps": [type(app).__name__ for app in apps],
                         "docs": "docs/OBSERVABILITY.md",
                     }
                 )
+            elif self._try_apps("GET", route, self._query(), None):
+                pass
             else:
                 self._send_json({"error": f"no route {route}"}, status=404)
         except (BrokenPipeError, ConnectionResetError):
@@ -317,7 +360,10 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if isinstance(payload, _flight.BlockLineage):
                     payload = payload.to_dict()
-                data = json.dumps(payload, sort_keys=True)
+                # default=repr: an exotic payload value (a state handle
+                # would only appear here through a future event kind)
+                # degrades to its repr instead of killing the stream
+                data = json.dumps(payload, sort_keys=True, default=repr)
                 self.wfile.write(
                     f"event: {kind}\ndata: {data}\n\n".encode("utf-8")
                 )
@@ -349,6 +395,19 @@ class IntrospectionServer:
         self._httpd = None
         self._pool = None
         self._flight_started = False
+        self._apps: tuple = ()
+
+    def mount(self, app) -> "IntrospectionServer":
+        """Mount a data-plane app (``.prefix`` + ``.handle(method, path,
+        params, body) → (status, doc)``) — requests under the prefix
+        route into it (the Beacon-API read plane, serving/handlers.py).
+        Rebinds an immutable tuple, so handler threads iterate a
+        consistent snapshot lock-free."""
+        with self._lock:
+            self._apps = self._apps + (app,)
+            if self._httpd is not None:
+                self._httpd.apps = self._apps
+        return self
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, start_flight: bool = True) -> "IntrospectionServer":
@@ -365,6 +424,7 @@ class IntrospectionServer:
             # bounded at ~0.25s)
             httpd.daemon_threads = False
             httpd.stopping = False
+            httpd.apps = self._apps
             pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="introspection-accept"
             )

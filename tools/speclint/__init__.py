@@ -61,6 +61,11 @@ def _default_targets(root: str) -> dict:
             # scenario mutators corrupt SSZ blocks — through sanctioned
             # channels only, or incremental roots would serve stale bytes
             os.path.join(root, _PKG, "scenarios"),
+            # the serving data plane reads snapshot states + column
+            # views; any write it made would corrupt a served snapshot —
+            # and the column views handed to reader threads are exactly
+            # the alias class aliasflow guards
+            os.path.join(root, _PKG, "serving"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -73,6 +78,9 @@ def _default_targets(root: str) -> dict:
             # the scenario harness drives the pipeline from test/driver
             # threads while the FaultInjector is read on the worker
             os.path.join(root, _PKG, "scenarios"),
+            # the serving layer is concurrent by construction: handler
+            # threads share the HeadStore and per-snapshot lazy builds
+            os.path.join(root, _PKG, "serving"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
