@@ -28,8 +28,8 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + the scenario smoke + the serving smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_scenarios.py tests/test_serving.py -q -m 'bench_smoke or chaos_smoke or serving_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the scenario smoke + the serving smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_scenarios.py tests/test_serving.py -q -m 'bench_smoke or chaos_smoke or serving_smoke'
 
 chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundary chain (minutes)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q -m chaos_smoke

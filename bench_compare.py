@@ -43,6 +43,11 @@ _TREND_HEADLINE = (
     "pipelined_block_s",
     "s_per_epoch",
     "warm_s_per_epoch",
+    # the columnar-primary epoch engine's trend axes (PR 9): the
+    # flagship warm epoch, its cold twin, and the prior-path comparator
+    "epoch_s",
+    "cold_epoch_s",
+    "oracle_epoch_s",
     "adversarial_s",
     "recovery_latency_mean_s",
     # the serving data plane's trend axes (PR 8): gather core seconds

@@ -362,7 +362,13 @@ def process_sync_committee_updates(state, context) -> None:
 
 
 def process_epoch(state, context) -> None:
-    """(epoch_processing.rs:305)"""
+    """(epoch_processing.rs:305) — columnar-primary pass above the
+    engine threshold (models/epoch_vector.py); the literal stage list
+    below is the fallback and the differential oracle."""
+    from ..epoch_vector import process_epoch_columnar
+
+    if process_epoch_columnar(state, context, "altair"):
+        return
     process_justification_and_finalization(state, context)
     process_inactivity_updates(state, context)
     process_rewards_and_penalties(state, context)

@@ -63,7 +63,12 @@ def process_slashings(state, context) -> None:
 
 
 def process_epoch(state, context) -> None:
-    """(epoch_processing.rs:61)"""
+    """(epoch_processing.rs:61) — columnar-primary pass above the
+    engine threshold (models/epoch_vector.py); literal list = oracle."""
+    from ..epoch_vector import process_epoch_columnar
+
+    if process_epoch_columnar(state, context, "bellatrix"):
+        return
     process_justification_and_finalization(state, context)
     process_inactivity_updates(state, context)
     process_rewards_and_penalties(state, context)

@@ -46,7 +46,13 @@ def process_historical_summaries_update(state, context) -> None:
 
 
 def process_epoch(state, context) -> None:
-    """(epoch_processing.rs process_epoch, capella)"""
+    """(epoch_processing.rs process_epoch, capella) — columnar-primary
+    pass above the engine threshold (models/epoch_vector.py); literal
+    list = oracle."""
+    from ..epoch_vector import process_epoch_columnar
+
+    if process_epoch_columnar(state, context, "capella"):
+        return
     process_justification_and_finalization(state, context)
     process_inactivity_updates(state, context)
     process_rewards_and_penalties(state, context)

@@ -163,7 +163,13 @@ def process_effective_balance_updates(state, context) -> None:
 
 
 def process_epoch(state, context) -> None:
-    """(epoch_processing.rs electra process_epoch)"""
+    """(epoch_processing.rs electra process_epoch) — columnar-primary
+    pass above the engine threshold (models/epoch_vector.py), including
+    the EIP-7251 churn stages; literal list = oracle."""
+    from ..epoch_vector import process_epoch_columnar
+
+    if process_epoch_columnar(state, context, "electra"):
+        return
     process_justification_and_finalization(state, context)
     process_inactivity_updates(state, context)
     process_rewards_and_penalties(state, context)

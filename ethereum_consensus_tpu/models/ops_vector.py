@@ -67,6 +67,8 @@ __all__ = [
     "register_attestation_preparer",
     "effective_balance_update_hits",
     "withdrawal_columns",
+    "adopt_list_column",
+    "install_zero_column",
     "fallback",
     "BATCH_MIN_VALIDATORS",
     "BATCH_MIN_ATTESTATIONS",
@@ -436,6 +438,65 @@ def _pack_from_columns(cols, state, previous_epoch,
         "inactivity_scores": inactivity,
         "balances": balances,
     }
+
+
+# ---------------------------------------------------------------------------
+# write-direction column commits (the columnar-primary epoch engine,
+# models/epoch_vector.py)
+# ---------------------------------------------------------------------------
+
+
+def adopt_list_column(lst, arr, changed_indices, vmax) -> None:
+    """Columnar-primary commit of a scalar-list column: ``arr`` is the
+    AUTHORITATIVE new content (the epoch engine computed the whole epoch
+    on it), the SSZ list is the materialization. One ``bulk_store`` with
+    the exact changed indices splices the values in (so incremental HTR
+    re-merkleizes only the touched 4096-element groups), and ``arr``
+    itself becomes the list's column cache — owned, with a CLEAN dirty
+    set — instead of paying a read-direction refresh of rows we just
+    wrote. This is the ``_col_dirty`` machinery driven in the write
+    direction (docs/OPS_VECTOR.md).
+
+    Ownership contract: the caller HANDS OVER ``arr`` — it must never
+    mutate it afterwards (the epoch engine drops its working references
+    at commit). ``changed_indices`` must name every position whose value
+    differs from the list's current content (the ``bulk_store``
+    certification contract). A no-change commit is free."""
+    np = _np()
+    n = len(lst)
+    if np is None or arr.shape[0] != n:
+        fallback("adopt_shape")
+        bulk_store(lst, [int(x) for x in arr], changed_indices)
+        return
+    changed = np.asarray(changed_indices, dtype=np.int64)
+    if changed.size:
+        bulk_store(lst, arr.tolist(), changed)
+        metrics.counter("ops_vector.bulk_store.calls").inc()
+        metrics.counter("ops_vector.bulk_store.elements").inc(
+            int(changed.size)
+        )
+    if lst.__class__ is CachedRootList:
+        lst._col_cache = ("list", arr, vmax)
+        lst._col_owned = True
+        lst._col_dirty = set()
+        metrics.counter("ops_vector.columns.adopted").inc()
+
+
+def install_zero_column(lst, n: int, vmax: int = 0xFF) -> None:
+    """Column adoption for a FRESH all-zero list (the participation
+    rotation writes ``[0] * n``): the list already holds exactly zeros,
+    so no splice is needed — just install the matching zero column as
+    the owned, clean cache, and certify uniformity (every element is a
+    literal int 0) so the next hash walk skips the type scan."""
+    np = _np()
+    if np is None or lst.__class__ is not CachedRootList or len(lst) != n:
+        return
+    dtype = np.uint8 if vmax == 0xFF else np.uint64
+    lst._col_cache = ("list", np.zeros(n, dtype=dtype), vmax)
+    lst._col_owned = True
+    lst._col_dirty = set()
+    lst._uniform_kind = ("int",)
+    metrics.counter("ops_vector.columns.adopted").inc()
 
 
 # ---------------------------------------------------------------------------

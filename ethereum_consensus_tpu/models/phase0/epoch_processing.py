@@ -357,11 +357,13 @@ def _attestation_deltas_vectorized(state, context):
     attesting increments < 2^23)."""
     import numpy as np
 
-    from ...ops.registry_columns import pack_registry
+    from ..ops_vector import pack_registry_cached
 
     n = len(state.validators)
     prev = h.get_previous_epoch(state, context)
-    packed = pack_registry(state, prev)
+    # delta-refreshed registry-column cache (models/ops_vector.py); the
+    # literal fromiter packing is its internal fallback
+    packed = pack_registry_cached(state, prev)
     eff = packed["effective_balance"]
     slashed = packed["slashed"]
     active_prev = packed["active_previous"]
@@ -753,7 +755,14 @@ def process_participation_record_updates(state, context) -> None:
 
 
 def process_epoch(state, context) -> None:
-    """(epoch_processing.rs:1039)"""
+    """(epoch_processing.rs:1039) — columnar-primary pass above the
+    engine threshold (models/epoch_vector.py, one vectorized pass over
+    the authoritative registry columns); this literal stage list is the
+    fallback and the differential oracle."""
+    from ..epoch_vector import process_epoch_columnar
+
+    if process_epoch_columnar(state, context, "phase0"):
+        return
     process_justification_and_finalization(state, context)
     process_rewards_and_penalties(state, context)
     process_registry_updates(state, context)

@@ -78,6 +78,15 @@ def _state_root_hex(signed_block) -> str:
     return bytes(signed_block.message.state_root).hex()
 
 
+def _block_root_hex(signed_block) -> str:
+    """The block's own hash_tree_root — the BLOCK-root index the serving
+    duties endpoints resolve ``dependent_root`` against. An instance-
+    cache hit in practice: stage A's proposer-signature check already
+    merkleized the message for its signing root."""
+    message = signed_block.message
+    return type(message).hash_tree_root(message).hex()
+
+
 class _Entry:
     """One speculatively applied block: the block itself (kept for the
     rollback re-application), its collected signature batch, and — when
@@ -292,6 +301,7 @@ class ChainPipeline:
             _flight.BlockLineage(
                 slot=entry.slot,
                 root=_state_root_hex(entry.signed_block),
+                block_root=_block_root_hex(entry.signed_block),
                 fork=entry.fork,
                 outcome=outcome,
                 stage_a_s=entry.stage_a_s,
@@ -349,6 +359,7 @@ class ChainPipeline:
                 "context": self._executor.context,
                 "slot": last.slot,
                 "root": _state_root_hex(last.signed_block),
+                "block_root": _block_root_hex(last.signed_block),
                 "seq": seq,
             }
         )
@@ -359,6 +370,7 @@ class ChainPipeline:
             {
                 "slot": entry.slot,
                 "root": _state_root_hex(entry.signed_block),
+                "block_root": _block_root_hex(entry.signed_block),
                 "blocks": blocks,
                 "seq": seq,
             },

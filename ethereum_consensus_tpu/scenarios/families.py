@@ -43,6 +43,7 @@ __all__ = [
     "equivocation_traffic",
     "deep_reorg_checkpoint_restore",
     "infrastructure_faults",
+    "eip7251_churn_segment",
     "FAMILIES",
 ]
 
@@ -387,10 +388,171 @@ def infrastructure_faults(validator_count: int = 64) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# family 6 — electra EIP-7251 churn at the epoch boundary
+# ---------------------------------------------------------------------------
+
+
+def eip7251_churn_segment(validator_count: int = 96, epochs: int = 2,
+                          policy: "FlushPolicy | None" = None) -> dict:
+    """An electra chain segment whose pre-state carries the full
+    EIP-7251 churn surface — pending CONSOLIDATIONS (a ripe one, a
+    slashed source that must be skipped, an unripe one that must stop
+    the sweep), pending balance deposits, ripe pending PARTIAL
+    withdrawals (paid by the block-level withdrawals sweep), and a
+    0x00/0x01/0x02 credential mix — replayed through the pipeline across
+    ``epochs`` boundaries with the columnar-primary epoch pass forced.
+
+    Contract: the churn stages actually run (consolidations/deposits
+    consumed, partials paid, the consolidation target switched to
+    compounding), every boundary ran through the columnar pass, the
+    committed head is bit-identical (root AND bytes) to the scalar
+    oracle, and the column caches agree with the literal values with
+    ``_col_dirty`` drained at EVERY block edge."""
+    import importlib
+
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork("electra", validator_count, "minimal")
+    ns = importlib.import_module(
+        "ethereum_consensus_tpu.models.electra.containers"
+    )
+    n = validator_count
+    min_activation = int(ctx.MIN_ACTIVATION_BALANCE)
+
+    # credential mix: eth1 0x01 on every 3rd validator, compounding 0x02
+    # on every 5th (genesis keeps 0x00 BLS elsewhere)
+    for i in range(0, n, 3):
+        v = state.validators[i]
+        v.withdrawal_credentials = b"\x01" + bytes(
+            v.withdrawal_credentials
+        )[1:]
+    for i in range(1, n, 5):
+        v = state.validators[i]
+        v.withdrawal_credentials = b"\x02" + bytes(
+            v.withdrawal_credentials
+        )[1:]
+    # ripe partial withdrawals: compounding validators with excess
+    # balance over MIN_ACTIVATION — the block-level sweep pays them
+    partial_targets = [1, 6, 11]
+    for i in partial_targets:
+        v = state.validators[i]
+        v.withdrawal_credentials = b"\x02" + bytes(
+            v.withdrawal_credentials
+        )[1:]
+        v.effective_balance = min_activation + 8 * 10**9
+        state.balances[i] = min_activation + 9 * 10**9
+        state.pending_partial_withdrawals.append(
+            ns.PendingPartialWithdrawal(
+                index=i, amount=2 * 10**9, withdrawable_epoch=0
+            )
+        )
+    # pending deposits for the boundary sweep
+    for k in range(8):
+        state.pending_balance_deposits.append(
+            ns.PendingBalanceDeposit(index=k, amount=10**9 * (k % 3 + 1))
+        )
+    # consolidations: ripe (source withdrawable), slashed source
+    # (skipped), unripe source (stops the sweep)
+    src_ripe, src_slashed, src_unripe = n - 2, n - 3, n - 4
+    state.validators[src_ripe].exit_epoch = 0
+    state.validators[src_ripe].withdrawable_epoch = 0
+    state.validators[src_slashed].slashed = True
+    state.validators[src_unripe].exit_epoch = 2
+    state.validators[src_unripe].withdrawable_epoch = epochs + 4
+    # the ripe target holds 0x01 credentials: processing must switch it
+    # to compounding AND queue its excess balance
+    switch_target = 3
+    state.validators[switch_target].withdrawal_credentials = (
+        b"\x01"
+        + bytes(state.validators[switch_target].withdrawal_credentials)[1:]
+    )
+    state.balances[switch_target] = min_activation + 3 * 10**9
+    for source, target in (
+        (src_ripe, switch_target),
+        (src_slashed, 8),
+        (src_unripe, 9),
+    ):
+        state.pending_consolidations.append(
+            ns.PendingConsolidation(source_index=source, target_index=target)
+        )
+    cu._strip_spec_caches(state)
+
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    n_blocks = epochs * spe + 2
+    # electra attestation traffic needs the EIP-7549 committee-bits
+    # shape, which produce_chain's phase0-format helper can't build —
+    # produce the segment the way the equivocation family does
+    stm = importlib.import_module(
+        "ethereum_consensus_tpu.models.electra.state_transition"
+    )
+    scratch = state.copy()
+    blocks = []
+    pending_atts: list = []
+    for slot in range(1, n_blocks + 1):
+        block = cu.produce_block_fork("electra", scratch, slot, ctx,
+                                      attestations=pending_atts)
+        stm.state_transition_block_in_slot(
+            scratch, block, stm.Validation.ENABLED, ctx
+        )
+        pending_atts = [cu.make_attestation_electra(scratch, slot, ctx)]
+        blocks.append(block)
+    del scratch
+    oracle_ex, _ = oracle_replay(state, ctx, blocks)
+    epochs_ctr = metrics.counter("epoch_vector.epochs")
+    before = epochs_ctr.value()
+    policy = policy or FlushPolicy(window_size=4, max_in_flight=2,
+                                   checkpoint_interval=2)
+    with forced_columnar():
+        ex = Executor(state.copy(), ctx)
+        pipe = ChainPipeline(ex, policy=policy)
+        for block in blocks:
+            pipe.submit(block)
+            # the churn stages mutate balances, credentials AND the
+            # pending queues — the columns must agree with the literal
+            # values, dirty channels drained, at every edge
+            assert_column_consistency(
+                pipe.state,
+                where=f"churn segment, slot {int(block.message.slot)}",
+            )
+        stats = pipe.close()
+    engaged = epochs_ctr.value() - before
+    assert engaged >= epochs, (
+        f"columnar pass ran {engaged} boundaries, expected >= {epochs}"
+    )
+    assert stats.rollbacks == 0
+
+    head = getattr(ex.state, "data", ex.state)
+    # the churn actually happened
+    assert len(head.pending_balance_deposits) < 8 + 1, "deposits untouched"
+    remaining_sources = {
+        int(p.source_index) for p in head.pending_consolidations
+    }
+    assert src_ripe not in remaining_sources, "ripe consolidation unprocessed"
+    assert src_unripe in remaining_sources, "unripe consolidation consumed"
+    assert bytes(
+        head.validators[switch_target].withdrawal_credentials
+    )[:1] == b"\x02", "consolidation target not switched to compounding"
+    assert len(head.pending_partial_withdrawals) < len(partial_targets), (
+        "no pending partial withdrawal was paid"
+    )
+    assert_bit_identical(ex.state, oracle_ex.state, "eip7251 churn head")
+    assert_column_consistency(ex.state, "eip7251 churn head")
+    metrics.counter("scenario.eip7251_churn.runs").inc()
+    return {
+        "blocks": len(blocks),
+        "boundaries": engaged,
+        "pending_deposits_left": len(head.pending_balance_deposits),
+        "pending_consolidations_left": len(head.pending_consolidations),
+        "pending_partials_left": len(head.pending_partial_withdrawals),
+        "stats": stats.snapshot(),
+    }
+
+
 FAMILIES = {
     "fork_boundary": fork_boundary_replay,
     "storm": invalid_block_storm,
     "equivocation": equivocation_traffic,
     "reorg": deep_reorg_checkpoint_restore,
     "faults": infrastructure_faults,
+    "eip7251_churn": eip7251_churn_segment,
 }

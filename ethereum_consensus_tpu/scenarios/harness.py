@@ -70,15 +70,21 @@ def scalar_mode():
 
 @contextmanager
 def forced_columnar():
-    """Drop the columnar engine's registry-size threshold for the scope,
-    so toy-scale scenario chains exercise the batched attestation path
-    the way a 2^17 registry would."""
+    """Drop the columnar engines' registry-size thresholds for the
+    scope, so toy-scale scenario chains exercise the batched attestation
+    path AND the columnar-primary epoch pass (models/epoch_vector.py)
+    the way a 2^21 registry would."""
+    from ..models import epoch_vector
+
     old = ops_vector.BATCH_MIN_VALIDATORS
+    old_epoch = epoch_vector.EPOCH_VECTOR_MIN_VALIDATORS
     ops_vector.BATCH_MIN_VALIDATORS = 0
+    epoch_vector.EPOCH_VECTOR_MIN_VALIDATORS = 0
     try:
         yield
     finally:
         ops_vector.BATCH_MIN_VALIDATORS = old
+        epoch_vector.EPOCH_VECTOR_MIN_VALIDATORS = old_epoch
 
 
 def _unwrap(state):

@@ -339,8 +339,15 @@ class BeaconDataPlane:
             lambda: oracle.attester_duty_map(snap.raw, snap.context, epoch),
         )
         rows = oracle.attester_duties_data(snap.raw, duty_map, indices)
+        dep = snap.memo(
+            ("dependent_root", "attester", epoch),
+            lambda: oracle.dependent_root(
+                snap.raw, snap.context, epoch, "attester",
+                head_root=snap.block_root,
+            ),
+        )
         return 200, self._envelope(
-            snap, rows, extra={"dependent_root": snap.root_hex()}
+            snap, rows, extra={"dependent_root": "0x" + dep.hex()}
         )
 
     def _proposer_duties(self, epoch: int):
@@ -349,8 +356,15 @@ class BeaconDataPlane:
             ("proposer_duties", epoch),
             lambda: oracle.proposer_duties_data(snap.raw, snap.context, epoch),
         )
+        dep = snap.memo(
+            ("dependent_root", "proposer", epoch),
+            lambda: oracle.dependent_root(
+                snap.raw, snap.context, epoch, "proposer",
+                head_root=snap.block_root,
+            ),
+        )
         return 200, self._envelope(
-            snap, rows, extra={"dependent_root": snap.root_hex()}
+            snap, rows, extra={"dependent_root": "0x" + dep.hex()}
         )
 
     def _epoch_rewards(self, state_id):

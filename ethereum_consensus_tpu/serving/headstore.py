@@ -53,7 +53,10 @@ class Snapshot:
     spec helpers and the columnar engine take). ``root`` is the state's
     hash_tree_root as bytes — for pipeline-published snapshots it is the
     block's claimed (and stage-A-verified) post-state root, a free field
-    read."""
+    read. ``block_root`` is the head BLOCK's hash_tree_root (the
+    flight-lineage claimed block root for pipeline publishes, derived
+    from ``latest_block_header`` otherwise) — the duties endpoints'
+    ``dependent_root`` anchor and the store's block-root index key."""
 
     __slots__ = (
         "state",
@@ -61,6 +64,7 @@ class Snapshot:
         "context",
         "slot",
         "root",
+        "block_root",
         "fork",
         "seq",
         "published_at",
@@ -70,12 +74,18 @@ class Snapshot:
         "_memo",
     )
 
-    def __init__(self, state, context, slot: int, root: bytes, seq=None):
+    def __init__(self, state, context, slot: int, root: bytes, seq=None,
+                 block_root: "bytes | None" = None):
         self.state = state
         self.raw = getattr(state, "data", state)
         self.context = context
         self.slot = int(slot)
         self.root = bytes(root)
+        if block_root is None:
+            from . import oracle as _oracle
+
+            block_root = _oracle.head_block_root(self.raw)
+        self.block_root = bytes(block_root)
         version = getattr(state, "version", None)
         self.fork = version().name.lower() if version is not None else None
         self.seq = seq
@@ -149,6 +159,7 @@ class HeadStore:
         self._capacity = max(1, int(capacity))
         self._history: list = []  # oldest → newest
         self._by_root: dict = {}
+        self._by_block_root: dict = {}  # PR 8 residue: the block-root index
         self._attached = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -176,6 +187,11 @@ class HeadStore:
         """Commit-hook state-channel subscriber (must never raise into
         the pipeline — the hook counts and swallows if we do)."""
         root = payload["root"]
+        block_root = payload.get("block_root")
+        if block_root is not None:
+            block_root = bytes.fromhex(
+                block_root[2:] if block_root.startswith("0x") else block_root
+            )
         self._install(
             Snapshot(
                 payload["state"],
@@ -183,19 +199,22 @@ class HeadStore:
                 payload["slot"],
                 bytes.fromhex(root[2:] if root.startswith("0x") else root),
                 seq=payload.get("seq"),
+                block_root=block_root,
             )
         )
 
-    def publish(self, state, context, slot=None, root=None, seq=None):
+    def publish(self, state, context, slot=None, root=None, seq=None,
+                block_root=None):
         """Directly publish ``state`` (NOT copied — hand the store a
-        state nothing else will mutate). Root/slot computed from the
-        state when omitted."""
+        state nothing else will mutate). Root/slot/block root computed
+        from the state when omitted."""
         raw = getattr(state, "data", state)
         if root is None:
             root = type(raw).hash_tree_root(raw)
         if slot is None:
             slot = int(raw.slot)
-        snap = Snapshot(state, context, slot, root, seq=seq)
+        snap = Snapshot(state, context, slot, root, seq=seq,
+                        block_root=block_root)
         self._install(snap)
         return snap
 
@@ -203,10 +222,13 @@ class HeadStore:
         with self._lock:
             self._history.append(snap)
             self._by_root[snap.root] = snap
+            self._by_block_root[snap.block_root] = snap
             while len(self._history) > self._capacity:
                 old = self._history.pop(0)
                 if self._by_root.get(old.root) is old:
                     del self._by_root[old.root]
+                if self._by_block_root.get(old.block_root) is old:
+                    del self._by_block_root[old.block_root]
                 _metrics.counter("serving.snapshots.evicted").inc()
         _metrics.counter("serving.snapshots.published").inc()
         _metrics.gauge("serving.head_slot").set(snap.slot)
@@ -215,6 +237,7 @@ class HeadStore:
         with self._lock:
             self._history = []
             self._by_root = {}
+            self._by_block_root = {}
 
     # -- resolution ----------------------------------------------------------
     @property
@@ -235,7 +258,9 @@ class HeadStore:
         ``justified`` → the matching retained snapshot, or None (the
         handler's 404). ``genesis`` resolves only while a slot-0
         snapshot is retained. Slot resolution is exact-match newest-
-        first: snapshots exist per commit, not per slot."""
+        first: snapshots exist per commit, not per slot. A 0x-root
+        resolves against the state-root index first, then the
+        block-root index (PR 8 residue: dependent_root pinning)."""
         value = getattr(state_id, "value", state_id)
         if isinstance(value, str):
             if value == "head":
@@ -255,7 +280,12 @@ class HeadStore:
                 return None
         if isinstance(value, bytes):
             with self._lock:
-                return self._by_root.get(bytes(value))
+                hit = self._by_root.get(bytes(value))
+                if hit is None:
+                    # the block-root index: duties clients pin follow-up
+                    # reads to dependent_root, which is a BLOCK root
+                    hit = self._by_block_root.get(bytes(value))
+                return hit
         if isinstance(value, int):
             return self._newest(lambda s: s.slot == value)
         return None

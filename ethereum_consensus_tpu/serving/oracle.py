@@ -306,6 +306,46 @@ def proposer_duties_data(state, context, epoch: int) -> list:
     return rows
 
 
+def head_block_root(state) -> bytes:
+    """The head BLOCK's hash_tree_root derived from the state alone:
+    ``latest_block_header`` with its ``state_root`` filled the way
+    ``process_slot`` fills it. Identical to the pipeline's claimed block
+    root for the same head (test-asserted), so pipeline-less publishes
+    index the same way."""
+    from ..models.phase0.containers import BeaconBlockHeader
+
+    header = state.latest_block_header.copy()
+    if bytes(header.state_root) == b"\x00" * 32:
+        header.state_root = type(state).hash_tree_root(state)
+    return BeaconBlockHeader.hash_tree_root(header)
+
+
+def dependent_root(state, context, epoch: int, duty: str,
+                   head_root: "bytes | None" = None) -> bytes:
+    """The REAL ``dependent_root`` of a duties response (PR 8 residue —
+    this used to be a state-root placeholder): the block root the duty
+    assignment is derived from, i.e. the last block before the epoch the
+    shuffling seed reads.
+
+    * proposer duties for ``epoch`` → block root at
+      ``start_slot(epoch) - 1``;
+    * attester duties for ``epoch`` → block root at
+      ``start_slot(epoch - 1) - 1``;
+    * a dependent slot before genesis → the genesis block root; at or
+      past the state's slot → the head block root (``head_root`` when
+      the caller has the pipeline's claimed one, else derived)."""
+    spe = int(context.SLOTS_PER_EPOCH)
+    if duty == "proposer":
+        dep_slot = epoch * spe - 1
+    else:
+        dep_slot = max(0, epoch - 1) * spe - 1
+    if 0 <= dep_slot < int(state.slot):
+        return h.get_block_root_at_slot(state, dep_slot)
+    if head_root is not None:
+        return bytes(head_root)
+    return head_block_root(state)
+
+
 def rewards_summary_data(state, context) -> dict:
     """Scalar twin of ``views.rewards_summary_columnar`` — exact python
     ints over the literal containers."""

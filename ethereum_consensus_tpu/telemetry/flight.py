@@ -85,6 +85,7 @@ class BlockLineage:
     __slots__ = (
         "slot",
         "root",
+        "block_root",
         "fork",
         "outcome",
         "stage_a_s",
@@ -107,6 +108,7 @@ class BlockLineage:
         self,
         slot: int,
         root: str,
+        block_root: "str | None" = None,
         fork: "str | None" = None,
         outcome: str = "committed",
         stage_a_s: "float | None" = None,
@@ -128,6 +130,7 @@ class BlockLineage:
             raise ValueError(f"unknown outcome {outcome!r}")
         self.slot = slot
         self.root = root
+        self.block_root = block_root
         self.fork = fork
         self.outcome = outcome
         self.stage_a_s = stage_a_s

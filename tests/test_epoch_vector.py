@@ -1,0 +1,484 @@
+"""Columnar-primary epoch engine (models/epoch_vector.py): differential
+bit-identity against the literal stage lists across all six forks —
+including electra's EIP-7251 churn — plus copy-on-write column travel,
+the write-direction adoption contract, and the XLA-jittability of the
+numeric kernels."""
+
+import os
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import chain_utils  # noqa: E402
+
+from ethereum_consensus_tpu.models import epoch_vector, ops_vector  # noqa: E402
+from ethereum_consensus_tpu.primitives import FAR_FUTURE_EPOCH  # noqa: E402
+from ethereum_consensus_tpu.scenarios.harness import (  # noqa: E402
+    assert_bit_identical,
+    assert_column_consistency,
+)
+from ethereum_consensus_tpu.ssz.core import CachedRootList  # noqa: E402
+from ethereum_consensus_tpu.telemetry import metrics  # noqa: E402
+
+np = pytest.importorskip("numpy")
+
+FORKS = ("phase0", "altair", "bellatrix", "capella", "deneb", "electra")
+
+
+@pytest.fixture
+def forced_engine(monkeypatch):
+    """Engage the columnar pass on toy registries (the production
+    threshold is 2^12)."""
+    monkeypatch.setattr(epoch_vector, "EPOCH_VECTOR_MIN_VALIDATORS", 0)
+
+
+def _slot_processing(fork):
+    import importlib
+
+    return importlib.import_module(
+        f"ethereum_consensus_tpu.models.{fork}.slot_processing"
+    )
+
+
+def _scramble(state, ctx, fork, rng, epoch):
+    """Out-of-contract-free state churn: ejection candidates, entrants,
+    finalized-eligible activations, slashed validators at the penalty
+    halfway point, hysteresis triggers in both directions, inactivity
+    scores — and for electra the full EIP-7251 churn surface. Mutating
+    activity fields directly bypasses initiate_validator_exit, so the
+    memo caches are stripped afterwards (the documented epoch-horizon
+    gap — chain_utils._strip_spec_caches)."""
+    n = len(state.validators)
+    for i in rng.sample(range(n), 6):
+        state.validators[i].effective_balance = int(ctx.ejection_balance)
+    for i in rng.sample(range(n), 4):
+        v = state.validators[i]
+        v.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+        v.activation_epoch = FAR_FUTURE_EPOCH
+    for i in rng.sample(range(n), 5):
+        v = state.validators[i]
+        v.activation_eligibility_epoch = 0
+        v.activation_epoch = FAR_FUTURE_EPOCH
+    half = int(ctx.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    for i in rng.sample(range(n), 3):
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = epoch + half
+        state.slashings[epoch % int(ctx.EPOCHS_PER_SLASHINGS_VECTOR)] = 10**9
+    for i in rng.sample(range(n), 8):
+        state.balances[i] = rng.choice(
+            [10**9, 33 * 10**9, 62 * 10**9, 2100 * 10**9]
+        )
+    for i in rng.sample(range(n), 2):
+        state.validators[i].exit_epoch = epoch + 7
+    if hasattr(state, "inactivity_scores"):
+        for i in rng.sample(range(n), 10):
+            state.inactivity_scores[i] = rng.randrange(0, 200)
+    if fork == "electra":
+        import importlib
+
+        ns = importlib.import_module(
+            "ethereum_consensus_tpu.models.electra.containers"
+        )
+        for i in range(0, n, 3):
+            v = state.validators[i]
+            v.withdrawal_credentials = b"\x01" + bytes(
+                v.withdrawal_credentials
+            )[1:]
+        for i in range(1, n, 5):
+            v = state.validators[i]
+            v.withdrawal_credentials = b"\x02" + bytes(
+                v.withdrawal_credentials
+            )[1:]
+        for k in range(12):
+            state.pending_balance_deposits.append(
+                ns.PendingBalanceDeposit(
+                    index=k, amount=10**9 * (k % 5 + 1)
+                )
+            )
+        src_ripe, src_slash, src_unripe = 7, 11, 13
+        state.validators[src_ripe].exit_epoch = max(1, epoch)
+        state.validators[src_ripe].withdrawable_epoch = epoch
+        state.validators[src_slash].slashed = True
+        state.validators[src_unripe].exit_epoch = epoch + 3
+        state.validators[src_unripe].withdrawable_epoch = epoch + 9
+        for source, target in (
+            (src_ripe, 0), (src_slash, 3), (src_unripe, 6), (src_ripe, 9),
+        ):
+            state.pending_consolidations.append(
+                ns.PendingConsolidation(
+                    source_index=source, target_index=target
+                )
+            )
+    chain_utils._strip_spec_caches(state)
+
+
+@pytest.mark.parametrize("fork", FORKS)
+@pytest.mark.parametrize(
+    "participation", [0b111, 0b000, 0b010], ids=["full", "leak", "target"]
+)
+def test_columnar_epoch_bit_identity(fork, participation, forced_engine):
+    """The whole-epoch differential: columnar-primary pass vs the
+    literal stage list, root AND bytes, across 6 scrambled epochs —
+    ejections, activations, slashings, leak conditions, hysteresis, and
+    (electra) consolidations + pending deposits all land inside the
+    pass. Column caches must agree with the literal values with
+    ``_col_dirty`` drained after every boundary."""
+    state, ctx = chain_utils.fresh_genesis_fork(fork, 96, "minimal")
+    sp = _slot_processing(fork)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    engaged_ctr = metrics.counter("epoch_vector.epochs")
+    s_col = state.copy()
+    s_scl = state.copy()
+    for target_epoch in range(1, 7):
+        for s in (s_col, s_scl):
+            rng = random.Random((target_epoch, participation).__hash__())
+            _scramble(s, ctx, fork, rng, target_epoch - 1)
+            if hasattr(s, "previous_epoch_participation"):
+                n = len(s.validators)
+                s.previous_epoch_participation = [participation] * n
+                s.current_epoch_participation = [participation & 0b110] * n
+        before = engaged_ctr.value()
+        sp.process_slots(s_col, target_epoch * spe, ctx)
+        assert engaged_ctr.value() - before == 1, (
+            f"columnar pass did not engage at epoch {target_epoch}"
+        )
+        os.environ["ECT_EPOCH_VECTOR"] = "off"
+        try:
+            sp.process_slots(s_scl, target_epoch * spe, ctx)
+        finally:
+            os.environ.pop("ECT_EPOCH_VECTOR", None)
+        assert_bit_identical(
+            s_col, s_scl, f"{fork} epoch {target_epoch}"
+        )
+        assert_column_consistency(s_col, f"{fork} epoch {target_epoch}")
+
+
+def test_engine_declines_cleanly(forced_engine):
+    """Every decline path leaves the state untouched for the literal
+    list: env kill switches, the u64 lane guard, and the registry-size
+    threshold (without the fixture's override)."""
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 64, "minimal")
+    sp = _slot_processing("deneb")
+    spe = int(ctx.SLOTS_PER_EPOCH)
+
+    for env in ("ECT_EPOCH_VECTOR", "ECT_OPS_VECTOR"):
+        s = state.copy()
+        before = metrics.counter("epoch_vector.epochs").value()
+        os.environ[env] = "off"
+        try:
+            sp.process_slots(s, spe, ctx)
+        finally:
+            os.environ.pop(env, None)
+        assert metrics.counter("epoch_vector.epochs").value() == before
+
+    # adversarial near-2^64 balance: the lane guard declines BEFORE any
+    # mutation and the literal path still produces the exact state
+    hot = state.copy()
+    hot.balances[5] = (1 << 64) - 3
+    twin = hot.copy()
+    guard = metrics.counter("epoch_vector.fallback.u64_guard")
+    before = guard.value()
+    s = hot.copy()
+    sp.process_slots(s, spe, ctx)
+    assert guard.value() > before, "lane guard did not fire"
+    os.environ["ECT_EPOCH_VECTOR"] = "off"
+    try:
+        sp.process_slots(twin, spe, ctx)
+    finally:
+        os.environ.pop("ECT_EPOCH_VECTOR", None)
+    assert_bit_identical(s, twin, "lane-guard decline")
+
+
+def test_engine_threshold_without_override():
+    """Below EPOCH_VECTOR_MIN_VALIDATORS the pass stays out of the way
+    (tier-1's toy states must keep running the literal lists)."""
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 64, "minimal")
+    sp = _slot_processing("deneb")
+    before = metrics.counter("epoch_vector.epochs").value()
+    s = state.copy()
+    sp.process_slots(s, int(ctx.SLOTS_PER_EPOCH), ctx)
+    assert metrics.counter("epoch_vector.epochs").value() == before
+
+
+# ---------------------------------------------------------------------------
+# write-direction column adoption
+# ---------------------------------------------------------------------------
+
+
+def test_adopt_list_column_contract():
+    """adopt_list_column materializes the authoritative array into the
+    SSZ list via ONE certified bulk_store and installs the array itself
+    as the clean, owned column cache — and the incremental root off the
+    adopted commit matches a cold recompute."""
+    from ethereum_consensus_tpu.ssz.core import List, uint64
+
+    typ = List[uint64, 1 << 20]
+    lst = CachedRootList(range(10_000))
+    typ.hash_tree_root(lst)  # memoize so the adopted commit splices
+    # attach a columnar consumer (arms _col_dirty)
+    arr0 = np.arange(10_000, dtype=np.uint64)
+    lst._col_cache = ("list", arr0, (1 << 64) - 1)
+    lst._col_owned = True
+    lst._col_dirty = set()
+
+    work = arr0.copy()
+    work[17] += 5
+    work[9_999] = 123
+    changed = np.nonzero(work != arr0)[0]
+    ops_vector.adopt_list_column(lst, work, changed, (1 << 64) - 1)
+    assert list.__getitem__(lst, 17) == 17 + 5
+    assert list.__getitem__(lst, 9_999) == 123
+    assert lst._col_cache[1] is work, "authoritative array not adopted"
+    assert lst._col_owned and lst._col_dirty == set()
+    assert typ.hash_tree_root(lst) == typ.hash_tree_root(
+        CachedRootList(work.tolist())
+    )
+    # a no-change adoption must not touch the list (free commit)
+    gen = lst._mut_gen
+    ops_vector.adopt_list_column(
+        lst, work.copy(), np.empty(0, dtype=np.int64), (1 << 64) - 1
+    )
+    assert lst._mut_gen == gen
+
+
+def test_install_zero_column():
+    lst = CachedRootList([0] * 512)
+    ops_vector.install_zero_column(lst, 512, 0xFF)
+    assert lst._col_cache[1].dtype == np.uint8
+    assert not lst._col_cache[1].any()
+    assert lst._uniform_kind == ("int",)
+    # the installed column serves reads through the normal accessor
+    class _S:  # noqa: N801 — minimal field bag
+        pass
+
+    s = _S()
+    s.current_epoch_participation = lst
+    cols = ops_vector.RegistryColumns(s)
+    col = cols.list_column(s, "current_epoch_participation")
+    assert col is not None and not col.any()
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write column travel
+# ---------------------------------------------------------------------------
+
+
+def test_copy_on_write_shared_base_and_post_write_isolation(forced_engine):
+    """state.copy() under the columnar-primary backend must NOT copy
+    column buffers until a write lands on either side: the copy shares
+    the exact array objects (ownership dropped on both sides), and the
+    first post-write sync clones the writer's arrays while the sibling
+    keeps the originals."""
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 96, "minimal")
+    sp = _slot_processing("deneb")
+    sp.process_slots(state, int(ctx.SLOTS_PER_EPOCH), ctx)  # builds columns
+
+    cols = ops_vector.columns_for(state)
+    cols.validator_columns(state)
+    cols.list_column(state, "balances")
+    base_val_arrays = state.validators._col_cache[1]
+    base_bal_array = state.balances._col_cache[1]
+
+    copied = state.copy()
+    # shared base: the SAME buffers, ownership dropped on both sides
+    assert copied.validators._col_cache[1] is base_val_arrays
+    assert copied.balances._col_cache[1] is base_bal_array
+    assert not state.validators._col_owned
+    assert not copied.validators._col_owned
+    assert not state.balances._col_owned
+    assert not copied.balances._col_owned
+
+    # a write on the COPY clones the copy's arrays on its next sync...
+    copied.balances[3] = 77 * 10**9
+    copied.validators[4].effective_balance = 17 * 10**9
+    ccols = ops_vector.columns_for(copied)
+    assert int(ccols.list_column(copied, "balances")[3]) == 77 * 10**9
+    assert (
+        int(ccols.validator_columns(copied)["effective_balance"][4])
+        == 17 * 10**9
+    )
+    assert copied.balances._col_cache[1] is not base_bal_array
+    assert copied.validators._col_cache[1] is not base_val_arrays
+    # ...while the original still shares the untouched base buffers
+    assert state.balances._col_cache[1] is base_bal_array
+    assert int(cols.list_column(state, "balances")[3]) != 77 * 10**9
+    assert_column_consistency(state, "original after sibling write")
+    assert_column_consistency(copied, "copy after write")
+
+
+def test_columnar_epoch_travels_across_copy(forced_engine):
+    """An epoch processed on a COPY (the pipeline checkpoint shape) must
+    not leak adopted arrays or dirty state back into the original."""
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 96, "minimal")
+    sp = _slot_processing("deneb")
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    sp.process_slots(state, spe, ctx)
+    root_before = type(state).hash_tree_root(state)
+    serialized_before = type(state).serialize(state)
+
+    checkpoint = state.copy()
+    sp.process_slots(checkpoint, 2 * spe, ctx)  # columnar pass on the copy
+    assert type(state).hash_tree_root(state) == root_before
+    assert type(state).serialize(state) == serialized_before
+    assert_column_consistency(state, "original after copy's epoch")
+    assert_column_consistency(checkpoint, "checkpoint after its epoch")
+
+
+@pytest.mark.slow
+def test_copy_on_write_at_flagship_scale():
+    """The 2^21 CoW contract with a peak-RSS guard: snapshotting the
+    flagship state for serving (the HeadStore shape) must not duplicate
+    the ~130 MB of column buffers per copy — four copies' column
+    bundles together must add well under one bundle's worth of RSS,
+    because they are the SAME shared arrays."""
+    N = 1 << 21
+    state, ctx = chain_utils.fast_registry_state(N, "deneb")
+    cols = ops_vector.columns_for(state)
+    bundle = cols.registry_snapshot(state)
+    assert bundle is not None
+    column_bytes = sum(a.nbytes for a in bundle.values())
+    assert column_bytes >= 100 * (1 << 20)  # 100 MiB at 2^21
+
+    def rss_mb() -> float:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+        return 0.0
+
+    copies = [state.copy() for _ in range(4)]
+    before = rss_mb()
+    bundles = []
+    for c in copies:
+        ccols = ops_vector.columns_for(c)
+        b = ccols.registry_snapshot(c)
+        assert b is not None
+        bundles.append(b)
+    grown = rss_mb() - before
+    # shared-base: every copy's bundle views the ORIGINAL buffers
+    for b in bundles:
+        for key, arr in b.items():
+            assert np.shares_memory(arr, bundle[key]), key
+    assert grown < column_bytes / (1 << 20) / 2, (
+        f"4 copies' column bundles grew RSS by {grown:.0f} MB — "
+        "buffers are being copied, not shared"
+    )
+    # post-write isolation still holds at scale
+    copies[0].balances[123] = 9 * 10**9
+    c0 = ops_vector.columns_for(copies[0])
+    refreshed = c0.list_column(copies[0], "balances")
+    assert int(refreshed[123]) == 9 * 10**9
+    assert int(bundle["balances"][123]) != 9 * 10**9
+
+
+# ---------------------------------------------------------------------------
+# kernels: XLA-jittable, bit-identical under jax
+# ---------------------------------------------------------------------------
+
+
+def _kernel_inputs(n=4096, seed=7):
+    rng = np.random.default_rng(seed)
+    return dict(
+        scores=rng.integers(0, 1 << 20, n, dtype=np.uint64),
+        eligible=rng.random(n) < 0.9,
+        participating=rng.random(n) < 0.7,
+        base_reward=rng.integers(0, 1 << 26, n, dtype=np.uint64),
+        unslashed=rng.random(n) < 0.6,
+        balances=rng.integers(0, 1 << 45, n, dtype=np.uint64),
+    )
+
+
+def test_kernels_jittable_bit_identical():
+    """The numeric cores run under jax.numpy inside jax.jit with x64
+    enabled and produce bit-identical uint64 outputs to the numpy path —
+    the XLA route for the device epoch kernel (BASELINE.json north
+    star)."""
+    jax = pytest.importorskip("jax")
+    jax.config.update("jax_enable_x64", True)
+    import functools
+
+    import jax.numpy as jnp
+
+    k = _kernel_inputs()
+    host_scores = epoch_vector.inactivity_scores_kernel(
+        np, k["scores"], k["eligible"], k["participating"], 4, 16, False
+    )
+    host_r, host_p = epoch_vector.flag_deltas_kernel(
+        np, k["base_reward"], k["eligible"], k["unslashed"],
+        14, 2_000, 2_048, 64, False, False,
+    )
+    host_bal = epoch_vector.apply_delta_pairs_kernel(
+        np, k["balances"], [(host_r, host_p)]
+    )
+
+    @functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+    def device(scores, eligible, participating, bias, rec, leaking,
+               weight, u_incr, a_incr, base_reward, unslashed, balances):
+        s = epoch_vector.inactivity_scores_kernel(
+            jnp, scores, eligible, participating, bias, rec, leaking
+        )
+        r, p = epoch_vector.flag_deltas_kernel(
+            jnp, base_reward, eligible, unslashed, weight, u_incr, a_incr,
+            64, leaking, False,
+        )
+        b = epoch_vector.apply_delta_pairs_kernel(jnp, balances, [(r, p)])
+        return s, r, p, b
+
+    dev_scores, dev_r, dev_p, dev_bal = device(
+        jnp.asarray(k["scores"]), jnp.asarray(k["eligible"]),
+        jnp.asarray(k["participating"]), 4, 16, False, 14, 2_000, 2_048,
+        jnp.asarray(k["base_reward"]), jnp.asarray(k["unslashed"]),
+        jnp.asarray(k["balances"]),
+    )
+    assert np.array_equal(np.asarray(dev_scores), host_scores)
+    assert np.array_equal(np.asarray(dev_r), host_r)
+    assert np.array_equal(np.asarray(dev_p), host_p)
+    assert np.array_equal(np.asarray(dev_bal), host_bal)
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: the 2^18 columnar-primary engagement check (make bench-smoke)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.slow
+def test_columnar_primary_engagement_2e18():
+    """One warm deneb epoch at 2^18 (mainnet preset, disk-cached state):
+    the columnar-primary pass must engage at its NATURAL threshold with
+    zero fallbacks, zero column builds (copies share the primed columns
+    copy-on-write) and a sub-second epoch — the bench-smoke tripwire for
+    the 2^21 flagship path."""
+    import time
+
+    N = 1 << 18
+    state, ctx = chain_utils.fast_registry_state(N, "deneb")
+    sp = _slot_processing("deneb")
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    sp.process_slots(state, spe, ctx)
+    state.previous_epoch_participation = [0b111] * N
+    type(state).hash_tree_root(state)
+    cols = ops_vector.columns_for(state)
+    cols.validator_columns(state)
+    for field in ops_vector.RegistryColumns.LIST_FIELDS:
+        cols.list_column(state, field)
+    warmup = state.copy()
+    sp.process_slots(warmup, 2 * spe, ctx)
+    del warmup
+
+    base = metrics.snapshot()
+    s = state.copy()
+    t0 = time.perf_counter()
+    sp.process_slots(s, 2 * spe, ctx)
+    warm_s = time.perf_counter() - t0
+    d = metrics.delta(base)
+    assert d.get("epoch_vector.epochs", 0) == 1
+    assert not any(
+        k.startswith("epoch_vector.fallback.") and v for k, v in d.items()
+    ), {k: v for k, v in d.items() if k.startswith("epoch_vector.fallback.")}
+    assert d.get("ops_vector.columns.builds", 0) == 0
+    assert warm_s < 1.0, f"2^18 warm epoch took {warm_s:.2f}s"

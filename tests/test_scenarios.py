@@ -262,6 +262,38 @@ def test_fault_during_storm_composes():
 
 
 # ---------------------------------------------------------------------------
+# family 6 — electra EIP-7251 churn at the epoch boundary
+# ---------------------------------------------------------------------------
+
+
+def test_eip7251_churn_segment_family():
+    """The full churn surface — ripe/slashed/unripe consolidations,
+    pending deposits, paid partial withdrawals, the 0x01→0x02 switch —
+    through the pipeline with the columnar-primary epoch pass forced:
+    bit-identical to the scalar oracle and column-consistent at every
+    edge (the assertions live in the family)."""
+    out = families.eip7251_churn_segment()
+    assert out["boundaries"] >= 2
+    assert out["pending_deposits_left"] == 0
+    assert out["pending_consolidations_left"] == 1  # the unripe one
+    assert out["pending_partials_left"] == 0
+    assert out["stats"]["rollbacks"] == 0
+
+
+@pytest.mark.slow
+def test_eip7251_churn_segment_natural_threshold():
+    """The same churn segment at 4,096 validators — above
+    EPOCH_VECTOR_MIN_VALIDATORS, so the columnar pass engages at its
+    PRODUCTION threshold (no forced override doing the work)."""
+    from ethereum_consensus_tpu.telemetry import metrics as _metrics
+
+    before = _metrics.counter("epoch_vector.epochs").value()
+    out = families.eip7251_churn_segment(validator_count=4096, epochs=1)
+    assert out["boundaries"] >= 1
+    assert _metrics.counter("epoch_vector.epochs").value() > before
+
+
+# ---------------------------------------------------------------------------
 # chaos smoke (make chaos) + the slow mainnet-scale storm
 # ---------------------------------------------------------------------------
 
@@ -278,6 +310,8 @@ def test_chaos_smoke():
         n_blocks=8, plan={2: bad_proposer_signature, 5: bad_state_root}
     )
     assert [f.index for f in report.failures] == [2, 5]
+    churn = families.eip7251_churn_segment()
+    assert churn["boundaries"] >= 2
 
 
 @pytest.mark.slow

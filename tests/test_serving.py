@@ -37,7 +37,7 @@ from ethereum_consensus_tpu.serving import (  # noqa: E402
     HeadStore,
 )
 from ethereum_consensus_tpu.serving import oracle, views  # noqa: E402
-from ethereum_consensus_tpu.telemetry import metrics  # noqa: E402
+from ethereum_consensus_tpu.telemetry import flight, metrics  # noqa: E402
 from ethereum_consensus_tpu.telemetry.server import (  # noqa: E402
     IntrospectionServer,
 )
@@ -180,7 +180,12 @@ def test_roundtrip_bit_identity(fork, fork_states, served):
 
     # -- duties round-trip --------------------------------------------------
     dependent_root, duties = client.get_attester_duties(epoch, [0, 1, 2, 9])
-    assert dependent_root == snap.root
+    # a REAL block root (PR 8 residue closed): the last block before the
+    # epoch the shuffling depends on, not the state-root placeholder
+    assert dependent_root == oracle.dependent_root(
+        raw, ctx, epoch, "attester", head_root=snap.block_root
+    )
+    assert dependent_root != snap.root
     duty_map = oracle.attester_duty_map(raw, ctx, epoch)
     expect_rows = oracle.attester_duties_data(raw, duty_map, [0, 1, 2, 9])
     assert [
@@ -193,7 +198,10 @@ def test_roundtrip_bit_identity(fork, fork_states, served):
         for r in expect_rows
     ]
     root, proposers = client.get_proposer_duties(epoch)
-    assert root == snap.root
+    assert root == oracle.dependent_root(
+        raw, ctx, epoch, "proposer", head_root=snap.block_root
+    )
+    assert root != snap.root
     assert len(proposers) == int(ctx.SLOTS_PER_EPOCH)
     assert all(
         bytes(raw.validators[d.validator_index].public_key) == d.public_key
@@ -540,3 +548,84 @@ def test_serving_smoke(served):
         urllib.request.urlopen(server.url("/healthz"), timeout=10).read()
     )
     assert health["status"] in ("ok", "degraded")
+
+
+# ---------------------------------------------------------------------------
+# dependent_root + the block-root index (PR 8 residue)
+# ---------------------------------------------------------------------------
+
+
+def test_dependent_root_is_a_real_block_root(served):
+    """Duties responses carry the REAL dependent_root — the root of the
+    last block before the epoch the duty shuffling reads — sourced from
+    the pipeline's flight-lineage claimed block roots, resolvable
+    through the HeadStore's block-root index, and bit-identical to the
+    oracle recomputation from the snapshot state."""
+    store, server = served
+    state, ctx = fresh_genesis(64, "minimal")
+    blocks = produce_chain(state, ctx, 10)
+    store.attach()
+    rec = flight.start()
+    try:
+        ex = Executor(state.copy(), ctx)
+        ex.stream(blocks, policy=FlushPolicy(window_size=3, max_in_flight=2))
+    finally:
+        flight.stop()
+    client = _client(server)
+    snap = store.head
+    raw = snap.raw
+    epoch = int(raw.slot) // int(ctx.SLOTS_PER_EPOCH)
+
+    lineage_block_roots = {
+        bytes.fromhex(r.block_root)
+        for r in rec.records()
+        if r.committed and r.block_root
+    }
+    # the engine's claimed block roots ARE the chain's block roots
+    assert lineage_block_roots == {
+        type(b.message).hash_tree_root(b.message) for b in blocks
+    }
+    # the head snapshot carries its block root and the index resolves it
+    assert snap.block_root in lineage_block_roots
+    assert store.resolve("0x" + snap.block_root.hex()) is snap
+    # ...and the derived (state-only) form agrees with the claimed one
+    assert oracle.head_block_root(raw) == snap.block_root
+
+    for duty, fetch in (
+        ("attester", lambda: client.get_attester_duties(epoch, [0, 1])[0]),
+        ("proposer", lambda: client.get_proposer_duties(epoch)[0]),
+    ):
+        served_root = fetch()
+        expect = oracle.dependent_root(
+            raw, ctx, epoch, duty, head_root=snap.block_root
+        )
+        assert served_root == expect, (duty, served_root.hex())
+        assert served_root != snap.root, "state-root placeholder returned"
+        # the dependent slot is inside the replayed chain, so the root
+        # must be one of the lineage's claimed block roots
+        assert served_root in lineage_block_roots, duty
+        # spec form: the block root AT the dependent slot
+        spe = int(ctx.SLOTS_PER_EPOCH)
+        dep_slot = (epoch if duty == "proposer" else epoch - 1) * spe - 1
+        if 0 <= dep_slot < int(raw.slot):
+            from ethereum_consensus_tpu.models.phase0.helpers import (
+                get_block_root_at_slot,
+            )
+
+            assert served_root == get_block_root_at_slot(raw, dep_slot)
+
+
+def test_dependent_root_head_and_genesis_edges(served):
+    """Dependent slots at or past the head resolve to the head block
+    root; pre-genesis dependent slots resolve to the genesis block
+    root — both derived purely from the snapshot state."""
+    store, server = served
+    state, ctx = fresh_genesis(64, "minimal")
+    store.attach()
+    snap = store.publish(state.copy(), ctx)  # slot-0 snapshot
+    raw = snap.raw
+    # epoch 0, attester: dependent slot is pre-genesis → genesis block root
+    dep = oracle.dependent_root(raw, ctx, 0, "attester")
+    assert dep == oracle.head_block_root(raw) == snap.block_root
+    # pipeline-less publishes still land in the block-root index
+    assert store.resolve("0x" + snap.block_root.hex()) is snap

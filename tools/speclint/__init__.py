@@ -72,9 +72,13 @@ def _default_targets(root: str) -> dict:
             os.path.join(root, _PKG, "telemetry"),
             os.path.join(root, _PKG, "crypto", "bls.py"),
             os.path.join(root, _PKG, "utils", "trace.py"),
-            # the columnar engine keeps process-wide state (one-shot
+            # the columnar engines keep process-wide state (one-shot
             # fallback events, the preparer registry) — lock-checked
             os.path.join(root, _PKG, "models", "ops_vector.py"),
+            # the columnar-primary epoch engine's write path: adopted
+            # arrays become shared column caches, and its fallback
+            # one-shot set mirrors ops_vector's
+            os.path.join(root, _PKG, "models", "epoch_vector.py"),
             # the scenario harness drives the pipeline from test/driver
             # threads while the FaultInjector is read on the worker
             os.path.join(root, _PKG, "scenarios"),
