@@ -2,7 +2,9 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos pipeline-selfcheck trace metrics serve serve-data server-smoke serving-smoke
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
+
+PROFILE_DIR ?= profile_artifacts
 
 help:  ## list targets
 	@grep -E '^[a-z][a-zA-Z_-]*:.*##' $(MAKEFILE_LIST) | awk -F':.*## ' '{printf "  %-20s %s\n", $$1, $$2}'
@@ -50,6 +52,11 @@ trace:  ## record a pipeline run as Chrome trace JSON (open in Perfetto)
 metrics:  ## dump the telemetry metrics registry after a pipeline run
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --metrics-out metrics.json
 	@cat metrics.json
+
+profile:  ## one-command capture artifact: selfcheck with Chrome trace + metrics snapshot + device ledger in $(PROFILE_DIR)/ (the TPU_CAPTURE_PLAN command; on a chip, run without JAX_PLATFORMS=cpu)
+	mkdir -p $(PROFILE_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --trace-out $(PROFILE_DIR)/trace.json --metrics-out $(PROFILE_DIR)/metrics.json --device-out $(PROFILE_DIR)/device.json
+	@echo "capture artifact in $(PROFILE_DIR)/: trace.json (Perfetto), metrics.json, device.json"
 
 serve:  ## pipeline selfcheck with the live introspection server up (held 30s: curl /metrics /healthz /blocks /events)
 	JAX_PLATFORMS=cpu $(PY) -m ethereum_consensus_tpu.pipeline --selfcheck --serve 8799 --hold 30

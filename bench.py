@@ -51,9 +51,14 @@ Telemetry (docs/OBSERVABILITY.md): every config's result carries a
 ``metrics`` block — registry counter deltas (SSZ digests, pubkey-cache
 hit rate, bulk-decompress and pairing-route counts, flush shape) — and
 the per-block configs attribute their ``phases`` from the transition's
-own telemetry spans. ``--trace-out PATH`` records the whole child run
-as Chrome trace JSON; ``--metrics-out PATH`` dumps the final registry
-snapshot.
+own telemetry spans — plus a ``device`` block (ISSUE 10): compiles /
+recompile-sentinel count / transfer bytes / routing-journal tallies /
+jit-cache hits, cross-checked against the observatory's own ledgers
+(``journal_consistent``, folded into ``ok`` for ``pipeline_blocks`` and
+the epoch configs). ``--trace-out PATH`` records the whole child run
+as Chrome trace JSON (device lane included); ``--metrics-out PATH``
+dumps the final registry snapshot; ``--device-out PATH`` the device
+observatory's ledgers.
 
 Prints ONE COMPACT JSON line as the last stdout line (small enough for
 any log-tail window — round 4's full dump truncated mid-object and the
@@ -91,6 +96,7 @@ PROGRESS_ENV = "EC_BENCH_PROGRESS"
 DEGRADED_ENV = "EC_BENCH_DEGRADED"
 TRACE_OUT_ENV = "EC_BENCH_TRACE_OUT"      # --trace-out (child records spans)
 METRICS_OUT_ENV = "EC_BENCH_METRICS_OUT"  # --metrics-out (registry snapshot)
+DEVICE_OUT_ENV = "EC_BENCH_DEVICE_OUT"    # --device-out (observatory ledgers)
 SERVE_PORT_ENV = "EC_BENCH_SERVE_PORT"    # --serve-port (introspection server)
 
 PROBE_TIMEOUT_S = 150       # TPU init is ~20-40s healthy; a hang never ends
@@ -162,21 +168,25 @@ def bench_native_single_core(chunks: bytes, depth: int):
 
 def bench_htr():
     import jax
-    import jax.numpy as jnp
 
     from ethereum_consensus_tpu.ops.merkle import zero_hash_words
 
     log2 = 12 if _fast_test() else LOG2_LEAVES - (3 if _degraded() else 0)
     n = 1 << log2
     reps = 2 if _fast_test() else (3 if _degraded() else DEVICE_REPS)
+    from ethereum_consensus_tpu.telemetry import device as tel_device
+
     rng = np.random.default_rng(42)
     chunks = rng.integers(0, 256, size=n * 32, dtype=np.uint8).tobytes()
-    words = jnp.asarray(
+    # through the observatory's h2d seam: the headline config's upload
+    # volume lands in the transfer ledger on a chip capture
+    words, zero_words = tel_device.h2d(
+        "bench.htr",
         np.ascontiguousarray(
             np.frombuffer(chunks, dtype=">u4").astype(np.uint32).reshape(n, 8).T
-        )
+        ),
+        zero_hash_words(),
     )
-    zero_words = jnp.asarray(zero_hash_words())
 
     device_s, device_root = bench_device(words, zero_words, log2, reps)
     host_s, host_root, host_kind = bench_native_single_core(chunks, log2)
@@ -1716,6 +1726,97 @@ def _child_elapsed() -> float:
     return 0.0 if _CHILD_T0 is None else time.monotonic() - _CHILD_T0
 
 
+def _obs_tallies() -> dict:
+    """A flat snapshot of the device observatory's own ledgers (NOT the
+    metrics registry) — the cross-structure side of the per-config
+    consistency check in ``_device_block``."""
+    from ethereum_consensus_tpu.telemetry import device as tel_device
+
+    obs = tel_device.OBSERVATORY
+    compiles = obs.compiles()
+    totals = obs.transfer_summary()["totals"]
+    routes: dict = {}
+    for kind, choices in obs.route_tallies().items():
+        for choice, count in choices.items():
+            routes[f"{kind}.{choice}"] = count
+    return {
+        "compiles": len(compiles),
+        "recompiles": sum(1 for c in compiles if c["recompile"]),
+        "transfers": dict(totals),
+        "routes": routes,
+    }
+
+
+# configs whose ``ok`` additionally requires the device evidence to be
+# self-consistent (metrics-registry deltas == observatory-journal deltas):
+# the device-routed measures the TPU_CAPTURE_PLAN brings home — on this
+# CPU-only box the same machinery runs against the host JAX backend with
+# all-host route tallies, so the check stays tier-1-testable
+DEVICE_OK_CONFIGS = ("pipeline_blocks", "epoch_deneb", "epoch_electra",
+                     "epoch_mainnet")
+
+
+def _device_block(metrics_before: dict, obs_before: dict) -> dict:
+    """Per-config device-execution evidence (ISSUE 10): compiles,
+    recompile count, transfer bytes, routing-journal tallies, jit-cache
+    hits/misses — with a ``journal_consistent`` cross-check that the
+    metrics-registry deltas and the observatory's own ledgers tell the
+    same story (two independently-written structures; a guard drift or
+    a half-active observatory shows up here as False)."""
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    d = tel_metrics.delta(metrics_before)
+    now = _obs_tallies()
+    compile_hist = d.get("device.compile_s")
+    routes = {
+        key: now["routes"].get(key, 0) - obs_before["routes"].get(key, 0)
+        for key in set(now["routes"]) | set(obs_before["routes"])
+    }
+    routes = {key: count for key, count in routes.items() if count}
+    transfers = {
+        key: now["transfers"][key] - obs_before["transfers"].get(key, 0)
+        for key in now["transfers"]
+    }
+    block = {
+        "compiles": d.get("device.compiles", 0),
+        "recompiles": d.get("device.recompiles", 0),
+        "compile_s": (
+            compile_hist.get("sum", 0.0)
+            if isinstance(compile_hist, dict)
+            else 0.0
+        ),
+        "jit_cache_hits": d.get("device.jit_cache.hits", 0),
+        "jit_cache_misses": d.get("device.jit_cache.misses", 0),
+        "h2d_count": d.get("device.transfer.h2d_count", 0),
+        "h2d_bytes": d.get("device.transfer.h2d_bytes", 0),
+        "d2h_count": d.get("device.transfer.d2h_count", 0),
+        "d2h_bytes": d.get("device.transfer.d2h_bytes", 0),
+        "routes": routes,
+        "route_device": sum(
+            count for key, count in routes.items()
+            if key.endswith(".device") or key.endswith(".columnar")
+        ),
+        "route_host": sum(
+            count for key, count in routes.items()
+            if key.endswith(".host") or key.endswith(".literal")
+            or key.endswith(".scalar")
+        ),
+    }
+    counter_routes: dict = {}
+    for key, value in d.items():
+        if key.startswith("device.route.") and value:
+            counter_routes[key[len("device.route."):]] = value
+    block["journal_consistent"] = bool(
+        counter_routes == routes
+        and block["compiles"] == now["compiles"] - obs_before["compiles"]
+        and block["recompiles"]
+        == now["recompiles"] - obs_before["recompiles"]
+        and block["h2d_bytes"] == transfers["h2d_bytes"]
+        and block["d2h_bytes"] == transfers["d2h_bytes"]
+    )
+    return block
+
+
 def _metrics_block(before: dict) -> dict:
     """Per-config delta of the telemetry registry: the WORK a config did
     (digests, cache traffic, pairing routes, flush shape), not just its
@@ -1786,6 +1887,7 @@ def _metrics_block(before: dict) -> dict:
 
 def child_main() -> None:
     global _CHILD_T0
+    from ethereum_consensus_tpu.telemetry import device as tel_device
     from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
     from ethereum_consensus_tpu.telemetry import spans as tel_spans
     from ethereum_consensus_tpu.utils import trace
@@ -1797,6 +1899,10 @@ def child_main() -> None:
     trace_out = os.environ.get(TRACE_OUT_ENV)
     if trace_out:
         tel_spans.start_recording(capacity=1 << 18)
+    # the device observatory runs for the whole battery: per-config
+    # ``device`` evidence blocks + the BENCH_FULL device ledger; its
+    # per-event cost is microseconds against kernel-scale work
+    tel_device.start()
     server = None
     serve_port = os.environ.get(SERVE_PORT_ENV)
     if serve_port:
@@ -1831,6 +1937,7 @@ def child_main() -> None:
             continue
         _note(f"config {name} starting ({elapsed:.0f}s elapsed)")
         metrics_base = tel_metrics.snapshot()
+        obs_base = _obs_tallies()
         t0 = time.monotonic()
         try:
             with trace.span("bench." + name):
@@ -1839,6 +1946,12 @@ def child_main() -> None:
             out = {"error": f"{type(exc).__name__}: {str(exc)[:200]}"}
         out["wall_s"] = round(time.monotonic() - t0, 2)
         out["metrics"] = _metrics_block(metrics_base)
+        out["device"] = _device_block(metrics_base, obs_base)
+        if name in DEVICE_OK_CONFIGS and "ok" in out:
+            # the device evidence is part of these configs' acceptance:
+            # route tallies / transfer bytes / recompile counts must
+            # agree between the metrics registry and the observatory
+            out["ok"] = bool(out["ok"]) and out["device"]["journal_consistent"]
         results[name] = out
         checkpoint()
         _note(f"config {name} done in {out['wall_s']}s")
@@ -1856,6 +1969,9 @@ def child_main() -> None:
     # can surface them in the full dump even though the registry lives
     # in this child process
     results["process_metrics"] = tel_metrics.snapshot()
+    # the whole run's device ledgers ride along the same way (compile
+    # census, per-site transfer bytes, routing-journal tallies)
+    results["device_ledger"] = tel_device.snapshot(journal_n=64)
     checkpoint()
     if trace_out:
         tel_spans.stop_recording()
@@ -1866,6 +1982,11 @@ def child_main() -> None:
         with open(metrics_out, "w") as f:
             json.dump(tel_metrics.snapshot(), f, indent=1, sort_keys=True)
         _note(f"metrics snapshot written: {metrics_out}")
+    device_out = os.environ.get(DEVICE_OUT_ENV)
+    if device_out:
+        with open(device_out, "w") as f:
+            json.dump(tel_device.snapshot(), f, indent=1, sort_keys=True)
+        _note(f"device ledger written: {device_out}")
     if server is not None:
         server.stop()
 
@@ -1952,6 +2073,7 @@ def main() -> None:
     for flag, env_key in (
         ("--trace-out", TRACE_OUT_ENV),
         ("--metrics-out", METRICS_OUT_ENV),
+        ("--device-out", DEVICE_OUT_ENV),
     ):
         if flag in argv:
             at = argv.index(flag)
@@ -1981,7 +2103,8 @@ def main() -> None:
         env = cpu_mesh_env(1, repo_root=REPO)
         env[DEGRADED_ENV] = note
         for env_key in (
-            TRACE_OUT_ENV, METRICS_OUT_ENV, SERVE_PORT_ENV, "EC_BENCH_ONLY",
+            TRACE_OUT_ENV, METRICS_OUT_ENV, DEVICE_OUT_ENV, SERVE_PORT_ENV,
+            "EC_BENCH_ONLY",
         ):
             if os.environ.get(env_key):  # survive the hermetic scrub
                 env[env_key] = os.environ[env_key]
@@ -2021,6 +2144,7 @@ def main() -> None:
         return obj
 
     process_metrics = configs.pop("process_metrics", None)
+    device_ledger = configs.pop("device_ledger", None)
     htr = configs.pop("htr", None) or {}
     value = vs = 0.0
     error = None
@@ -2080,6 +2204,7 @@ def main() -> None:
             "backend_probe_transcript": probe_transcript,
             "degraded": None if healthy else f"cpu fallback: {note}",
             "metrics": process_metrics,
+            "device_ledger": device_ledger,
             "configs": configs,
         }
     )

@@ -24,7 +24,10 @@ when any seconds-like metric regressed beyond the gate (CI-friendly).
 Trend mode (``--trend``) renders the per-phase seconds of every config
 across the given evidence files (column label = the ``rNN`` tail of the
 filename) as a markdown table — the PR-over-PR trajectory the ROADMAP
-quotes, generated instead of hand-maintained.
+quotes, generated instead of hand-maintained. Driver-wrapper files with
+no per-config payload (r01–r05 are failed-run shells of ``{n, cmd, rc,
+tail}``) render as one explicit ``skipped`` line per document instead
+of a wall of ``–`` cells in every table.
 """
 
 from __future__ import annotations
@@ -58,6 +61,15 @@ _TREND_HEADLINE = (
     "single_validator_qps",
     "batch_1k_qps",
     "committee_slot_qps",
+    # the device observatory's evidence axes (ISSUE 10): compile seconds
+    # and counts, the recompile sentinel, transfer volume, route split
+    "device.compile_s",
+    "device.compiles",
+    "device.recompiles",
+    "device.h2d_bytes",
+    "device.d2h_bytes",
+    "device.route_device",
+    "device.route_host",
 )
 
 
@@ -140,14 +152,33 @@ def _trend_keys(leaves: dict) -> list:
     return keys
 
 
+def _is_run_wrapper(doc: dict) -> bool:
+    """A driver-wrapper shell with no per-config evidence payload (the
+    r01–r05 shape: ``{n, cmd, rc, tail[, parsed]}``) — the whole run is
+    rendered as ``skipped`` instead of per-metric ``–`` walls."""
+    if not isinstance(doc, dict):
+        return True
+    configs = _configs(doc)
+    if configs is doc and {"cmd", "rc", "tail"} <= set(doc):
+        return True
+    return not any(isinstance(v, dict) for v in configs.values())
+
+
 def trend(paths: "list[str]") -> str:
     """One markdown document: per config, a table of phase (and
     headline) seconds across the given evidence files, oldest column
-    first (the given order)."""
+    first (the given order). Files that are failed-run wrappers are
+    listed once as ``skipped`` and excluded from the table columns."""
     docs = []
+    skipped = []
     for path in paths:
         with open(path) as f:
-            docs.append((_trend_label(path), _configs(json.load(f))))
+            doc = json.load(f)
+        label = _trend_label(path)
+        if _is_run_wrapper(doc):
+            skipped.append(label)
+        else:
+            docs.append((label, _configs(doc)))
     config_names: list = []
     for _, configs in docs:
         for name in configs:
@@ -159,6 +190,15 @@ def trend(paths: "list[str]") -> str:
         "or metric is absent in that file (config not yet landed, or "
         "skipped)."
     )
+    if skipped:
+        lines.append("")
+        lines.append("| run | status |")
+        lines.append("|---|---|")
+        for label in skipped:
+            lines.append(
+                f"| {label} | skipped — failed-run wrapper "
+                "(no per-config payload) |"
+            )
     for name in config_names:
         per_file = [
             (label, _numeric_leaves(configs.get(name, {})))

@@ -8,9 +8,17 @@ are set by ``ops.install()`` (and unset by ``ops.uninstall()``).
 Thresholds are minimum element counts: device sweeps/shuffles win only
 above a size where kernel launch + host<->device packing amortizes; below
 the threshold the spec functions keep their host path.
+
+These predicates are ALSO the routing journal's primary source
+(telemetry/device.py): every consult is a device-vs-host decision, so
+while the device observatory is active each one is journaled with its
+threshold inputs — the gate functions pre-guard on the observatory's
+``active`` bool, keeping the off path at one extra read.
 """
 
 from __future__ import annotations
+
+from .telemetry import device as _device_obs
 
 SWEEPS_MIN_N: int | None = None
 SHUFFLE_MIN_N: int | None = None
@@ -18,27 +26,59 @@ BLS_AGG_MIN_N: int | None = None
 PAIRING_MIN_SETS: int | None = None
 
 
+def _journal(kind: str, routed: bool, n: int, threshold: "int | None") -> None:
+    _device_obs.route(
+        kind,
+        "device" if routed else "host",
+        reason=(
+            "routed"
+            if routed
+            else ("not_installed" if threshold is None else "below_threshold")
+        ),
+        n=n,
+        threshold=threshold,
+    )
+
+
 def sweeps_enabled(n: int) -> bool:
     """Route registry sweeps (flag deltas, inactivity, hysteresis) to
     device for an ``n``-validator registry?"""
-    return SWEEPS_MIN_N is not None and n >= SWEEPS_MIN_N
+    routed = SWEEPS_MIN_N is not None and n >= SWEEPS_MIN_N
+    if _device_obs.OBSERVATORY.active:
+        _journal("sweeps", routed, n, SWEEPS_MIN_N)
+    return routed
 
 
 def shuffle_enabled(n: int) -> bool:
     """Route committee shuffling to the device whole-list kernel for an
     ``n``-element index list?"""
-    return SHUFFLE_MIN_N is not None and n >= SHUFFLE_MIN_N
+    routed = SHUFFLE_MIN_N is not None and n >= SHUFFLE_MIN_N
+    if _device_obs.OBSERVATORY.active:
+        _journal("shuffle", routed, n, SHUFFLE_MIN_N)
+    return routed
 
 
 def bls_agg_enabled(n: int) -> bool:
     """Route G1 pubkey aggregation to the device limb kernels for an
     ``n``-point batch? (Below the threshold the native C++ adds win —
     the device fold is latency-bound, not work-bound.)"""
-    return BLS_AGG_MIN_N is not None and n >= BLS_AGG_MIN_N
+    routed = BLS_AGG_MIN_N is not None and n >= BLS_AGG_MIN_N
+    if _device_obs.OBSERVATORY.active:
+        _journal("bls_agg", routed, n, BLS_AGG_MIN_N)
+    return routed
 
 
 def pairing_enabled(n_sets: int) -> bool:
     """Route the RLC batch verification (blinder mults + Miller loops +
     Fq12 product) to the device pairing kernels for an ``n_sets``
-    batch? The native multi-pairing wins below the threshold."""
-    return PAIRING_MIN_SETS is not None and n_sets >= PAIRING_MIN_SETS
+    batch? The native multi-pairing wins below the threshold.
+
+    NOTE: the definitive pairing-route journal entry (device attempt
+    succeeded / fell back to host) is written by ``crypto/bls.py`` at
+    the verdict site — this gate only journals the threshold decision
+    for batches it declines, so the two don't double-count routed
+    batches."""
+    routed = PAIRING_MIN_SETS is not None and n_sets >= PAIRING_MIN_SETS
+    if not routed and _device_obs.OBSERVATORY.active:
+        _journal("pairing_gate", routed, n_sets, PAIRING_MIN_SETS)
+    return routed

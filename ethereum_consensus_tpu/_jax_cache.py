@@ -30,3 +30,19 @@ def enable(cache_dir: str | None = None) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _ENABLED = True
+
+
+def status() -> dict:
+    """Persistent-compile-cache state for the device observatory's
+    ``/device`` document (telemetry/device.py): whether the on-disk XLA
+    cache is wired up, where it lives, and how many compiled entries it
+    holds right now. Never imports jax."""
+    cache_dir = os.environ.get("EC_JAX_CACHE_DIR", _DEFAULT_DIR)
+    entries = None
+    try:
+        entries = sum(
+            1 for name in os.listdir(cache_dir) if not name.startswith(".")
+        )
+    except OSError:
+        pass
+    return {"enabled": _ENABLED, "dir": cache_dir, "entries": entries}

@@ -35,6 +35,7 @@ from ..error import (
     InvalidSignatureError,
 )
 from ..native import bls as native_bls
+from ..telemetry import device as _device_obs
 from ..telemetry import metrics as _metrics
 from ..utils import trace
 from .curves import (
@@ -185,6 +186,35 @@ _WARM_CALLS = _metrics.counter("bls.warm_raw_keys.calls")
 _WARM_KEYS = _metrics.counter("bls.warm_raw_keys.keys")
 _ROUTE_DEVICE = _metrics.counter("bls.pairing_route.device")
 _ROUTE_HOST = _metrics.counter("bls.pairing_route.host")
+
+# which route proved the most recent batched verification on THIS thread
+# ("device" / "host" / None before any batch) — the flight recorder's
+# per-flush-window verify_route source (pipeline/scheduler.py stamps it
+# onto the window right after the worker's verify returns; the verifier
+# is a single thread, so thread-locality is exactly window-locality)
+_ROUTE_TL = threading.local()
+
+
+def _note_pairing_route(choice: str, reason: str, n_sets: int) -> None:
+    """Record one batch verification's route: the thread-local stamp
+    (always — two writes), and the device observatory's routing journal
+    with the threshold inputs (only while observing)."""
+    _ROUTE_TL.route = choice
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route(
+            "pairing",
+            choice,
+            reason,
+            sets=n_sets,
+            threshold=_device_flags.PAIRING_MIN_SETS,
+        )
+
+
+def last_batch_route() -> "str | None":
+    """The route ("device"/"host") of the newest batched verification
+    on the calling thread, or None if none ran (short batches and the
+    per-set fallback verify host-side without the RLC batch)."""
+    return getattr(_ROUTE_TL, "route", None)
 
 
 def _pk_cache_put(data: bytes, raw: bytes) -> None:
@@ -719,15 +749,31 @@ def _batch_all_valid(sets: list[SignatureSet], dst: bytes) -> bool:
             if any(s):
                 break
         scalars.append(s)
+    device_declined = False
     if _device_flags.pairing_enabled(len(sets)):
         verdict = _batch_device_pairing(sets, dst, scalars)
         if verdict is not None:
             _ROUTE_DEVICE.inc()
+            _note_pairing_route("device", "routed", len(sets))
             return verdict
+        device_declined = True
     # raw-affine pubkeys: decompressed once per key (cached on the
     # PublicKey — subgroup-checked at parse time), so repeat verifiers
     # (the same validators every block) never pay the sqrt again
     _ROUTE_HOST.inc()
+    _note_pairing_route(
+        "host",
+        (
+            "device_unusable"
+            if device_declined
+            else (
+                "not_installed"
+                if _device_flags.PAIRING_MIN_SETS is None
+                else "below_threshold"
+            )
+        ),
+        len(sets),
+    )
     return native_bls.batch_verify_raw(
         [([pk.raw_uncompressed() for pk in s.public_keys], s.message,
           s.signature.to_bytes()) for s in sets],
@@ -813,6 +859,10 @@ def verify_signature_sets(
     batch with probability <= 2^-128."""
     if not sets:
         return []
+    # each batched verification re-stamps the thread-local route below;
+    # clearing first means "no RLC batch ran" is distinguishable (the
+    # single-set and blame-attribution paths verify host-side per set)
+    _ROUTE_TL.route = None
     if _native() and len(sets) > 1 and _batch_all_valid(sets, dst):
         return [True] * len(sets)
     return [s.verify(dst) for s in sets]
@@ -849,7 +899,8 @@ def _verify_pool():
 
 
 def verify_signature_sets_async(
-    sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None, pre=None
+    sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None, pre=None,
+    route_sink=None,
 ):
     """Dispatch one batched verification to the background verifier thread;
     returns a ``concurrent.futures.Future[list[bool]]``.
@@ -862,7 +913,10 @@ def verify_signature_sets_async(
     the pipeline's stage-occupancy probe. ``pre``, if given, runs on the
     worker immediately before verification (the pipeline's fault-injection
     seam, pipeline/faults.py); anything it raises surfaces through the
-    future exactly as a real worker fault would."""
+    future exactly as a real worker fault would. ``route_sink``, if
+    given, is called on the worker after verification with the batch's
+    pairing route ("device"/"host"/None — ``last_batch_route``), the
+    flight recorder's per-window verify_route feed."""
     sets = list(sets)
 
     def run() -> list[bool]:
@@ -875,7 +929,10 @@ def verify_signature_sets_async(
             # the span lands on the verifier thread's lane, so a recorded
             # pipeline run shows stage B as its own Perfetto track
             with trace.span("pipeline.flush.verify", sets=len(sets)):
-                return verify_signature_sets(sets, dst)
+                verdicts = verify_signature_sets(sets, dst)
+            if route_sink is not None:
+                route_sink(last_batch_route())
+            return verdicts
         finally:
             if timer is not None:
                 timer(_time.perf_counter() - t0)

@@ -62,6 +62,7 @@ import threading
 
 from .. import _device_flags
 from ..primitives import FAR_FUTURE_EPOCH, GENESIS_EPOCH
+from ..telemetry import device as _device_obs
 from ..telemetry import metrics
 from ..utils import trace
 from . import ops_vector
@@ -71,6 +72,7 @@ __all__ = [
     "inactivity_scores_kernel",
     "flag_deltas_kernel",
     "apply_delta_pairs_kernel",
+    "jitted_kernels",
     "EPOCH_VECTOR_MIN_VALIDATORS",
 ]
 
@@ -100,15 +102,73 @@ def _np():
         return None
 
 
-def fallback(reason: str) -> None:
+def fallback(reason: str, **inputs) -> None:
     """Count a decline to the literal epoch path (trace event once per
-    reason per process, mirroring ops_vector.fallback)."""
+    reason per process, mirroring ops_vector.fallback). EVERY decline
+    path runs through here — including the deliberate ones
+    (``below_threshold``, ``device_sweeps``) that used to be silent
+    outside the bench harness: a production-threshold decline is a
+    routing decision worth seeing. While the device observatory is on,
+    the decline also lands in its routing journal with the threshold
+    inputs (telemetry/device.py)."""
     metrics.counter(f"epoch_vector.fallback.{reason}").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route("epoch_vector", "literal", reason, **inputs)
     if reason not in _FALLBACK_SEEN:
         with _FALLBACK_LOCK:
             if reason not in _FALLBACK_SEEN:
                 _FALLBACK_SEEN.add(reason)
                 trace.event("epoch_vector.fallback", reason=reason)
+
+
+_JITTED_KERNELS = {}
+_JITTED_KERNELS_LOCK = threading.Lock()
+
+
+def jitted_kernels() -> dict:
+    """The three numeric cores bound to ``jax.numpy``, jitted, and
+    wrapped through the device observatory's compile ledger
+    (telemetry/device.py ``observe_jit``) — the XLA route for the device
+    epoch kernel (the ROADMAP's "put the kernels on the chip" residue).
+    Production host passes keep the numpy ``xp``; this surface exists so
+    the device route, its compile/recompile telemetry, and the
+    jit-identity tests all exercise the SAME wrapped callables. Returns
+    ``{"inactivity_scores": fn, "flag_deltas": fn, "apply_delta_pairs":
+    fn}``; built once per process."""
+    if _JITTED_KERNELS:
+        return _JITTED_KERNELS
+    with _JITTED_KERNELS_LOCK:
+        if _JITTED_KERNELS:
+            return _JITTED_KERNELS
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        jax.config.update("jax_enable_x64", True)
+        built = {
+            "inactivity_scores": _device_obs.observe_jit(
+                jax.jit(
+                    functools.partial(inactivity_scores_kernel, jnp),
+                    static_argnums=(3, 4, 5),  # bias, recovery, leaking
+                ),
+                "epoch_vector.inactivity_scores_kernel",
+            ),
+            "flag_deltas": _device_obs.observe_jit(
+                jax.jit(
+                    functools.partial(flag_deltas_kernel, jnp),
+                    # weight, increments, denominator, leaking, head flag
+                    static_argnums=(3, 4, 5, 6, 7, 8),
+                ),
+                "epoch_vector.flag_deltas_kernel",
+            ),
+            "apply_delta_pairs": _device_obs.observe_jit(
+                jax.jit(functools.partial(apply_delta_pairs_kernel, jnp)),
+                "epoch_vector.apply_delta_pairs_kernel",
+            ),
+        }
+        _JITTED_KERNELS.update(built)
+    return _JITTED_KERNELS
 
 
 def _disabled() -> bool:
@@ -1042,14 +1102,28 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
     engine declines — the caller then runs its literal stage list."""
     n = len(state.validators)
     if n < EPOCH_VECTOR_MIN_VALIDATORS:
-        return False  # deliberate cost threshold, not a degradation
+        # a deliberate cost threshold, not a degradation — but still a
+        # routing decision: counted + one-shot-evented like every other
+        # decline so a production-size miss is visible outside the bench
+        fallback(
+            "below_threshold",
+            validators=n,
+            threshold=EPOCH_VECTOR_MIN_VALIDATORS,
+        )
+        return False
     if _disabled():
-        fallback("disabled")
+        fallback("disabled", validators=n)
         return False
     if _device_flags.sweeps_enabled(n):
-        return False  # the installed device sweeps keep their routing
+        # the installed device sweeps keep their routing
+        fallback(
+            "device_sweeps",
+            validators=n,
+            sweeps_min_n=_device_flags.SWEEPS_MIN_N,
+        )
+        return False
     if _np() is None:
-        fallback("no_numpy")
+        fallback("no_numpy", validators=n)
         return False
     try:
         from .altair.constants import TIMELY_TARGET_FLAG_INDEX
@@ -1062,6 +1136,12 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
     if ec is None:
         return False
     cfg = ec.cfg
+    if _device_obs.OBSERVATORY.active:
+        # every guard passed: the engage decision, journaled next to the
+        # declines so the /device routing journal tells the whole story
+        _device_obs.route(
+            "epoch_vector", "columnar", "engaged", validators=n, fork=fork
+        )
     with trace.span("epoch_vector.pass", fork=fork, validators=n):
         try:
             with trace.span("epoch_vector.justification"):

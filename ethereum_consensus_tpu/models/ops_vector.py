@@ -55,6 +55,7 @@ import os
 import threading
 
 from ..ssz.core import CachedRootList, bulk_store
+from ..telemetry import device as _device_obs
 from ..telemetry import metrics
 from ..utils import trace
 
@@ -100,8 +101,11 @@ _FALLBACK_LOCK = threading.Lock()
 
 def fallback(reason: str) -> None:
     """Record a degradation to a scalar path: counter per occurrence,
-    trace event once per reason per process."""
+    trace event once per reason per process (plus a routing-journal
+    entry while the device observatory is on)."""
     metrics.counter(f"ops_vector.fallback.{reason}").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route("ops_vector", "scalar", reason)
     if reason not in _FALLBACK_SEEN:
         with _FALLBACK_LOCK:
             if reason not in _FALLBACK_SEEN:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import device as _obs
 from . import fq
 
 __all__ = [
@@ -134,6 +135,9 @@ def _tree_reduce(points, levels: int):
 _SEGMENT = 256  # phase-1 fold width for large batches
 
 
+_tree_reduce = _obs.observe_jit(_tree_reduce, "ops.g1._tree_reduce")
+
+
 def sum_points(points) -> jax.Array:
     """Sum an (N, 3, 24) batch of Jacobian points on device; returns the
     (3, 24) Jacobian sum. Pads to a power of two with infinity.
@@ -172,6 +176,11 @@ def _tree_reduce_segmented(points, levels: int):
     return jax.lax.fori_loop(0, levels, level, points)[:, 0]
 
 
+_tree_reduce_segmented = _obs.observe_jit(
+    _tree_reduce_segmented, "ops.g1._tree_reduce_segmented"
+)
+
+
 def sum_points_segmented(points) -> jax.Array:
     """(S, B, 3, 24) → (S, 3, 24): S independent B-point sums on device.
     Pads B to a power of two with infinity."""
@@ -204,7 +213,7 @@ def points_from_raw(raws: "list[bytes]") -> jax.Array:
     limbs[:, 1] = words[:, 24:][:, ::-1]
     live = (limbs[:, 0].any(axis=1)) | (limbs[:, 1].any(axis=1))
     limbs[:, 2, 0] = live  # Z=1 for live points, 0 (infinity) otherwise
-    dev = jnp.asarray(limbs)
+    dev = _obs.h2d("ops.g1.points_from_raw", limbs)
     # one batched to-Montgomery pass over all coordinates
     return fq.to_mont(dev.reshape(n * 3, fq.LIMBS)).reshape(n, 3, fq.LIMBS)
 
@@ -227,7 +236,9 @@ def _canonical_jacobian_to_raw(row) -> "tuple[bytes, bool]":
 
 def point_to_raw(point) -> "tuple[bytes, bool]":
     """(3, 24) Montgomery Jacobian point → (affine raw96, is_infinity)."""
-    return _canonical_jacobian_to_raw(np.asarray(fq.from_mont(point)))
+    return _canonical_jacobian_to_raw(
+        _obs.d2h("ops.g1.point_to_raw", fq.from_mont(point))
+    )
 
 
 def aggregate_pubkeys_device(raws: "list[bytes]") -> "tuple[bytes, bool]":

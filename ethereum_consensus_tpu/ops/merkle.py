@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ssz.merkle import BYTES_PER_CHUNK, next_pow_of_two, zero_hash
+from ..telemetry import device as _obs
 from .sha256 import sha256_64b
 
 __all__ = [
@@ -74,6 +75,11 @@ def merkle_root_words(nodes: jax.Array, zero_words: jax.Array, depth: int) -> ja
     return reduce_levels(nodes, zero_words, depth)
 
 
+merkle_root_words = _obs.observe_jit(
+    merkle_root_words, "ops.merkle.merkle_root_words"
+)
+
+
 def merkleize_chunks_device(chunks: bytes, limit: int | None = None) -> bytes:
     """Drop-in device equivalent of ssz.merkle.merkleize_chunks.
 
@@ -95,7 +101,8 @@ def merkleize_chunks_device(chunks: bytes, limit: int | None = None) -> bytes:
     words = np.ascontiguousarray(
         np.frombuffer(chunks, dtype=">u4").astype(np.uint32).reshape(count, 8).T
     )
-    root = merkle_root_words(
-        jnp.asarray(words), jnp.asarray(zero_hash_words()), depth
+    words_d, zero_d = _obs.h2d(
+        "ops.merkle.merkleize_chunks", words, zero_hash_words()
     )
-    return np.asarray(root).astype(">u4").tobytes()
+    root = merkle_root_words(words_d, zero_d, depth)
+    return _obs.d2h("ops.merkle.merkleize_chunks", root).astype(">u4").tobytes()

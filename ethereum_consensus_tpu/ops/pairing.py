@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import device as _obs
 from . import fq2, fq12, fql
 from .fql import LV
 
@@ -77,7 +78,9 @@ def g1_affine_from_raw(raws: "list[bytes]") -> tuple[LV, LV]:
     words = np.frombuffer(b"".join(raws), dtype=">u2").reshape(n, 48)
     x = np.ascontiguousarray(words[:, :24][:, ::-1]).astype(np.uint64)
     y = np.ascontiguousarray(words[:, 24:][:, ::-1]).astype(np.uint64)
-    xy = fql.to_mont_device(jnp.asarray(np.concatenate([x, y])))
+    xy = fql.to_mont_device(
+        _obs.h2d("ops.pairing.g1_affine_from_raw", np.concatenate([x, y]))
+    )
     return fql.lv_canon(xy[:n]), fql.lv_canon(xy[n:])
 
 
@@ -87,7 +90,9 @@ def g2_affine_from_raw(raws: "list[bytes]") -> tuple[LV, LV]:
     n = len(raws)
     words = np.frombuffer(b"".join(raws), dtype=">u2").reshape(n, 4, 24)
     limbs = np.ascontiguousarray(words[:, :, ::-1]).astype(np.uint64)
-    m = fql.to_mont_device(jnp.asarray(limbs.reshape(n * 4, 24))).reshape(n, 4, 24)
+    m = fql.to_mont_device(
+        _obs.h2d("ops.pairing.g2_affine_from_raw", limbs.reshape(n * 4, 24))
+    ).reshape(n, 4, 24)
     x = fql.lv_canon(jnp.stack([m[:, 0], m[:, 1]], axis=-2))
     y = fql.lv_canon(jnp.stack([m[:, 2], m[:, 3]], axis=-2))
     return x, y
@@ -308,7 +313,7 @@ def _scalars_to_bits(scalars: "list[int]", bits: int) -> np.ndarray:
 
 
 @jax.jit
-def _mul_scan_g1(points, bits):
+def _mul_scan_g1(points, bits):  # observed below
     """points (N, 3, 24) Jacobian, bits (N, B) MSB-first →
     (N, 3, 24) [scalar]·P, double-and-add with per-element selects."""
     acc0 = jnp.zeros_like(points)
@@ -324,7 +329,7 @@ def _mul_scan_g1(points, bits):
 
 
 @jax.jit
-def _mul_scan_g2(points, bits):
+def _mul_scan_g2(points, bits):  # observed below
     acc0 = jnp.zeros_like(points)
 
     def step(acc, bit_col):
@@ -335,6 +340,10 @@ def _mul_scan_g2(points, bits):
 
     acc, _ = jax.lax.scan(step, acc0, jnp.moveaxis(bits, 1, 0))
     return acc
+
+
+_mul_scan_g1 = _obs.observe_jit(_mul_scan_g1, "ops.pairing._mul_scan_g1")
+_mul_scan_g2 = _obs.observe_jit(_mul_scan_g2, "ops.pairing._mul_scan_g2")
 
 
 def g1_mul_batched(points: LV, scalars: "list[int]", bits: int = 128) -> LV:
@@ -455,6 +464,11 @@ def _fp12_tree(fs, levels: int):
         return jnp.where(keep[:, None, None, None, None], _clamp(prod), one)
 
     return jax.lax.fori_loop(0, levels, level, fs)[0]
+
+
+miller_loop_batched = _obs.observe_jit(
+    miller_loop_batched, "ops.pairing.miller_loop_batched"
+)
 
 
 def fp12_product(fs) -> jax.Array:

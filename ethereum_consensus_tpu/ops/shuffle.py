@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import device as _obs
+
 __all__ = ["shuffle_sources", "shuffled_indices_device", "compute_shuffled_indices_device"]
 
 
@@ -73,16 +75,23 @@ def _shuffle_rounds(indices, pivots, sources, count: int, forward: bool):
     return jax.lax.fori_loop(0, rounds, body, indices)
 
 
+_shuffle_rounds_jit = _obs.observe_jit(
+    jax.jit(_shuffle_rounds, static_argnames=("count", "forward")),
+    "ops.shuffle._shuffle_rounds",
+)
+
+
 def shuffled_indices_device(count: int, seed: bytes, rounds: int) -> jax.Array:
     """Map every index through the swap-or-not permutation on device:
     out[i] == compute_shuffled_index(i, count, seed)."""
     pivots, sources = shuffle_sources(count, seed, rounds)
     indices = jnp.arange(count, dtype=jnp.uint32)
-    return _shuffle_rounds(
+    pivots_d, sources_d = _obs.h2d("ops.shuffle", pivots, sources)
+    return _shuffle_rounds_jit(
         indices,
-        jnp.asarray(pivots),
-        jnp.asarray(sources),
-        count,
+        pivots_d,
+        sources_d,
+        count=count,
         forward=True,
     )
 
@@ -93,8 +102,9 @@ def compute_shuffled_indices_device(indices: list[int], seed: bytes, context) ->
     count = len(indices)
     if count == 0:
         return []
-    mapping = np.asarray(
-        shuffled_indices_device(count, seed, context.SHUFFLE_ROUND_COUNT)
+    mapping = _obs.d2h(
+        "ops.shuffle",
+        shuffled_indices_device(count, seed, context.SHUFFLE_ROUND_COUNT),
     )
     arr = np.asarray(indices)
     return arr[mapping].tolist()

@@ -29,6 +29,7 @@ from ..models.altair.constants import (
     TIMELY_HEAD_FLAG_INDEX,
     WEIGHT_DENOMINATOR,
 )
+from ..telemetry import device as _obs
 
 from .registry_columns import pack_registry  # noqa: F401 — re-export
 
@@ -110,6 +111,9 @@ def _flag_deltas(
     return rewards, penalties
 
 
+_flag_deltas = _obs.observe_jit(_flag_deltas, "ops.sweeps._flag_deltas")
+
+
 def flag_deltas_device(packed: dict, flag_index: int, total_active_balance: int, context, is_leaking: bool):
     """Device twin of altair get_flag_index_deltas (helpers.rs:265)."""
     participating = (
@@ -117,10 +121,14 @@ def flag_deltas_device(packed: dict, flag_index: int, total_active_balance: int,
         & ~packed["slashed"]
         & packed["active_previous"]
     )
+    eff_d, part_d, elig_d = _obs.h2d(
+        "ops.sweeps.flag_deltas",
+        packed["effective_balance"], participating, packed["eligible"],
+    )
     rewards, penalties = _flag_deltas(
-        jnp.asarray(packed["effective_balance"]),
-        jnp.asarray(participating),
-        jnp.asarray(packed["eligible"]),
+        eff_d,
+        part_d,
+        elig_d,
         jnp.uint64(total_active_balance),
         jnp.uint64(PARTICIPATION_FLAG_WEIGHTS[flag_index]),
         flag_index,
@@ -129,7 +137,10 @@ def flag_deltas_device(packed: dict, flag_index: int, total_active_balance: int,
         WEIGHT_DENOMINATOR,
         is_leaking,
     )
-    return np.asarray(rewards), np.asarray(penalties)
+    return (
+        _obs.d2h("ops.sweeps.flag_deltas", rewards),
+        _obs.d2h("ops.sweeps.flag_deltas", penalties),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("bias", "recovery_rate", "is_leaking"))
@@ -146,6 +157,11 @@ def _inactivity_updates(scores, participating, eligible, bias: int, recovery_rat
     return scores
 
 
+_inactivity_updates = _obs.observe_jit(
+    _inactivity_updates, "ops.sweeps._inactivity_updates"
+)
+
+
 def inactivity_updates_device(packed: dict, context, is_leaking: bool):
     """Device twin of altair process_inactivity_updates
     (epoch_processing.rs:104)."""
@@ -154,15 +170,20 @@ def inactivity_updates_device(packed: dict, context, is_leaking: bool):
         & ~packed["slashed"]
         & packed["active_previous"]
     )
-    return np.asarray(
+    scores_d, part_d, elig_d = _obs.h2d(
+        "ops.sweeps.inactivity_updates",
+        packed["inactivity_scores"], participating, packed["eligible"],
+    )
+    return _obs.d2h(
+        "ops.sweeps.inactivity_updates",
         _inactivity_updates(
-            jnp.asarray(packed["inactivity_scores"]),
-            jnp.asarray(participating),
-            jnp.asarray(packed["eligible"]),
+            scores_d,
+            part_d,
+            elig_d,
             context.inactivity_score_bias,
             context.inactivity_score_recovery_rate,
             is_leaking,
-        )
+        ),
     )
 
 
@@ -171,6 +192,11 @@ def _inactivity_penalties(effective_balance, scores, not_target, bias: int, quot
     numerator = effective_balance * scores
     denominator = jnp.uint64(bias) * jnp.uint64(quotient)
     return jnp.where(not_target, numerator // denominator, jnp.uint64(0))
+
+
+_inactivity_penalties = _obs.observe_jit(
+    _inactivity_penalties, "ops.sweeps._inactivity_penalties"
+)
 
 
 def inactivity_penalties_device(packed: dict, context, quotient: int):
@@ -196,14 +222,18 @@ def inactivity_penalties_device(packed: dict, context, quotient: int):
         products = eff.astype(object) * scores.astype(object)
         exact = np.where(not_target, products // denominator, 0)
         return exact.astype(np.uint64)
-    return np.asarray(
+    eff_d, scores_d, not_target_d = _obs.h2d(
+        "ops.sweeps.inactivity_penalties", eff, scores, not_target
+    )
+    return _obs.d2h(
+        "ops.sweeps.inactivity_penalties",
         _inactivity_penalties(
-            jnp.asarray(eff),
-            jnp.asarray(scores),
-            jnp.asarray(not_target),
+            eff_d,
+            scores_d,
+            not_target_d,
             context.inactivity_score_bias,
             quotient,
-        )
+        ),
     )
 
 
@@ -227,12 +257,22 @@ def _effective_balance_updates(
     return jnp.where(update, candidate, effective)
 
 
+_effective_balance_updates = _obs.observe_jit(
+    _effective_balance_updates, "ops.sweeps._effective_balance_updates"
+)
+
+
 def effective_balance_updates_device(packed: dict, context):
     """Device twin of phase0 process_effective_balance_updates."""
-    return np.asarray(
+    bal_d, eff_d = _obs.h2d(
+        "ops.sweeps.effective_balance_updates",
+        packed["balances"], packed["effective_balance"],
+    )
+    return _obs.d2h(
+        "ops.sweeps.effective_balance_updates",
         _effective_balance_updates(
-            jnp.asarray(packed["balances"]),
-            jnp.asarray(packed["effective_balance"]),
+            bal_d,
+            eff_d,
             context.EFFECTIVE_BALANCE_INCREMENT,
             context.MAX_EFFECTIVE_BALANCE,
             context.HYSTERESIS_QUOTIENT,

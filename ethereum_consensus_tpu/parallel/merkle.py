@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.merkle import reduce_levels, zero_hash_words
 from ..ssz.merkle import BYTES_PER_CHUNK, merkleize_chunks, next_pow_of_two, zero_hash
+from ..telemetry import device as _obs
 from .mesh import SHARD_AXIS
 
 __all__ = ["sharded_merkle_root_words", "sharded_merkleize_chunks"]
@@ -104,11 +105,16 @@ def sharded_merkleize_chunks(
     words = np.ascontiguousarray(
         np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(padded, 8).T
     )
+    words_d, zero_d = _obs.h2d(
+        "parallel.merkle.sharded_merkleize", words, zero_hash_words()
+    )
     root = sharded_merkle_root_words(
-        jnp.asarray(words),
-        jnp.asarray(zero_hash_words()),
+        words_d,
+        zero_d,
         depth=depth,
         mesh=mesh,
         axis_name=axis_name,
     )
-    return np.asarray(root).astype(">u4").tobytes()
+    return _obs.d2h(
+        "parallel.merkle.sharded_merkleize", root
+    ).astype(">u4").tobytes()

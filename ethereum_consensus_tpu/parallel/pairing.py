@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..ops import fq12, pairing as dp
+from ..telemetry import device as _obs
 from .mesh import SHARD_AXIS, default_device_mesh
 
 __all__ = ["batch_verify_sharded", "miller_partials_sharded"]
@@ -58,14 +59,17 @@ def _sharded_parts(mesh):
 
     # check_vma=False: the Miller scan mixes device-varying lanes with
     # unvarying constants (same situation as parallel/step.py's SHA loop)
-    return jax.jit(
-        jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(P(SHARD_AXIS),) * 7,
-            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-            check_vma=False,
-        )
+    return _obs.observe_jit(
+        jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(SHARD_AXIS),) * 7,
+                out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                check_vma=False,
+            )
+        ),
+        "parallel.pairing._sharded_parts",
     )
 
 
@@ -112,11 +116,20 @@ def miller_partials_sharded(mesh, pk_raws, h_raws, sig_raws, scalars):
     sig_bits = jnp.asarray(dp._scalars_to_bits(sig_scalars, 128))
 
     shard = NamedSharding(mesh, P(SHARD_AXIS))
-    args = tuple(
-        jax.device_put(a, shard)
-        for a in (pk_jac, pk_bits, xq.arr, yq.arr, sig_jac, sig_bits,
-                  jnp.asarray(valid))
-    )
+    staged = (pk_jac, pk_bits, xq.arr, yq.arr, sig_jac, sig_bits,
+              jnp.asarray(valid))
+    if _obs.OBSERVATORY.active:
+        import time as _time
+
+        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in staged)
+        t0 = _time.perf_counter()
+        args = tuple(jax.device_put(a, shard) for a in staged)
+        _obs.OBSERVATORY.record_transfer(
+            "parallel.pairing.shard_put", "h2d", len(staged), nbytes,
+            t0, _time.perf_counter(),
+        )
+    else:
+        args = tuple(jax.device_put(a, shard) for a in staged)
     partial_fs, partial_sigs = _sharded_parts(mesh)(*args)
 
     f_total = dp.fp12_product(jnp.asarray(partial_fs))

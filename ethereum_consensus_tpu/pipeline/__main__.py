@@ -20,6 +20,12 @@ Telemetry exports (docs/OBSERVABILITY.md):
   flush dispatch/verify/settle windows and rollbacks visible.
 * ``--metrics-out PATH`` — dump the process-wide metrics registry
   snapshot (digests, pubkey-cache hit rates, flush shapes, ...) as JSON.
+* ``--device-out PATH``  — run the device execution observatory
+  (``telemetry/device.py``) for the selfcheck's duration and dump its
+  ledgers (compile ledger + recompile sentinel, per-site host<->device
+  transfer bytes, the device-vs-host routing journal) as JSON. The
+  three `-out` flags together are ``make profile``'s capture artifact
+  (docs/TPU_CAPTURE_PLAN.md).
 * ``--serve PORT``       — run the live introspection server
   (``telemetry/server.py``: /metrics Prometheus exposition, /healthz,
   /blocks lineage, /events SSE) for the selfcheck's duration; 0 picks
@@ -165,11 +171,13 @@ def _flag_value(argv: "list[str]", flag: str) -> "str | None":
 def main(argv: "list[str]") -> int:
     trace_out = _flag_value(argv, "--trace-out")
     metrics_out = _flag_value(argv, "--metrics-out")
+    device_out = _flag_value(argv, "--device-out")
     serve_port = _flag_value(argv, "--serve")
     hold_s = _flag_value(argv, "--hold")
     if "--selfcheck" not in argv:
         print(__doc__)
         return 2
+    from ..telemetry import device as device_obs
     from ..telemetry import metrics, spans
 
     server = None
@@ -196,6 +204,8 @@ def main(argv: "list[str]") -> int:
         raise SystemExit("--serve-data requires --serve PORT")
     if trace_out:
         spans.start_recording()
+    if device_out:
+        device_obs.start()
     try:
         if _find_chain_utils():
             _selfcheck_chain()
@@ -218,6 +228,15 @@ def main(argv: "list[str]") -> int:
             with open(metrics_out, "w", encoding="utf-8") as f:
                 json.dump(metrics.snapshot(), f, indent=1, sort_keys=True)
             print(f"metrics snapshot written: {metrics_out}")
+        if device_out:
+            import json
+
+            device_obs.stop()
+            with open(device_out, "w", encoding="utf-8") as f:
+                json.dump(
+                    device_obs.snapshot(), f, indent=1, sort_keys=True
+                )
+            print(f"device ledger written: {device_out}")
     print("selfcheck OK")
     if server is not None:
         if hold_s is not None and float(hold_s) > 0:

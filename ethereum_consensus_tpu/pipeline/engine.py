@@ -315,6 +315,9 @@ class ChainPipeline:
                 ),
                 flush_sets=len(window.batch) if window is not None else 0,
                 verify_s=window.verify_s if window is not None else None,
+                verify_route=(
+                    window.verify_route if window is not None else None
+                ),
                 settle_s=settle_s,
                 total_s=(
                     now - entry.t_start

@@ -134,6 +134,7 @@ class Window:
     __slots__ = (
         "entries", "batch", "post_state", "snap_state", "future", "seq",
         "attempts", "t_dispatch", "t_settled", "verify_s", "degraded",
+        "verify_route",
     )
 
     def __init__(self, entries, batch, post_state, seq: int):
@@ -152,6 +153,11 @@ class Window:
         self.t_settled = None
         self.verify_s = 0.0
         self.degraded = False
+        # which pairing route proved this window's batch ("device" /
+        # "host" / None when no RLC batch ran) — written by the worker
+        # via the verify route_sink (same happens-before edge as the
+        # timer), folded into BlockLineage.verify_route
+        self.verify_route = None
 
 
 class VerifyScheduler:
@@ -200,9 +206,14 @@ class VerifyScheduler:
             stats.stage_b_busy(seconds)
             _w.verify_s += seconds
 
+        def route_sink(route, _w=window):
+            # same worker-side write discipline as the timer
+            _w.verify_route = route
+
         try:
             window.future = bls.verify_signature_sets_async(
-                window.batch.sets, timer=timer, pre=pre
+                window.batch.sets, timer=timer, pre=pre,
+                route_sink=route_sink,
             )
         except RuntimeError:
             _metrics.counter("pipeline.fault.dispatch_failure").inc()
@@ -247,7 +258,9 @@ class VerifyScheduler:
         t0 = time.perf_counter()
         try:
             with trace.span("pipeline.flush.verify_inline", seq=window.seq):
-                return bls.verify_signature_sets(window.batch.sets)
+                verdicts = bls.verify_signature_sets(window.batch.sets)
+            window.verify_route = bls.last_batch_route()
+            return verdicts
         finally:
             window.verify_s += time.perf_counter() - t0
 

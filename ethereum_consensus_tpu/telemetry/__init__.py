@@ -16,6 +16,10 @@ pure-host ``ssz``/``crypto`` paths — nothing here touches jax):
 * ``flight``  — the chain flight recorder: a bounded ring journal of
   per-block ``BlockLineage`` records assembled by the pipeline's
   commit/rollback hook, with JSONL export and a query API.
+* ``device``  — the device execution observatory: compile ledger with
+  recompile sentinel, host<->device transfer ledger, and the
+  device-vs-host routing journal, recorded at the repo's JAX/XLA seams
+  (stdlib-only here; jax stays at the instrumented call sites).
 * ``server``  — the live introspection server (``/metrics`` Prometheus
   exposition, ``/healthz``, ``/blocks``, ``/events`` SSE). NOT imported
   here: it pulls in ``http.server``, which no pure-compute layer needs
@@ -27,5 +31,6 @@ Conventions and export formats: docs/OBSERVABILITY.md.
 from __future__ import annotations
 
 from . import flight, metrics, phases, spans
+from . import device  # noqa: E402 — after spans/metrics (its imports)
 
-__all__ = ["flight", "metrics", "phases", "spans", "server"]
+__all__ = ["device", "flight", "metrics", "phases", "spans", "server"]

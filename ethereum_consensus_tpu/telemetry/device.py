@@ -1,0 +1,484 @@
+"""Device execution observatory: JAX/XLA compile, transfer, and routing
+telemetry.
+
+The host paths are instrumented exhaustively (spans, metrics, flight
+lineage) but the JAX/XLA side was a black box: a TPU run would come home
+with ``bls.pairing_route.{device,host}`` tallies and nothing else — no
+visibility into compiles (tens of seconds per distinct shape on the
+tunneled chip), silent per-shape RE-compiles (the classic TPU perf
+killer: one drifting dtype and every "warm" call re-traces), host<->
+device transfer volume (the epoch columns and signature batches are the
+payloads that matter), or why a given call routed device vs host. This
+module closes that: one process-wide ``DeviceObservatory`` recording
+
+* a **compile ledger** — every traced-function compile observed through
+  the repo's jit seams (``ops/``, ``parallel/``,
+  ``models/epoch_vector.py`` kernels), with the call's shape/dtype
+  signature, elapsed seconds (the compiling call's wall time — on an
+  accelerator trace+compile dominates it), and a **recompile sentinel**:
+  a counter plus a ONE-SHOT trace event per function naming the old and
+  new signatures whenever an already-compiled kernel is re-traced for a
+  drifted signature;
+* a **transfer ledger** — host→device and device→host transfer counts
+  and bytes aggregated per call site (``device.transfer.{h2d,d2h}_
+  {count,bytes}`` registry counters + per-site totals), with
+  per-transfer spans on a dedicated ``device`` virtual lane in the
+  Chrome-trace export (telemetry/spans.py ``named_lane``) so Perfetto
+  renders the device traffic alongside the pipeline/verifier thread
+  tracks;
+* a **routing journal** — every device-vs-host decision (the
+  ``_device_flags`` threshold gates, the BLS pairing route, the
+  ``epoch_vector`` engage/decline) with its choice, reason, and
+  threshold inputs, queryable live via the introspection server's
+  ``/device`` endpoint and summarized per flush window in
+  ``BlockLineage.verify_route``.
+
+Cost discipline (the spans/commit-hook contract): ``OBSERVATORY.active``
+is a plain bool read — instrumented call sites check it FIRST and pay
+nothing else while the observatory is off (guarded by the overhead test
+in tests/test_device_observatory.py). Everything here is stdlib-only;
+jax is never imported by this module (the instrumented seams already
+have it).
+
+Lock discipline (speclint-checked): every write to the observatory's
+shared structures holds ``self._lock``; the hot ``active`` read and the
+metrics-registry increments (locked per metric) stay outside it.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+__all__ = [
+    "DeviceObservatory",
+    "OBSERVATORY",
+    "DEFAULT_CAPACITY",
+    "observe_jit",
+    "h2d",
+    "d2h",
+    "route",
+    "signature_of",
+    "start",
+    "stop",
+    "is_observing",
+    "observing",
+    "snapshot",
+]
+
+DEFAULT_CAPACITY = 1 << 12
+
+_DEVICE_LANE = "device"
+
+
+def signature_of(args: tuple, kwargs: dict) -> str:
+    """A stable shape/dtype signature for one jitted call: arrays render
+    as ``dtype[d0,d1]``, static scalars by value, everything else by
+    type name — the same drift axes XLA re-traces on."""
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{dtype}[{','.join(str(d) for d in shape)}]")
+        elif isinstance(a, (bool, int, float, str, bytes)):
+            parts.append(repr(a))
+        else:
+            parts.append(type(a).__name__)
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{k}={dtype}[{','.join(str(d) for d in shape)}]")
+        elif isinstance(v, (bool, int, float, str, bytes)):
+            parts.append(f"{k}={v!r}")
+        else:
+            parts.append(f"{k}={type(v).__name__}")
+    return "(" + ", ".join(parts) + ")"
+
+
+def _jit_cache_size(jitted) -> "int | None":
+    """The jitted callable's executable-cache entry count, when the jax
+    version exposes it (``PjitFunction._cache_size``); None otherwise —
+    the observatory then falls back to its own seen-signature table."""
+    probe = getattr(jitted, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 — version drift must not break calls
+        return None
+
+
+class DeviceObservatory:
+    """Process-wide ledger of device-side execution facts; one instance
+    (``OBSERVATORY``) serves the whole process, started/stopped like the
+    span recorder."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._compiles: deque = deque(maxlen=capacity)
+        self._routes: deque = deque(maxlen=capacity)
+        self._route_tally: dict = {}      # (kind, choice) -> count
+        self._transfers: dict = {}        # site -> {h2d/d2h count/bytes}
+        self._signatures: dict = {}       # fn -> set of compiled signatures
+        self._sentinel_seen: set = set()  # fn names whose sentinel fired
+        self.active = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Begin a fresh observation (drops previous ledgers)."""
+        with self._lock:
+            self._compiles.clear()
+            self._routes.clear()
+            self._route_tally.clear()
+            self._transfers.clear()
+            self._signatures.clear()
+            self._sentinel_seen.clear()
+            self.active = True
+
+    def stop(self) -> None:
+        """Stop observing (ledgers stay readable)."""
+        with self._lock:
+            self.active = False
+
+    # -- compile ledger ------------------------------------------------------
+    def record_call(self, name: str, signature: str, t0: float, t1: float,
+                    compiled: "bool | None", cache_size: "int | None") -> None:
+        """One observed jitted call. ``compiled`` is the jit-cache
+        verdict when the jax version exposes the cache size (None =
+        unknown: fall back to the seen-signature table)."""
+        seconds = max(0.0, t1 - t0)
+        recompile_from = None
+        with self._lock:
+            known = self._signatures.get(name)
+            if known is None:
+                known = self._signatures[name] = set()
+            if compiled is None:
+                compiled = signature not in known
+            if compiled:
+                if known and signature not in known:
+                    # the sentinel case: this kernel had compiled before
+                    # and a drifted signature re-traced it
+                    recompile_from = sorted(known)[-1]
+                known.add(signature)
+                self._compiles.append(
+                    {
+                        "fn": name,
+                        "signature": signature,
+                        "compile_s": seconds,
+                        "recompile": recompile_from is not None,
+                        "prev_signature": recompile_from,
+                        "cache_size": cache_size,
+                        "at": time.time(),
+                    }
+                )
+            fire_sentinel = (
+                recompile_from is not None
+                and name not in self._sentinel_seen
+            )
+            if fire_sentinel:
+                self._sentinel_seen.add(name)
+        if compiled:
+            _metrics.counter("device.compiles").inc()
+            _metrics.histogram("device.compile_s").observe(seconds)
+            _metrics.counter("device.jit_cache.misses").inc()
+        else:
+            _metrics.counter("device.jit_cache.hits").inc()
+        if recompile_from is not None:
+            _metrics.counter("device.recompiles").inc()
+        if fire_sentinel:
+            # one-shot per function per process (the ops_vector.fallback
+            # idiom): the counter counts every recompile, the event names
+            # the drift once so a trace isn't flooded by a pathological
+            # shape churn
+            from ..utils import trace
+
+            trace.event(
+                "device.recompile",
+                fn=name,
+                old_signature=recompile_from,
+                new_signature=signature,
+            )
+        rec = _spans.RECORDER
+        if rec.enabled and compiled:
+            rec.add_complete(
+                "device.compile",
+                t0,
+                t1,
+                {"fn": name, "signature": signature,
+                 "recompile": recompile_from is not None},
+                lane=rec.named_lane(_DEVICE_LANE),
+            )
+
+    # -- transfer ledger -----------------------------------------------------
+    def record_transfer(self, site: str, direction: str, count: int,
+                        nbytes: int, t0: float, t1: float) -> None:
+        """One host<->device transfer at ``site`` (``direction`` is
+        ``h2d`` or ``d2h``)."""
+        with self._lock:
+            agg = self._transfers.get(site)
+            if agg is None:
+                agg = self._transfers[site] = {
+                    "h2d_count": 0, "h2d_bytes": 0,
+                    "d2h_count": 0, "d2h_bytes": 0,
+                }
+            agg[f"{direction}_count"] += count
+            agg[f"{direction}_bytes"] += nbytes
+        _metrics.counter(f"device.transfer.{direction}_count").inc(count)
+        _metrics.counter(f"device.transfer.{direction}_bytes").inc(nbytes)
+        rec = _spans.RECORDER
+        if rec.enabled:
+            rec.add_complete(
+                f"device.{direction}",
+                t0,
+                t1,
+                {"site": site, "bytes": nbytes, "count": count},
+                lane=rec.named_lane(_DEVICE_LANE),
+            )
+
+    # -- routing journal -----------------------------------------------------
+    def record_route(self, kind: str, choice: str, reason: str,
+                     inputs: dict) -> None:
+        """One device-vs-host decision: ``kind`` names the gate
+        (``pairing``, ``sweeps``, ``shuffle``, ``bls_agg``,
+        ``epoch_vector``), ``choice`` where it went (``device`` /
+        ``host`` / ``columnar`` / ``literal``), ``reason`` why, and
+        ``inputs`` the threshold arithmetic behind it."""
+        with self._lock:
+            key = (kind, choice)
+            self._route_tally[key] = self._route_tally.get(key, 0) + 1
+            self._routes.append(
+                {
+                    "kind": kind,
+                    "choice": choice,
+                    "reason": reason,
+                    "inputs": dict(inputs),
+                    "at": time.time(),
+                }
+            )
+        _metrics.counter(f"device.route.{kind}.{choice}").inc()
+        rec = _spans.RECORDER
+        if rec.enabled:
+            rec.add_instant(
+                "device.route",
+                time.perf_counter(),
+                {"kind": kind, "choice": choice, "reason": reason},
+                lane=rec.named_lane(_DEVICE_LANE),
+            )
+
+    # -- reading -------------------------------------------------------------
+    def compiles(self) -> list:
+        """Compile-ledger records, oldest first (consistent copy)."""
+        with self._lock:
+            return [dict(r) for r in self._compiles]
+
+    def routes(self, n: "int | None" = None) -> list:
+        """Routing-journal records, oldest first; newest ``n`` if
+        given."""
+        with self._lock:
+            records = [dict(r) for r in self._routes]
+        return records if n is None else records[-n:]
+
+    def route_tallies(self) -> dict:
+        """Cumulative ``{kind: {choice: count}}`` over the whole
+        observation (unbounded, unlike the journal ring)."""
+        with self._lock:
+            items = list(self._route_tally.items())
+        out: dict = {}
+        for (kind, choice), count in items:
+            out.setdefault(kind, {})[choice] = count
+        return out
+
+    def transfer_summary(self) -> dict:
+        """Per-site transfer aggregates plus process totals."""
+        with self._lock:
+            sites = {site: dict(agg) for site, agg in self._transfers.items()}
+        totals = {"h2d_count": 0, "h2d_bytes": 0, "d2h_count": 0,
+                  "d2h_bytes": 0}
+        for agg in sites.values():
+            for key in totals:
+                totals[key] += agg[key]
+        return {"sites": sites, "totals": totals}
+
+    def signatures(self) -> dict:
+        """``{fn: sorted compiled signatures}`` — the shape census."""
+        with self._lock:
+            return {name: sorted(sigs)
+                    for name, sigs in self._signatures.items()}
+
+    def snapshot(self, journal_n: int = 128) -> dict:
+        """The /device endpoint document: every ledger, JSON-ready."""
+        from .._jax_cache import status as _jax_cache_status
+
+        compiles = self.compiles()
+        return {
+            "observing": self.active,
+            "compile_ledger": {
+                "compiles": len(compiles),
+                "recompiles": sum(1 for c in compiles if c["recompile"]),
+                "total_compile_s": sum(c["compile_s"] for c in compiles),
+                "signatures": self.signatures(),
+                "recent": compiles[-journal_n:],
+            },
+            "transfer_ledger": self.transfer_summary(),
+            "routing_journal": {
+                "tallies": self.route_tallies(),
+                "recent": self.routes(journal_n),
+            },
+            "jit_cache": {
+                "hits": _metrics.counter("device.jit_cache.hits").value(),
+                "misses": _metrics.counter("device.jit_cache.misses").value(),
+            },
+            "persistent_cache": _jax_cache_status(),
+        }
+
+
+OBSERVATORY = DeviceObservatory()
+
+
+# ---------------------------------------------------------------------------
+# the instrumentation seams (called from ops/, parallel/, models/, crypto/)
+# ---------------------------------------------------------------------------
+
+
+def observe_jit(jitted, name: str):
+    """Wrap an already-jitted callable so every call through it feeds
+    the compile ledger while the observatory is active. The inactive
+    path is one bool read + one indirection (overhead-test guarded);
+    the active path derives the call's shape signature, times the call,
+    and classifies it compile / cache-hit / RECOMPILE via the jit cache
+    size (or the observatory's own signature table on jax versions
+    without ``_cache_size``)."""
+
+    def observed(*args, **kwargs):
+        obs = OBSERVATORY
+        if not obs.active:
+            return jitted(*args, **kwargs)
+        signature = signature_of(args, kwargs)
+        before = _jit_cache_size(jitted)
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        t1 = time.perf_counter()
+        after = _jit_cache_size(jitted)
+        compiled = None
+        if before is not None and after is not None:
+            compiled = after > before
+        obs.record_call(name, signature, t0, t1, compiled, after)
+        return out
+
+    observed.__name__ = name.rsplit(".", 1)[-1]
+    observed.__qualname__ = name
+    observed.__wrapped__ = jitted
+    return observed
+
+
+@functools.lru_cache(maxsize=1)
+def _jnp():
+    """The jax.numpy module, resolved once (thread-safe via lru_cache —
+    no unlocked module-global write). Call sites of ``h2d`` are device
+    entry points that already imported jax, so this never triggers a
+    cold jax import on a host-only process."""
+    import jax.numpy
+
+    return jax.numpy
+
+
+@functools.lru_cache(maxsize=1)
+def _np():
+    import numpy
+
+    return numpy
+
+
+def _nbytes(a) -> int:
+    n = getattr(a, "nbytes", None)
+    if n is not None:
+        return int(n)
+    try:
+        return len(a)
+    except TypeError:
+        return 0
+
+
+def h2d(site: str, *arrays):
+    """``jnp.asarray`` every argument (the repo's host→device seam),
+    recording count/bytes/seconds against ``site`` while observing.
+    Returns a single array for a single argument, a tuple otherwise.
+    On the CPU backend the "transfer" may be a zero-copy view — the
+    ledger measures the dispatch seam, which on a real accelerator IS
+    the PCIe/ICI transfer."""
+    jnp = _jnp()
+    obs = OBSERVATORY
+    if not obs.active:
+        out = tuple(jnp.asarray(a) for a in arrays)
+        return out[0] if len(out) == 1 else out
+    nbytes = sum(_nbytes(a) for a in arrays)
+    t0 = time.perf_counter()
+    out = tuple(jnp.asarray(a) for a in arrays)
+    t1 = time.perf_counter()
+    obs.record_transfer(site, "h2d", len(out), nbytes, t0, t1)
+    return out[0] if len(out) == 1 else out
+
+
+def d2h(site: str, array):
+    """``np.asarray`` the device value (the device→host seam),
+    recording against ``site`` while observing."""
+    np = _np()
+    obs = OBSERVATORY
+    if not obs.active:
+        return np.asarray(array)
+    t0 = time.perf_counter()
+    out = np.asarray(array)
+    t1 = time.perf_counter()
+    obs.record_transfer(site, "d2h", 1, _nbytes(out), t0, t1)
+    return out
+
+
+def route(kind: str, choice: str, reason: str, **inputs) -> None:
+    """Journal one device-vs-host decision (no-op while not observing;
+    hot call sites pre-guard with ``OBSERVATORY.active`` so the off
+    path is a single bool read)."""
+    obs = OBSERVATORY
+    if not obs.active:
+        return
+    obs.record_route(kind, choice, reason, inputs)
+
+
+# -- module-level lifecycle ---------------------------------------------------
+
+
+def start() -> DeviceObservatory:
+    OBSERVATORY.start()
+    return OBSERVATORY
+
+
+def stop() -> None:
+    OBSERVATORY.stop()
+
+
+def is_observing() -> bool:
+    return OBSERVATORY.active
+
+
+@contextmanager
+def observing():
+    """Observe for the duration of the block; yields ``OBSERVATORY``
+    (the ``spans.recording`` idiom)."""
+    start()
+    try:
+        yield OBSERVATORY
+    finally:
+        stop()
+
+
+def snapshot(journal_n: int = 128) -> dict:
+    return OBSERVATORY.snapshot(journal_n)

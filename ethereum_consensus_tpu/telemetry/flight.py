@@ -95,6 +95,7 @@ class BlockLineage:
         "flush_slots",
         "flush_sets",
         "verify_s",
+        "verify_route",
         "settle_s",
         "total_s",
         "retries",
@@ -118,6 +119,7 @@ class BlockLineage:
         flush_slots: tuple = (),
         flush_sets: int = 0,
         verify_s: "float | None" = None,
+        verify_route: "str | None" = None,
         settle_s: "float | None" = None,
         total_s: "float | None" = None,
         retries: int = 0,
@@ -140,6 +142,10 @@ class BlockLineage:
         self.flush_slots = tuple(flush_slots)
         self.flush_sets = flush_sets
         self.verify_s = verify_s
+        # which pairing route proved this block's flush window:
+        # "device" / "host" / None (no RLC batch ran — empty flush or
+        # per-set fallback) — the device observatory's lineage hook
+        self.verify_route = verify_route
         self.settle_s = settle_s
         self.total_s = total_s
         self.retries = retries

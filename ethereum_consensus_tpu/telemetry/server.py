@@ -1,4 +1,5 @@
-"""Live introspection server: /metrics, /healthz, /blocks, /events.
+"""Live introspection server: /metrics, /healthz, /blocks, /events,
+/device.
 
 A stdlib-only threaded HTTP server over the telemetry substrate — the
 read side of the ROADMAP's serving layer, landed first so every later
@@ -19,7 +20,19 @@ on instrumented ground:
 * ``/events``   — Server-Sent Events off the pipeline commit hook:
   ``head`` / ``commit`` / ``rollback`` / ``broken`` (add ``block`` for
   full lineage records with ``?kinds=head,block``). Commit order on the
-  wire IS chain order — the submitting thread emits.
+  wire IS chain order — the submitting thread emits. Idle streams carry
+  a ``: ping`` keepalive comment every ``sse_keepalive_s`` (default
+  15 s) so proxies and load balancers don't reap quiet subscribers.
+* ``/device``   — the device execution observatory's ledgers
+  (telemetry/device.py): compile ledger with recompile sentinel hits,
+  per-site host<->device transfer aggregates, the device-vs-host
+  routing journal, and the persistent XLA cache state. ``?n=`` caps the
+  journal tails.
+
+``/metrics`` additionally carries a standard ``build_info`` gauge (git
+sha, jax/numpy versions, x64 flag, backend platform as labels, value 1)
+so every scrape — and every bench trend artifact built from one — is
+self-describing.
 
 Concurrency model (speclint's newest scope): the accept loop runs on a
 single-worker ``ThreadPoolExecutor`` (the repo's sanctioned way to own a
@@ -44,6 +57,7 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from . import device as _device
 from . import flight as _flight
 from . import metrics as _metrics
 
@@ -53,10 +67,13 @@ __all__ = [
     "prometheus_name",
     "escape_label_value",
     "health_view",
+    "build_info_labels",
+    "build_info_line",
 ]
 
 _QUANTILES = (0.5, 0.9, 0.99)
 _SSE_DEFAULT_KINDS = ("head", "commit", "rollback", "broken")
+DEFAULT_SSE_KEEPALIVE_S = 15.0
 
 
 # ---------------------------------------------------------------------------
@@ -97,15 +114,98 @@ def _fmt(v) -> str:
     return repr(float(v))
 
 
+def _read_git_sha() -> str:
+    """The checkout's HEAD commit, read straight from .git (no
+    subprocess, no git dependency); "unknown" outside a checkout."""
+    import os
+
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    try:
+        with open(os.path.join(repo, ".git", "HEAD")) as f:
+            head = f.read().strip()
+        if head.startswith("ref: "):
+            ref = head[len("ref: "):]
+            with open(os.path.join(repo, ".git", ref)) as f:
+                return f.read().strip()[:12]
+        return head[:12]
+    except OSError:
+        return "unknown"
+
+
+def _dist_version(name: str) -> str:
+    """Installed version without importing the package (a /metrics
+    scrape must never trigger a cold jax import)."""
+    import sys
+
+    mod = sys.modules.get(name)
+    version = getattr(mod, "__version__", None)
+    if version:
+        return str(version)
+    try:
+        from importlib import metadata
+
+        return metadata.version(name)
+    except Exception:  # noqa: BLE001 — absent dependency
+        return "unknown"
+
+
+def build_info_labels() -> dict:
+    """The ``build_info`` label set: git sha, jax/numpy versions, the
+    x64 flag, and the backend platform. Platform/x64 report live values
+    when jax is already imported (never importing it from here — an
+    uninitialized process reports ``uninitialized``)."""
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    x64 = "uninitialized"
+    backend = "uninitialized"
+    if jax_mod is not None:
+        try:
+            x64 = "1" if jax_mod.config.jax_enable_x64 else "0"
+        except Exception:  # noqa: BLE001 — config drift
+            x64 = "unknown"
+        try:
+            # default_backend() would *initialize* a backend on a fresh
+            # process — only ask once something else already has
+            if getattr(jax_mod._src.xla_bridge, "_backends", None):
+                backend = jax_mod.default_backend()
+        except Exception:  # noqa: BLE001 — internal layout drift
+            backend = "unknown"
+    return {
+        "git_sha": _read_git_sha(),
+        "jax": _dist_version("jax"),
+        "numpy": _dist_version("numpy"),
+        "x64": x64,
+        "backend": backend,
+    }
+
+
+def build_info_line() -> str:
+    labels = ",".join(
+        f'{key}="{escape_label_value(value)}"'
+        for key, value in sorted(build_info_labels().items())
+    )
+    return f"build_info{{{labels}}} 1"
+
+
 def render_prometheus(metric_objects=None) -> str:
     """The registry (or an explicit metric-object list — the golden
-    test's seam) as one exposition document. Counters/gauges render
-    verbatim; a ``Histogram`` renders as a summary — reservoir-derived
-    ``{quantile="0.5|0.9|0.99"}`` samples plus exact ``_sum``/``_count``
-    — with ``_min``/``_max`` companion gauges."""
+    test's seam) as one exposition document, prefixed — on the
+    default registry walk only — by the standard ``build_info`` gauge.
+    Counters/gauges render verbatim; a ``Histogram`` renders as a
+    summary — reservoir-derived ``{quantile="0.5|0.9|0.99"}`` samples
+    plus exact ``_sum``/``_count`` — with ``_min``/``_max`` companion
+    gauges."""
+    lines: list = []
     if metric_objects is None:
         metric_objects = _metrics.registered_metrics()
-    lines: list = []
+        lines.append(
+            "# HELP build_info repo/toolchain identity of this process"
+        )
+        lines.append("# TYPE build_info gauge")
+        lines.append(build_info_line())
     for metric in metric_objects:
         name = prometheus_name(metric.name)
         lines.append(f"# HELP {name} {escape_help(metric.name)}")
@@ -255,6 +355,14 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             elif route == "/blocks":
                 self._serve_blocks()
+            elif route == "/device":
+                params = self._query()
+                try:
+                    n = int(self._param(params, "n", "128"))
+                except ValueError:
+                    self._send_json({"error": "?n= must be an int"}, 400)
+                    return
+                self._send_json(_device.OBSERVATORY.snapshot(journal_n=n))
             elif route == "/events":
                 self._serve_events()
             elif route == "/":
@@ -267,6 +375,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "/healthz",
                             "/blocks",
                             "/events",
+                            "/device",
                         ]
                         + [app.prefix + "..." for app in apps],
                         "apps": [type(app).__name__ for app in apps],
@@ -349,15 +458,30 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b": ect introspection event stream\n\n")
             self.wfile.flush()
+            keepalive_s = float(
+                getattr(
+                    self.server, "sse_keepalive_s", DEFAULT_SSE_KEEPALIVE_S
+                )
+            )
+            import time as _time
+
+            last_write = _time.monotonic()
             while not getattr(self.server, "stopping", False):
                 try:
                     kind, payload = inbox.get(timeout=0.25)
                 except queue.Empty:
-                    # heartbeat comment: keeps intermediaries from timing
-                    # the stream out and surfaces dead clients promptly
-                    self.wfile.write(b": keepalive\n\n")
-                    self.wfile.flush()
+                    # keepalive comment on the SSE interval (not every
+                    # poll): an idle subscriber behind a proxy or LB
+                    # keeps its stream alive, without the old
+                    # 4-writes-per-second chatter; the 0.25 s poll still
+                    # bounds stop() and dead-client discovery
+                    now = _time.monotonic()
+                    if now - last_write >= keepalive_s:
+                        self.wfile.write(b": ping\n\n")
+                        self.wfile.flush()
+                        last_write = now
                     continue
+                last_write = _time.monotonic()
                 if isinstance(payload, _flight.BlockLineage):
                     payload = payload.to_dict()
                 # default=repr: an exotic payload value (a state handle
@@ -388,10 +512,12 @@ class IntrospectionServer:
     (``flight.start()``) unless told not to, so ``/blocks`` is live the
     moment the server is."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 sse_keepalive_s: float = DEFAULT_SSE_KEEPALIVE_S):
         self._lock = threading.Lock()
         self._host = host
         self._requested_port = port
+        self._sse_keepalive_s = sse_keepalive_s
         self._httpd = None
         self._pool = None
         self._flight_started = False
@@ -425,6 +551,7 @@ class IntrospectionServer:
             httpd.daemon_threads = False
             httpd.stopping = False
             httpd.apps = self._apps
+            httpd.sse_keepalive_s = self._sse_keepalive_s
             pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="introspection-accept"
             )

@@ -24,6 +24,14 @@ practice the main thread) rather than raw ``threading.get_ident()``
 values, so the Perfetto track list stays readable; the real ident is
 kept in the thread-name metadata.
 
+Besides thread lanes there are **named virtual lanes**
+(``named_lane``): tid tracks that belong to no Python thread —
+the device observatory (``telemetry/device.py``) renders XLA compiles
+and host<->device transfers on a dedicated ``device`` track alongside
+the pipeline/verifier thread tracks, via ``add_complete``/
+``add_instant`` (pre-timed records appended without touching any
+thread's span stack).
+
 Lock discipline (speclint-checked): every write to the recorder's shared
 structures holds ``self._lock``; the hot ``enabled`` read and the
 per-thread span stack (``threading.local``) stay lock-free.
@@ -193,6 +201,51 @@ class SpanRecorder:
 
     def event(self, name: str, fields: dict) -> None:
         rec = _EventRecord(name, self._lane(), time.perf_counter(), fields)
+        with self._lock:
+            self._events.append(rec)
+
+    # -- named virtual lanes (non-thread tid tracks) -------------------------
+    def named_lane(self, name: str) -> int:
+        """The lane int for the virtual track ``name`` (allocated on
+        first use). Virtual lanes share the tid namespace with thread
+        lanes but belong to no thread — the device observatory's
+        ``device`` track."""
+        key = ("virtual", name)
+        lane = self._lanes.get(key)
+        if lane is None:
+            with self._lock:
+                lane = self._lanes.get(key)
+                if lane is None:
+                    lane = len(self._lanes)
+                    self._lanes[key] = lane
+                    self._lane_names[lane] = name
+        return lane
+
+    def add_complete(self, name: str, t0: float, t1: float, fields: dict,
+                     lane: "int | None" = None) -> SpanRecord:
+        """Append a pre-timed completed span (``perf_counter`` stamps)
+        without touching any thread's span stack — the virtual-lane
+        writer's API."""
+        rec = SpanRecord(
+            span_id=next(self._ids),
+            parent_id=0,
+            name=name,
+            lane=self._lane() if lane is None else lane,
+            t0=t0,
+            fields=fields,
+        )
+        rec.t1 = t1
+        with self._lock:
+            self._spans.append(rec)
+        return rec
+
+    def add_instant(self, name: str, ts: float, fields: dict,
+                    lane: "int | None" = None) -> None:
+        """Append a pre-timed instant event, optionally on a virtual
+        lane."""
+        rec = _EventRecord(
+            name, self._lane() if lane is None else lane, ts, fields
+        )
         with self._lock:
             self._events.append(rec)
 

@@ -72,3 +72,46 @@ def test_trend_cli_exit_zero(tmp_path, capsys):
     rc = bench_compare.main(["--trend", str(path)])
     assert rc == 0
     assert "bench trend" in capsys.readouterr().out
+
+
+def test_trend_renders_failed_run_wrappers_as_skipped(tmp_path):
+    """r01–r05-shaped driver wrappers ({n, cmd, rc, tail}) carry no
+    per-config payload: they must surface as one explicit `skipped` row
+    each — and NOT as a `–` column in every metric table."""
+    wrapper = tmp_path / "BENCH_r01.json"
+    wrapper.write_text(json.dumps({
+        "n": 1, "cmd": "python bench.py", "rc": 0,
+        "tail": "...crashed...", "parsed": None,
+    }))
+    real = tmp_path / "BENCH_r02.json"
+    real.write_text(json.dumps({"configs": {"cfg": {
+        "block_s": 0.11, "phases": {"operations_s": 0.04},
+    }}}))
+    out = bench_compare.trend([str(wrapper), str(real)])
+    assert "| r01 | skipped — failed-run wrapper" in out
+    # the wrapper is not a table column, so no –-only column exists
+    assert "| metric | r02 |" in out
+    assert "r01 |" not in out.split("## cfg")[1]
+
+
+def test_trend_renders_device_axes(tmp_path):
+    """The device observatory's evidence block (ISSUE 10) trends like
+    the phase seconds: compile_s/compiles/recompiles/transfer bytes/
+    route split rows appear when a config carries a `device` block."""
+    r1 = tmp_path / "BENCH_r10.json"
+    r1.write_text(json.dumps({"configs": {"pipeline_blocks": {
+        "pipelined_block_s": 0.09,
+        "device": {
+            "compile_s": 1.25, "compiles": 4, "recompiles": 1,
+            "h2d_bytes": 123456, "d2h_bytes": 640,
+            "route_device": 3, "route_host": 9,
+            "journal_consistent": True,
+        },
+    }}}))
+    out = bench_compare.trend([str(r1)])
+    assert "| device.compile_s | 1.2500 |" in out
+    assert "| device.compiles | 4.0000 |" in out
+    assert "| device.recompiles | 1.0000 |" in out
+    assert "| device.h2d_bytes | 123456.0000 |" in out
+    assert "| device.route_device | 3.0000 |" in out
+    assert "| device.route_host | 9.0000 |" in out
