@@ -1645,6 +1645,274 @@ def _serving_queries_measure(store, server, stop, replay_future, pool,
     }
 
 
+def bench_pool_ingest(validators: int = 1 << 17, n_blocks: int = 16,
+                      atts: int = 8, groups: int = 8,
+                      aggregators: int = 64, window: int = 512):
+    """Operation-pool admission throughput (pool/, docs/POOL.md):
+    admissions/s through the windowed RLC engine vs the per-message
+    scalar twin at the 2^17 registry, UNDER a concurrent pipeline
+    replay looping in the background (both engines share the single
+    FIFO bls verifier with the pipeline's stage-B flushes — the real
+    contention a live node sees).
+
+    Traffic is gossip-shaped: ``groups`` distinct (slot, committee,
+    data_root) claims × ``aggregators`` overlapping ~60%-participation
+    aggregates each (the Wonderboom many-aggregators-per-committee
+    shape), every message a REAL signed aggregate over the bundle's
+    realized committee keys. The RLC engine admits them with deferred
+    signatures: batched G2 membership (one blinded MSM per window),
+    per-group claim fusion (multiplicity-count G1 MSM + signature sum),
+    one ``verify_signature_sets_async`` RLC multi-pairing per window.
+    The scalar twin pays one key-parse + pairing pair per message.
+
+    ``ok`` gates on the acceptance: >=10x admissions/s, EXACTLY one RLC
+    flush per admission window (metrics-counted), every message
+    admitted by both engines, and bit-identity of the resulting pool —
+    served views AND the vectorized-vs-brute-force aggregate selection."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import json as _json
+    import random as _random
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import chain_utils
+
+    from ethereum_consensus_tpu.crypto import bls
+    from ethereum_consensus_tpu.executor import Executor
+    from ethereum_consensus_tpu.models.phase0 import helpers as ph
+    from ethereum_consensus_tpu.pipeline import FlushPolicy
+    from ethereum_consensus_tpu.pool import (
+        AdmissionEngine,
+        OperationPool,
+        select_aggregates,
+    )
+    from ethereum_consensus_tpu.serving import HeadStore
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    if _fast_test():
+        validators = min(validators, 1 << 14)
+        n_blocks, atts, groups, aggregators = 8, 4, 4, 4
+    elif _degraded():
+        # keep the acceptance registry; degrade only the chain traffic
+        n_blocks, atts = min(n_blocks, 16), min(atts, 8)
+    validators = _cache_scaled(
+        "chainbundle-" + chain_utils._FASTREG_VERSION
+        + f"-deneb-mainnet-{{validators}}-{n_blocks}x{atts}",
+        validators,
+        budget_s=120.0,
+    )
+    state, ctx, blocks = chain_utils.mainnet_chain_bundle(
+        "deneb", validators, n_blocks, atts
+    )
+    groups = min(groups, n_blocks - 1)
+
+    # pinned head: the post-replay state published once — admission
+    # validates against a stable snapshot while the pipeline replay
+    # below churns purely as contention (its commits are not attached)
+    head_ex = Executor(state.copy(), ctx)
+    head_ex.stream(blocks, policy=FlushPolicy(window_size=8, max_in_flight=2))
+    store = HeadStore()
+    snap = store.publish(head_ex.state, ctx)
+    head = head_ex.state.data
+
+    # gossip-shaped traffic over realized committees (the bundle's
+    # attested (slot, committee 0) pairs carry real keys)
+    rng = _random.Random(0x9001)
+    traffic = []
+    head_slot = int(head.slot)
+    for k in range(groups):
+        slot = head_slot - k
+        base = chain_utils.make_attestation(head, slot, 0, ctx)
+        committee = ph.get_beacon_committee(head, slot, 0, ctx)
+        data = base.data
+        from ethereum_consensus_tpu.domains import DomainType
+        from ethereum_consensus_tpu.signing import compute_signing_root
+
+        domain = ph.get_domain(
+            head, DomainType.BEACON_ATTESTER, int(data.target.epoch), ctx
+        )
+        root = compute_signing_root(type(data), data, domain)
+        for _ in range(aggregators):
+            bits = [rng.random() < 0.6 for _ in range(len(committee))]
+            if not any(bits):
+                bits[0] = True
+            sigs = [
+                chain_utils.secret_key(committee[i]).sign(root)
+                for i, b in enumerate(bits)
+                if b
+            ]
+            agg = base.copy()
+            agg.aggregation_bits = bits
+            agg.signature = bls.aggregate(sigs).to_bytes()
+            traffic.append(agg)
+    messages = len(traffic)
+
+    # prime the shared snapshot memos (committee tables, domains) so
+    # neither engine pays the one-time shuffle build inside its timing
+    prime = AdmissionEngine(OperationPool(), store, ctx, rlc=False)
+    for k in range(groups):
+        probe = chain_utils.make_attestation(head, head_slot - k, 0, ctx,
+                                             participation=0.1)
+        prime.admit_attestation(probe)
+
+    stop = threading.Lock()
+    stop.acquire()
+
+    def replay_forever():
+        # window 4: the replay contends continuously (stage-A python on
+        # the GIL, stage-B flushes on the shared FIFO verifier) without
+        # parking the verifier in one multi-hundred-ms flush that any
+        # pool window would just sit behind — finer-grained contention,
+        # same sustained load
+        while stop.locked():
+            ex = Executor(state.copy(), ctx)
+            ex.stream(blocks, policy=FlushPolicy(window_size=4,
+                                                 max_in_flight=2))
+
+    pool_exec = ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="pool-replayer")
+    replay_future = pool_exec.submit(replay_forever)
+    time.sleep(2.0)  # let the replay reach steady state (its first
+    # loop fronts a 2^17 state copy — GIL churn, not yet replay load)
+    def run_rlc():
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=window,
+                                 rlc=True)
+        flushes_before = tel_metrics.counter("pool.flushes").value()
+        fused_before = tel_metrics.counter("pool.fused_groups").value()
+        batch = [att.copy() for att in traffic]
+        t0 = time.perf_counter()
+        tickets = engine.admit_attestation_batch(batch)
+        admit_s = time.perf_counter() - t0
+        engine.settle()
+        return {
+            "pool": pool, "engine": engine, "tickets": tickets,
+            "admit_s": admit_s,
+            "total_s": time.perf_counter() - t0,
+            "flushes": tel_metrics.counter("pool.flushes").value()
+            - flushes_before,
+            "fused": tel_metrics.counter("pool.fused_groups").value()
+            - fused_before,
+        }
+
+    def run_scalar():
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=window,
+                                 rlc=False)
+        batch = [att.copy() for att in traffic]
+        t0 = time.perf_counter()
+        tickets = [engine.admit_attestation(att) for att in batch]
+        engine.settle()
+        return {
+            "pool": pool, "engine": engine, "tickets": tickets,
+            "total_s": time.perf_counter() - t0,
+        }
+
+    try:
+        # interleaved best-of-3 per engine, fresh pools each rep: the
+        # replay's phase (state-copy GIL churn vs pairing stretches) is
+        # the dominant noise source — interleaving samples both engines
+        # across the same phases; RLC first, so any shared warming
+        # favors the scalar baseline
+        rlc_runs, scalar_runs = [], []
+        for _ in range(3):
+            rlc_runs.append(run_rlc())
+            scalar_runs.append(run_scalar())
+        rlc_best = min(rlc_runs, key=lambda r: r["total_s"])
+        scalar_best = min(scalar_runs, key=lambda r: r["total_s"])
+    finally:
+        stop.release()
+        replay_future.result(timeout=600)
+        pool_exec.shutdown(wait=True)
+
+    rlc_pool, rlc_engine = rlc_best["pool"], rlc_best["engine"]
+    rlc_tickets, rlc_s = rlc_best["tickets"], rlc_best["total_s"]
+    scalar_pool = scalar_best["pool"]
+    scalar_tickets, scalar_s = scalar_best["tickets"], scalar_best["total_s"]
+    flushes, fused = rlc_best["flushes"], rlc_best["fused"]
+
+    rlc_admitted = sum(1 for t in rlc_tickets if t.status == "admitted")
+    scalar_admitted = sum(
+        1 for t in scalar_tickets if t.status == "admitted"
+    )
+    verdicts_identical = [
+        (t.status, t.reason) for t in rlc_tickets
+    ] == [(t.status, t.reason) for t in scalar_tickets]
+
+    views_identical = _json.dumps(
+        [type(a).to_json(a) for a in rlc_pool.attestations_view()],
+        sort_keys=True,
+    ) == _json.dumps(
+        [type(a).to_json(a) for a in scalar_pool.attestations_view()],
+        sort_keys=True,
+    )
+    vec_picks = [
+        (g.slot, g.committee_key, g.data_root, row)
+        for g, row in select_aggregates(rlc_pool.groups(), 128)
+    ]
+    scalar_picks = [
+        (g.slot, g.committee_key, g.data_root, row)
+        for g, row in select_aggregates(scalar_pool.groups(), 128,
+                                        scalar=True)
+    ]
+    selection_identical = vec_picks == scalar_picks and len(vec_picks) > 0
+
+    expected_flushes = (messages + window - 1) // window
+    speedup = scalar_s / rlc_s if rlc_s else float("inf")
+    return {
+        "ok": bool(
+            rlc_engine.rlc
+            and speedup >= 10.0
+            and flushes == expected_flushes
+            and rlc_admitted == messages
+            and scalar_admitted == messages
+            and verdicts_identical
+            and views_identical
+            and selection_identical
+        ),
+        "validators": validators,
+        "messages": messages,
+        "groups": groups,
+        "aggregators_per_group": aggregators,
+        "window": window,
+        "rlc_ingest_s": rlc_s,
+        "rlc_admit_s": rlc_best["admit_s"],
+        "scalar_ingest_s": scalar_s,
+        "admissions_per_s_rlc": messages / rlc_s,
+        "admissions_per_s_scalar": messages / scalar_s,
+        "admission_speedup": speedup,
+        "flushes": flushes,
+        "flushes_expected": expected_flushes,
+        "fused_groups": fused,
+        "rlc_admitted": rlc_admitted,
+        "scalar_admitted": scalar_admitted,
+        "bit_identical": bool(
+            verdicts_identical and views_identical and selection_identical
+        ),
+        "served_head_slot": int(snap.slot),
+        "backend": _pool_backend_name(),
+        "note": (
+            "admissions/s to admit AND settle all messages, measured "
+            "while a chain-pipeline replay loops on the shared bls "
+            "verifier; the RLC engine defers signatures into one fused "
+            "flush per window (batched G2 membership MSM + per-group "
+            "multiplicity G1 MSM + one RLC multi-pairing) while the "
+            "scalar twin pays per-message key parses and one pairing "
+            "pair per message; bit_identical covers verdicts, served "
+            "views, and vectorized-vs-brute-force aggregate selection"
+        ),
+    }
+
+
+def _pool_backend_name() -> str:
+    from ethereum_consensus_tpu.crypto import bls
+
+    try:
+        return bls.backend_name()
+    except Exception:  # noqa: BLE001 — report, never fail the config
+        return "unknown"
+
+
 def bench_process_block():
     """Full block application incl. batched signature verification and the
     per-slot state HTR (minimal preset — the Python orchestration floor;
@@ -1705,6 +1973,7 @@ CONFIGS = [
     ("pipeline_blocks", bench_pipeline_blocks),
     ("adversarial_replay", bench_adversarial_replay),
     ("serving_queries", bench_serving_queries),
+    ("pool_ingest", bench_pool_ingest),
     # the single heaviest cold-cache build (2^20-validator registry):
     # after the priority numbers, and self-bounding via _child_elapsed
     ("state_htr", bench_state_htr),
@@ -1882,6 +2151,20 @@ def _metrics_block(before: dict) -> dict:
         ev["fallbacks"] = ev_fallbacks
     if ev:
         out["epoch_vector"] = ev
+    # operation-pool engagement (pool/): admissions by kind, rejections
+    # by structured reason, flush/fusion discipline
+    pool_block = {
+        key.split("pool.", 1)[1]: (
+            value if not isinstance(value, dict)
+            else {"count": value.get("count"),
+                  "mean": round(value["mean"], 6)
+                  if value.get("count") else None}
+        )
+        for key, value in d.items()
+        if key.startswith("pool.") and value
+    }
+    if pool_block:
+        out["pool"] = pool_block
     return out
 
 

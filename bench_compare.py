@@ -70,6 +70,15 @@ _TREND_HEADLINE = (
     "device.d2h_bytes",
     "device.route_device",
     "device.route_host",
+    # the operation pool's write-plane axes (ISSUE 11): admission rates
+    # for both engines, the RLC speedup, and the flush discipline
+    "admissions_per_s_rlc",
+    "admissions_per_s_scalar",
+    "admission_speedup",
+    "rlc_ingest_s",
+    "scalar_ingest_s",
+    "flushes",
+    "fused_groups",
 )
 
 

@@ -19,7 +19,18 @@ hostile chains and injected infrastructure failures:
    verifier worker mid-flush, delays a flush past its deadline, or
    raises transient errors; the hardened pipeline retries, degrades to
    in-line verification, or raises ``PipelineBrokenError`` with exact
-   attribution — never hangs.
+   attribution — never hangs;
+6. **EIP-7251 churn** — consolidations / pending deposits / partial
+   withdrawals across epoch boundaries under the forced columnar pass;
+7. **attester-slashing storm** — equivocating gossip through the
+   operation pool (``pool/``): the equivocation ledger surfaces the
+   ``AttesterSlashing``, block production packs it, and the produced
+   block actually slashes through ``process_attester_slashing``;
+8. **pool spam** — every hostile-gossip lane (malformed SSZ, garbage /
+   wrong-domain signatures, duplicate/subset bitfields, future slots)
+   against both admission engines with exact structured-reason blame;
+   ``run_storm(pool_spam=N)`` runs the same lanes live under rollback
+   traffic.
 
 The assertion core is harness.py: ``run_storm``, ``oracle_replay``,
 ``assert_bit_identical``, ``assert_column_consistency``. Everything is
@@ -27,6 +38,7 @@ host-only and jax-free, like ``pipeline/``.
 """
 
 from .harness import (
+    PoolSpammer,
     StormFailure,
     StormReport,
     assert_bit_identical,
@@ -50,11 +62,15 @@ from .mutators import (
 )
 from .families import (
     FAMILIES,
+    POOL_SPAM_LANES,
+    attester_slashing_storm,
+    build_pool_spam,
     deep_reorg_checkpoint_restore,
     equivocation_traffic,
     fork_boundary_replay,
     infrastructure_faults,
     invalid_block_storm,
+    pool_spam_chaos,
 )
 
 __all__ = [
@@ -62,8 +78,13 @@ __all__ = [
     "FAMILIES",
     "MUTATORS",
     "MutationEnv",
+    "POOL_SPAM_LANES",
+    "PoolSpammer",
     "StormFailure",
     "StormReport",
+    "attester_slashing_storm",
+    "build_pool_spam",
+    "pool_spam_chaos",
     "assert_bit_identical",
     "assert_column_consistency",
     "bad_attestation_signature",

@@ -548,6 +548,244 @@ def eip7251_churn_segment(validator_count: int = 96, epochs: int = 2,
     }
 
 
+# ---------------------------------------------------------------------------
+# family 7 — attester-slashing storm through the operation pool
+# ---------------------------------------------------------------------------
+
+
+def attester_slashing_storm(fork: str = "altair", validator_count: int = 64,
+                            n_blocks: int = 3, equivocations: int = 2,
+                            rlc: "bool | None" = None) -> dict:
+    """Equivocating attestation gossip fed through the WRITE data plane
+    (``pool/``): for each of ``equivocations`` (slot, committee) pairs,
+    the honest head vote AND a properly-signed double vote (same target
+    epoch, different beacon block root) admit through the RLC admission
+    engine; the pool's equivocation ledger must surface an
+    ``AttesterSlashing`` per conflict, block production must pack it,
+    and the produced block must actually SLASH the intersection
+    validators through ``process_attester_slashing`` — replayed through
+    the pipeline bit-identically to the scalar oracle, with the scalar
+    admission twin producing the identical pool and block."""
+    from ..pool import AdmissionEngine, OperationPool, produce_block
+    from ..serving import HeadStore
+
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork(fork, validator_count, "minimal")
+    blocks = cu.produce_chain(state, ctx, n_blocks, fork_name=fork,
+                              atts_per_block=1)
+    ex = Executor(state.copy(), ctx)
+    ex.stream(blocks, policy=FlushPolicy(window_size=2, max_in_flight=2))
+    store = HeadStore()
+    snap = store.publish(ex.state, ctx)
+    head = getattr(ex.state, "data", ex.state)
+
+    # the gossip: honest + double-vote pairs for the newest slots
+    traffic = []
+    for k in range(equivocations):
+        slot = n_blocks - k
+        honest = cu.make_attestation(head, slot, 0, ctx)
+        evil = cu.make_attestation(
+            head, slot, 0, ctx,
+            beacon_block_root=bytes([0x60 + k]) * 32,
+        )
+        traffic.extend((honest, evil))
+
+    def run_engine(use_rlc: bool):
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=4,
+                                 rlc=use_rlc)
+        tickets = [engine.admit_attestation(a.copy()) for a in traffic]
+        engine.settle()
+        return pool, engine, tickets
+
+    pool, engine, tickets = run_engine(rlc if rlc is not None else True)
+    scalar_pool, _, scalar_tickets = run_engine(False)
+    assert [(t.status, t.reason) for t in tickets] == [
+        (t.status, t.reason) for t in scalar_tickets
+    ], "admission verdicts diverge between the RLC and scalar engines"
+    import json as _json
+
+    def view_doc(p):
+        return _json.dumps(
+            [type(a).to_json(a) for a in p.attestations_view()]
+            + [type(s).to_json(s) for s in p.attester_slashings()],
+            sort_keys=True,
+        )
+
+    assert view_doc(pool) == view_doc(scalar_pool), (
+        "pool views diverge between the RLC and scalar engines"
+    )
+    slashings = pool.attester_slashings()
+    assert len(slashings) >= equivocations, (
+        f"pool surfaced {len(slashings)} slashings for "
+        f"{equivocations} equivocations"
+    )
+    expected_slashed = set()
+    for s in slashings:
+        expected_slashed |= set(
+            int(i) for i in s.attestation_1.attesting_indices
+        ) & set(int(i) for i in s.attestation_2.attesting_indices)
+    assert expected_slashed, "surfaced slashings have no intersection"
+
+    # drain the pool into a block — both selection engines agree bit-for-bit
+    produced = produce_block(snap, pool, ctx, randao=cu.make_randao_reveal,
+                             sign=cu.sign_block)
+    produced_scalar = produce_block(snap, scalar_pool, ctx,
+                                    randao=cu.make_randao_reveal,
+                                    sign=cu.sign_block,
+                                    scalar_selection=True)
+    assert bytes(
+        type(produced.message).hash_tree_root(produced.message)
+    ) == bytes(
+        type(produced_scalar.message).hash_tree_root(produced_scalar.message)
+    ), "produced blocks diverge between vectorized and scalar drains"
+    assert len(produced.message.body.attester_slashings) >= 1
+
+    # the slashing EXECUTES: pipeline replay + scalar oracle, bit-identical
+    pipe_ex = Executor(ex.state.copy(), ctx)
+    pipe_ex.stream([produced],
+                   policy=FlushPolicy(window_size=1, max_in_flight=1))
+    oracle_ex, _ = oracle_replay(ex.state, ctx, [produced])
+    assert_bit_identical(pipe_ex.state, oracle_ex.state,
+                         "pool-produced slashing block")
+    final = getattr(oracle_ex.state, "data", oracle_ex.state)
+    slashed = {i for i, v in enumerate(final.validators) if bool(v.slashed)}
+    assert expected_slashed <= slashed, (
+        f"equivocating validators {sorted(expected_slashed - slashed)} "
+        "were not slashed by the produced block"
+    )
+    metrics.counter("scenario.attester_slashing_storm.runs").inc()
+    return {
+        "equivocations": equivocations,
+        "slashings_surfaced": len(slashings),
+        "validators_slashed": sorted(expected_slashed),
+        "block_slot": int(produced.message.slot),
+    }
+
+
+# ---------------------------------------------------------------------------
+# family 8 — spam / garbage ingestion against the pool
+# ---------------------------------------------------------------------------
+
+#: the spam vocabulary: lane name -> the structured reason every
+#: admission engine must reject it with (no silent drops)
+POOL_SPAM_LANES = (
+    ("malformed_ssz", "bits_mismatch"),
+    ("garbage_signature", "malformed"),
+    ("wrong_domain_signature", "signature"),
+    ("duplicate", "duplicate"),
+    ("subset_bits", "subset"),
+    ("future_slot", "future_slot"),
+)
+
+
+def build_pool_spam(attestation, donor_signature: bytes) -> list:
+    """One hostile message per spam lane, derived from a valid
+    PARTIAL-participation ``attestation`` (the honest twin admits first,
+    so ``duplicate`` and ``subset_bits`` actually hit the redundancy
+    path, while ``wrong_domain_signature`` claims a SUPERSET — novel
+    bits, so only the pairing can reject it). Returns
+    ``[(lane, expected_reason, message), ...]`` in feed order."""
+    out = []
+    for lane, reason in POOL_SPAM_LANES:
+        bad = attestation.copy()
+        if lane == "malformed_ssz":
+            bad.aggregation_bits = list(bad.aggregation_bits)[:-1]
+        elif lane == "garbage_signature":
+            bad.signature = b"\x01" * 96  # not a curve point
+            bits = list(bad.aggregation_bits)
+            if False in bits:  # novel bits so the parse (not the
+                bits[bits.index(False)] = True  # dedup) rejects it
+                bad.aggregation_bits = bits
+        elif lane == "wrong_domain_signature":
+            # a VALID G2 point over the wrong message, claiming novel
+            # bits: survives every structural and redundancy check, dies
+            # only at the (batched) pairing
+            bad.signature = bytes(donor_signature)
+            bad.aggregation_bits = [True] * len(bad.aggregation_bits)
+        elif lane == "duplicate":
+            pass  # the honest twin already admitted
+        elif lane == "subset_bits":
+            bits = list(bad.aggregation_bits)
+            set_positions = [i for i, b in enumerate(bits) if b]
+            if len(set_positions) > 1:
+                bits[set_positions[-1]] = False
+            bad.aggregation_bits = bits
+        elif lane == "future_slot":
+            bad.data.slot = int(bad.data.slot) + 10_000
+        out.append((lane, reason, bad))
+    return out
+
+
+def pool_spam_chaos(fork: str = "altair", validator_count: int = 64,
+                    n_blocks: int = 3) -> dict:
+    """Every spam lane against a pinned head snapshot, through BOTH
+    admission engines: each lane must reject with its declared
+    structured reason (counter + one-shot trace event), the honest twin
+    must admit, verdicts must match between the RLC and scalar engines,
+    and admitted + rejected must account for every fed message."""
+    from ..pool import AdmissionEngine, OperationPool
+    from ..serving import HeadStore
+
+    cu = _chain_utils()
+    state, ctx = cu.fresh_genesis_fork(fork, validator_count, "minimal")
+    blocks = cu.produce_chain(state, ctx, n_blocks, fork_name=fork,
+                              atts_per_block=1)
+    ex = Executor(state.copy(), ctx)
+    for block in blocks:
+        ex.apply_block(block)
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    head = getattr(ex.state, "data", ex.state)
+    honest = cu.make_attestation(head, n_blocks, 0, ctx, participation=0.5)
+    spam = build_pool_spam(honest, bytes(blocks[-1].signature))
+
+    outcomes = {}
+    for use_rlc in (True, False):
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=3,
+                                 rlc=use_rlc)
+        fed = 1 + len(spam)
+        honest_ticket = engine.admit_attestation(honest.copy())
+        lane_tickets = [
+            (lane, reason, engine.admit_attestation(message.copy()))
+            for lane, reason, message in spam
+        ]
+        engine.settle()
+        assert honest_ticket.status == "admitted", (
+            f"honest twin rejected: {honest_ticket.reason}"
+        )
+        resolved = [honest_ticket] + [t for _, _, t in lane_tickets]
+        assert all(t.status in ("admitted", "rejected") for t in resolved), (
+            "a ticket never settled — silent drop"
+        )
+        admitted = sum(1 for t in resolved if t.status == "admitted")
+        rejected = sum(1 for t in resolved if t.status == "rejected")
+        assert admitted + rejected == fed, "message accounting leaked"
+        for lane, expected_reason, ticket in lane_tickets:
+            assert ticket.status == "rejected" and (
+                ticket.reason == expected_reason
+            ), (
+                f"lane {lane}: expected rejection {expected_reason!r}, "
+                f"got ({ticket.status}, {ticket.reason})"
+            )
+        outcomes["rlc" if use_rlc else "scalar"] = {
+            "admitted": admitted,
+            "rejected": rejected,
+            "engine_rlc": engine.rlc,
+        }
+    assert (
+        outcomes["rlc"]["admitted"] == outcomes["scalar"]["admitted"]
+        and outcomes["rlc"]["rejected"] == outcomes["scalar"]["rejected"]
+    ), f"engines diverge: {outcomes}"
+    for _, reason in POOL_SPAM_LANES:
+        assert metrics.counter(f"pool.rejected.{reason}").value() >= 2, (
+            f"pool.rejected.{reason} not counted for both engines"
+        )
+    metrics.counter("scenario.pool_spam.runs").inc()
+    return outcomes
+
+
 FAMILIES = {
     "fork_boundary": fork_boundary_replay,
     "storm": invalid_block_storm,
@@ -555,4 +793,6 @@ FAMILIES = {
     "reorg": deep_reorg_checkpoint_restore,
     "faults": infrastructure_faults,
     "eip7251_churn": eip7251_churn_segment,
+    "attester_slashing_storm": attester_slashing_storm,
+    "pool_spam": pool_spam_chaos,
 }

@@ -50,6 +50,7 @@ __all__ = [
     "StormReport",
     "StormFailure",
     "ReaderSwarm",
+    "PoolSpammer",
 ]
 
 
@@ -252,7 +253,7 @@ class StormFailure:
 
 class StormReport:
     __slots__ = ("failures", "blocks_applied", "wall_s", "stats_snapshots",
-                 "reader_samples", "reader_roots")
+                 "reader_samples", "reader_roots", "pool_spam")
 
     def __init__(self):
         self.failures: list[StormFailure] = []
@@ -263,6 +264,9 @@ class StormReport:
         # response samples and the distinct snapshot roots they pinned
         self.reader_samples = 0
         self.reader_roots = 0
+        # pool-spam accounting (run_storm(pool_spam=N)): fed/admitted
+        # counts + per-reason rejection tallies, no silent drops
+        self.pool_spam: "dict | None" = None
 
     @property
     def recovery_latencies(self) -> list:
@@ -389,9 +393,105 @@ class ReaderSwarm:
         return len(roots)
 
 
+class PoolSpammer:
+    """The pool-spam mutator lane of ``run_storm``: a background thread
+    feeding hostile gossip (every ``families.POOL_SPAM_LANES`` shape,
+    derived from the honest chain's own attestations) into an admission
+    engine whose head tracks the storm's committed snapshots.
+
+    The contract is ACCOUNTING, not geometry — the head rotates under
+    the spammer, so which structured reason fires for a given message
+    depends on timing; what may never happen is a silent drop: every fed
+    message must settle ``admitted`` or ``rejected`` with a reason from
+    the taxonomy, each rejection counted (``pool.rejected.{reason}``)
+    with its one-shot trace event. (``families.pool_spam_chaos`` pins
+    the head and asserts the exact per-lane reasons.)"""
+
+    def __init__(self, store, context, blocks, rounds: int):
+        from ..pool import AdmissionEngine, OperationPool
+
+        self._lock = threading.Lock()
+        self._store = store
+        self._blocks = blocks
+        self._rounds = int(rounds)
+        self._stop = False
+        self.pool = OperationPool()
+        self.engine = AdmissionEngine(self.pool, store, context,
+                                      window_size=8)
+        self.tickets: list = []
+        self._pool_exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pool-spammer"
+        )
+        self._future = self._pool_exec.submit(self._spam_loop)
+
+    def _should_stop(self) -> bool:
+        with self._lock:
+            return self._stop
+
+    def _spam_loop(self) -> None:
+        from .families import build_pool_spam
+
+        t0 = time.perf_counter()
+        while self._store.head is None:
+            if self._should_stop() or time.perf_counter() - t0 > 60:
+                return
+            time.sleep(0.01)
+        donors = [
+            (block.message.body.attestations[0].copy(),
+             bytes(block.signature))
+            for block in self._blocks
+            if len(block.message.body.attestations)
+        ]
+        fed = 0
+        for round_index in range(self._rounds):
+            if self._should_stop():
+                break
+            honest, donor_sig = donors[round_index % len(donors)]
+            tickets = [self.engine.admit_attestation(honest.copy())]
+            for _lane, _reason, message in build_pool_spam(
+                honest, donor_sig
+            ):
+                if self._should_stop():
+                    break
+                tickets.append(self.engine.admit_attestation(message))
+            fed += len(tickets)
+            with self._lock:
+                self.tickets.extend(tickets)
+        self.engine.settle()
+
+    def stop(self) -> dict:
+        """Join the spammer and return the accounting summary; raises if
+        any message dropped silently."""
+        with self._lock:
+            self._stop = True
+        self._future.result(timeout=120)
+        self.engine.settle()
+        self._pool_exec.shutdown(wait=True)
+        with self._lock:
+            tickets = list(self.tickets)
+        unsettled = [t for t in tickets if t.status == "pending"]
+        assert not unsettled, (
+            f"{len(unsettled)} spam messages never settled — silent drop"
+        )
+        rejected: dict = {}
+        for t in tickets:
+            if t.status == "rejected":
+                rejected[t.reason] = rejected.get(t.reason, 0) + 1
+        from ..pool import REASONS
+
+        unknown = set(rejected) - set(REASONS)
+        assert not unknown, f"rejections outside the taxonomy: {unknown}"
+        admitted = sum(1 for t in tickets if t.status == "admitted")
+        assert admitted + sum(rejected.values()) == len(tickets), (
+            "spam accounting leaked a message"
+        )
+        return {"fed": len(tickets), "admitted": admitted,
+                "rejected": rejected}
+
+
 def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
               fault_injector=None, check_states=True, check_columns=True,
-              serve_port=None, readers: int = 0):
+              serve_port=None, readers: int = 0, pool_spam: int = 0):
     """Replay a storm-corrupted chain through the pipeline with recovery
     after every failure, asserting the full contract at each one.
 
@@ -422,6 +522,15 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
     engine-internal rollback already ran inside the raising submit; the
     measured tail is the verification + snapshot cost of coming back).
 
+    ``pool_spam``: N > 0 runs the pool-spam mutator lane: a background
+    ``PoolSpammer`` feeds N rounds of hostile gossip (malformed SSZ,
+    garbage and wrong-domain signatures, duplicate/subset bitfields,
+    future-slot attestations — ``families.POOL_SPAM_LANES``) into an
+    admission engine tracking the storm's committed heads, THROUGH the
+    rollbacks and recoveries. Every message must settle with a
+    structured outcome — ``report.pool_spam`` carries the accounting and
+    the per-reason rejection tallies; a silent drop asserts.
+
     ``readers``: N > 0 spawns the concurrent-reader chaos swarm
     (``ReaderSwarm``): the serving data plane (serving/handlers.py over
     a pipeline-fed ``HeadStore``) is mounted on the storm's server and N
@@ -440,7 +549,7 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
     if readers and serve_port is None:
         serve_port = 0  # chaos readers need a wire to hammer
     server = None
-    store = swarm = None
+    store = swarm = spammer = None
     if serve_port is not None:
         from ..telemetry.server import IntrospectionServer
 
@@ -451,10 +560,22 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
             store = HeadStore().attach()
             server.mount(BeaconDataPlane(store))
             swarm = ReaderSwarm(server.url(), n_readers=readers)
+    if pool_spam:
+        if store is None:
+            from ..serving import HeadStore
+
+            store = HeadStore().attach()
+        spammer = PoolSpammer(store, context, blocks, pool_spam)
     try:
         report, ex = _run_storm(pre_state, context, blocks, plan, policy,
                                 sign, fault_injector, check_states,
                                 check_columns)
+        if spammer is not None:
+            report.pool_spam = spammer.stop()
+            spammer = None
+            metrics.counter("scenario.pool_spam.messages").inc(
+                report.pool_spam["fed"]
+            )
         if swarm is not None:
             swarm.stop()
             # committed-position oracle: the scalar state AFTER each
@@ -475,6 +596,8 @@ def run_storm(pre_state, context, blocks, plan, policy=None, sign=None,
             )
         return report, ex
     finally:
+        if spammer is not None:
+            spammer.stop()
         if swarm is not None:
             swarm.stop()
         if store is not None:

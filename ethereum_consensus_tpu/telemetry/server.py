@@ -308,15 +308,26 @@ class _Handler(BaseHTTPRequestHandler):
         return values[0] if values else default
 
     def _try_apps(self, method: str, route: str, params: dict, body) -> bool:
-        """Route into a mounted app (the serving data plane) when one
-        claims the path prefix; apps return (status, JSON document) and
-        never raise. False → no app claimed the route."""
+        """Route into a mounted app when one claims the path; apps
+        return (status, JSON document) and never raise. An app claims
+        with ``prefix`` (one string) or ``prefixes`` (several); the
+        LONGEST matching prefix across every mounted app wins, so the
+        pool plane's ``/eth/v1/beacon/pool/...`` routes past the read
+        plane's broader ``/eth/`` claim regardless of mount order.
+        False → no app claimed the route."""
+        best = None  # (prefix length, app)
         for app in getattr(self.server, "apps", ()):
-            if route.startswith(app.prefix):
-                status, doc = app.handle(method, route, params, body)
-                self._send_json(doc, status=status)
-                return True
-        return False
+            prefixes = getattr(app, "prefixes", None) or (app.prefix,)
+            for prefix in prefixes:
+                if route.startswith(prefix) and (
+                    best is None or len(prefix) > best[0]
+                ):
+                    best = (len(prefix), app)
+        if best is None:
+            return False
+        status, doc = best[1].handle(method, route, params, body)
+        self._send_json(doc, status=status)
+        return True
 
     # -- routes --------------------------------------------------------------
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract

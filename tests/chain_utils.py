@@ -284,9 +284,13 @@ def sign_block(state, block, context) -> bytes:
     return secret_key(block.proposer_index).sign(root).to_bytes()
 
 
-def make_attestation(state, slot: int, index: int, context, participation=1.0):
+def make_attestation(state, slot: int, index: int, context, participation=1.0,
+                     beacon_block_root=None):
     """A valid attestation for (slot, committee index) on ``state`` (which
-    must be at a slot where [slot]'s data is known, i.e. state.slot >= slot)."""
+    must be at a slot where [slot]'s data is known, i.e. state.slot >= slot).
+    ``beacon_block_root`` overrides the honest head vote — a PROPERLY
+    SIGNED equivocation (same slot/committee/target, different data): the
+    attester-slashing scenario's double-vote half."""
     ns = build(context.preset)
     committee = h.get_beacon_committee(state, slot, index, context)
     epoch = slot // context.SLOTS_PER_EPOCH
@@ -298,7 +302,11 @@ def make_attestation(state, slot: int, index: int, context, participation=1.0):
     data = ns.AttestationData(
         slot=slot,
         index=index,
-        beacon_block_root=_block_root_at_or_latest(state, slot),
+        beacon_block_root=(
+            _block_root_at_or_latest(state, slot)
+            if beacon_block_root is None
+            else bytes(beacon_block_root)
+        ),
         source=source,
         target=ns.Checkpoint(
             epoch=epoch, root=_block_root_at_or_latest(state, start_slot)

@@ -1,0 +1,652 @@
+"""Operation pool + write data plane (pool/): admission window
+geometries, RLC-vs-scalar bit-identity (views, selection, rejection
+reasons), client round-trips, pool-drain block production, and the
+attester-slashing/spam scenario families (docs/POOL.md).
+"""
+
+import json
+import random
+import sys
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import chain_utils as cu  # noqa: E402
+
+from ethereum_consensus_tpu.api.client import Client  # noqa: E402
+from ethereum_consensus_tpu.api.errors import ApiError  # noqa: E402
+from ethereum_consensus_tpu.executor import Executor  # noqa: E402
+from ethereum_consensus_tpu.pipeline import FlushPolicy  # noqa: E402
+from ethereum_consensus_tpu.pool import (  # noqa: E402
+    AdmissionEngine,
+    AggregateGroup,
+    OperationPool,
+    PoolDataPlane,
+    produce_block,
+    select_aggregates,
+)
+from ethereum_consensus_tpu.pool.store import (  # noqa: E402
+    bits_to_int,
+    pack_bits,
+)
+from ethereum_consensus_tpu.scenarios import (  # noqa: E402
+    attester_slashing_storm,
+    oracle_replay,
+    pool_spam_chaos,
+)
+from ethereum_consensus_tpu.scenarios.harness import (  # noqa: E402
+    assert_bit_identical,
+)
+from ethereum_consensus_tpu.serving import (  # noqa: E402
+    BeaconDataPlane,
+    HeadStore,
+)
+from ethereum_consensus_tpu.telemetry import metrics  # noqa: E402
+from ethereum_consensus_tpu.telemetry.server import (  # noqa: E402
+    IntrospectionServer,
+)
+
+np = pytest.importorskip("numpy")
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def altair_head():
+    """(executor at head, context, store with one published snapshot,
+    honest chain blocks) on a short altair chain."""
+    state, ctx = cu.fresh_genesis_fork("altair", 64, "minimal")
+    blocks = cu.produce_chain(state, ctx, 3, fork_name="altair",
+                              atts_per_block=1)
+    ex = Executor(state.copy(), ctx)
+    for block in blocks:
+        ex.apply_block(block)
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    return ex, ctx, store, blocks
+
+
+def _traffic(head, ctx, slots=(2, 3), participations=(0.5, 1.0)):
+    """Deterministic gossip-shaped attestation traffic: one aggregate
+    per (slot, participation)."""
+    out = []
+    for slot in slots:
+        for p in participations:
+            out.append(cu.make_attestation(head, slot, 0, ctx,
+                                           participation=p))
+    return out
+
+
+def _view_doc(pool):
+    return json.dumps(
+        [type(a).to_json(a) for a in pool.attestations_view()],
+        sort_keys=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bitfield store + selection differentials
+# ---------------------------------------------------------------------------
+
+
+def test_pack_bits_matches_int_masks():
+    rng = random.Random(0xB17)
+    for width in (1, 7, 64, 65, 130, 513):
+        bits = [rng.random() < 0.4 for _ in range(width)]
+        packed = pack_bits(bits)
+        assert packed.dtype == np.uint64
+        as_int = 0
+        for w, word in enumerate(packed.tolist()):
+            as_int |= int(word) << (64 * w)
+        assert as_int == bits_to_int(bits)
+
+
+def test_group_classify_differential_randomized():
+    """The vectorized duplicate/subset classifier agrees with the scalar
+    twin over random insert sequences."""
+    rng = random.Random(0x5E1)
+    for width in (8, 64, 100):
+        group = AggregateGroup(1, 0, b"\x00" * 32, width)
+        twin = AggregateGroup(1, 0, b"\x00" * 32, width)
+        for step in range(40):
+            bits = [rng.random() < 0.5 for _ in range(width)]
+            if not any(bits):
+                bits[0] = True
+            vec = group.classify(bits)
+            sca = twin.classify(bits, scalar=True)
+            assert vec == sca, f"width {width} step {step}: {vec} != {sca}"
+            if vec == "new":
+                group.insert(bits, b"\xaa", None)
+                twin.insert(bits, b"\xaa", None)
+        assert group.n == twin.n
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_selection_differential_randomized(seed):
+    """Vectorized greedy packing == the brute-force scalar packer: same
+    picks, same order, over random multi-group pools."""
+    rng = random.Random(seed)
+    groups = []
+    for g in range(rng.randint(2, 6)):
+        width = rng.choice((8, 63, 64, 65, 120))
+        group = AggregateGroup(g + 1, g % 3, bytes([g]) * 32, width)
+        for _ in range(rng.randint(1, 12)):
+            bits = [rng.random() < rng.uniform(0.2, 0.9)
+                    for _ in range(width)]
+            if not any(bits):
+                bits[0] = True
+            if group.classify(bits) == "new":
+                group.insert(bits, b"\xbb", None)
+        groups.append(group)
+    for cap in (1, 3, 128):
+        vec = select_aggregates(groups, cap)
+        sca = select_aggregates(groups, cap, scalar=True)
+        assert [(id(g), r) for g, r in vec] == [
+            (id(g), r) for g, r in sca
+        ], f"seed {seed} cap {cap}: selection diverges"
+
+
+def test_selection_greedy_skips_redundant_rows():
+    group = AggregateGroup(1, 0, b"\x01" * 32, 8)
+    group.insert([True, True, False, False, False, False, False, False],
+                 b"s1", None)
+    group.insert([True, True, True, True, True, False, False, False],
+                 b"s2", None)
+    group.insert([False, False, False, False, False, True, True, True],
+                 b"s3", None)
+    picks = select_aggregates([group], 10)
+    # the 5-bit row first, the disjoint 3-bit row second; the 2-bit row
+    # adds nothing over their union and must never be picked
+    assert [r for _, r in picks] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# admission: geometries, parity, blame
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 3, 64])
+def test_admission_window_geometry(altair_head, window):
+    """Exactly one RLC flush per admission window, and the settled pool
+    is bit-identical to the scalar twin's regardless of geometry."""
+    ex, ctx, store, _ = altair_head
+    head = ex.state.data
+    traffic = _traffic(head, ctx)
+
+    scalar_pool = OperationPool()
+    scalar_engine = AdmissionEngine(scalar_pool, store, ctx,
+                                    window_size=window, rlc=False)
+    for att in traffic:
+        scalar_engine.admit_attestation(att.copy())
+
+    pool = OperationPool()
+    engine = AdmissionEngine(pool, store, ctx, window_size=window, rlc=True)
+    if not engine.rlc:
+        pytest.skip("native backend unavailable — no RLC admission")
+    flushes_before = metrics.counter("pool.flushes").value()
+    tickets = [engine.admit_attestation(att.copy()) for att in traffic]
+    full_windows = len(traffic) // window
+    assert metrics.counter("pool.flushes").value() - flushes_before == (
+        full_windows
+    ), "a full admission window did not flush exactly once"
+    engine.settle()
+    total = metrics.counter("pool.flushes").value() - flushes_before
+    expected = full_windows + (1 if len(traffic) % window else 0)
+    assert total == expected, (
+        f"window {window}: {total} flushes for {len(traffic)} messages"
+    )
+    assert all(t.status == "admitted" for t in tickets)
+    assert _view_doc(pool) == _view_doc(scalar_pool)
+
+
+def test_rlc_split_blames_only_the_bad_signature(altair_head):
+    """A wrong-message signature inside a window of good aggregates:
+    the fused set fails, the split re-verifies members, and ONLY the
+    offender rejects — same verdicts as the scalar twin."""
+    ex, ctx, store, blocks = altair_head
+    head = ex.state.data
+    good = [
+        cu.make_attestation(head, 3, 0, ctx, participation=0.5),
+        cu.make_attestation(head, 3, 0, ctx, participation=1.0),
+    ]
+    bad = cu.make_attestation(head, 2, 0, ctx)
+    bad.signature = bytes(blocks[-1].signature)  # valid point, wrong msg
+    traffic = [good[0], bad, good[1]]
+
+    outcomes = {}
+    for rlc in (True, False):
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=3, rlc=rlc)
+        if rlc and not engine.rlc:
+            pytest.skip("native backend unavailable")
+        splits_before = metrics.counter("pool.flush_splits").value()
+        tickets = [engine.admit_attestation(a.copy()) for a in traffic]
+        engine.settle()
+        outcomes[rlc] = [(t.status, t.reason) for t in tickets]
+        if rlc:
+            assert metrics.counter("pool.flush_splits").value() > (
+                splits_before
+            ), "the failing window never split for blame"
+        assert outcomes[rlc] == [
+            ("admitted", None),
+            ("rejected", "signature"),
+            ("admitted", None),
+        ]
+    assert outcomes[True] == outcomes[False]
+
+
+def test_spam_lanes_reject_with_exact_reasons():
+    """The spam/garbage chaos family: every lane's declared structured
+    reason, both engines, counters + accounting (no silent drops)."""
+    outcomes = pool_spam_chaos()
+    assert outcomes["rlc"]["admitted"] == 1
+    assert outcomes["rlc"]["rejected"] == 6
+
+
+def test_signing_root_fast_path_matches_spec(altair_head):
+    """The admission engine computes attestation signing roots as
+    hash(data_root || domain) — assert it equals the spec's
+    compute_signing_root over SigningData for real data."""
+    import hashlib
+
+    from ethereum_consensus_tpu.domains import DomainType
+    from ethereum_consensus_tpu.models.phase0 import helpers as h
+    from ethereum_consensus_tpu.signing import compute_signing_root
+
+    ex, ctx, _store, _ = altair_head
+    head = ex.state.data
+    att = cu.make_attestation(head, 3, 0, ctx)
+    data = att.data
+    domain = bytes(
+        h.get_domain(head, DomainType.BEACON_ATTESTER,
+                     int(data.target.epoch), ctx)
+    )
+    spec_root = bytes(compute_signing_root(type(data), data, domain))
+    data_root = bytes(type(data).hash_tree_root(data))
+    fast_root = hashlib.sha256(data_root + domain).digest()
+    assert fast_root == spec_root
+
+
+def test_no_head_rejection():
+    state, ctx = cu.fresh_genesis_fork("altair", 64, "minimal")
+    pool = OperationPool()
+    engine = AdmissionEngine(pool, HeadStore(), ctx, rlc=False)
+    att = cu.make_attestation(state, 0, 0, ctx)
+    ticket = engine.admit_attestation(att)
+    assert (ticket.status, ticket.reason) == ("rejected", "no_head")
+
+
+def test_voluntary_exit_admission_and_parity(altair_head):
+    """Exit gossip through the fork's own processor on the snapshot
+    scratch: valid exit admits (both engines), duplicate rejects,
+    bogus-index rejects as invalid."""
+    ex, ctx, store, _ = altair_head
+    ns = __import__(
+        "ethereum_consensus_tpu.models.altair", fromlist=["build"]
+    ).build(ctx.preset)
+    from ethereum_consensus_tpu.domains import DomainType
+    from ethereum_consensus_tpu.models.phase0 import helpers as h
+    from ethereum_consensus_tpu.signing import compute_signing_root
+
+    head = ex.state.data
+    saved = ctx.shard_committee_period
+    ctx.shard_committee_period = 0  # genesis validators are young
+    try:
+        exit_message = ns.VoluntaryExit(epoch=0, validator_index=7)
+        domain = h.get_domain(head, DomainType.VOLUNTARY_EXIT, 0, ctx)
+        root = compute_signing_root(ns.VoluntaryExit, exit_message, domain)
+        signed = ns.SignedVoluntaryExit(
+            message=exit_message,
+            signature=cu.secret_key(7).sign(root).to_bytes(),
+        )
+        bogus = ns.SignedVoluntaryExit(
+            message=ns.VoluntaryExit(epoch=0, validator_index=2**31),
+            signature=signed.signature,
+        )
+        for rlc in (True, False):
+            pool = OperationPool()
+            engine = AdmissionEngine(pool, store, ctx, window_size=4,
+                                     rlc=rlc)
+            t1 = engine.admit_voluntary_exit(signed.copy())
+            t2 = engine.admit_voluntary_exit(bogus.copy())
+            engine.settle()
+            assert (t1.status, t2.status, t2.reason) == (
+                "admitted", "rejected", "invalid"
+            ), f"rlc={rlc}"
+            t3 = engine.admit_voluntary_exit(signed.copy())
+            engine.settle()
+            assert (t3.status, t3.reason) == ("rejected", "duplicate")
+            assert len(pool.voluntary_exits()) == 1
+    finally:
+        ctx.shard_committee_period = saved
+
+
+def test_electra_attestation_roundtrip():
+    """EIP-7549 committee-bits attestations admit through both engines
+    and round-trip the pool view bit-identically."""
+    state, ctx = cu.fresh_genesis_fork("electra", 64, "minimal")
+    blocks = cu.produce_chain(state, ctx, 2, fork_name="electra",
+                              atts_per_block=0)
+    ex = Executor(state.copy(), ctx)
+    for block in blocks:
+        ex.apply_block(block)
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    att = cu.make_attestation_electra(ex.state.data, 2, ctx)
+    views = {}
+    for rlc in (True, False):
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=2, rlc=rlc)
+        ticket = engine.admit_attestation(att.copy())
+        engine.settle()
+        assert ticket.status == "admitted", (rlc, ticket.reason)
+        views[rlc] = _view_doc(pool)
+    assert views[True] == views[False]
+    assert json.loads(views[True]) == [type(att).to_json(att)]
+
+
+# ---------------------------------------------------------------------------
+# the wire: client round-trips, block publication, /pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def served_pool(altair_head):
+    ex, ctx, store, blocks = altair_head
+    pool = OperationPool()
+    engine = AdmissionEngine(pool, store, ctx, window_size=4)
+    publish_ex = Executor(ex.state.copy(), ctx)
+
+    def submit(block):
+        publish_ex.apply_block(block)
+        store.publish(publish_ex.state, ctx)
+
+    server = IntrospectionServer(port=0).start(start_flight=False)
+    server.mount(BeaconDataPlane(store))
+    server.mount(PoolDataPlane(engine, submit=submit))
+    try:
+        yield publish_ex, ctx, store, pool, engine, server
+    finally:
+        pool.clear()
+        server.stop()
+
+
+@pytest.mark.pool_smoke
+def test_client_roundtrip_bit_identity(served_pool):
+    """POST→GET through api/client.py: the served pool views are
+    bit-identical to the scalar-twin pool fed the same messages."""
+    publish_ex, ctx, store, pool, engine, server = served_pool
+    head = publish_ex.state.data
+    client = Client(server.url().rstrip("/"))
+    traffic = _traffic(head, ctx, slots=(2, 3))
+    client.post_attestations([type(a).to_json(a) for a in traffic])
+
+    scalar_pool = OperationPool()
+    scalar_engine = AdmissionEngine(scalar_pool, store, ctx, rlc=False)
+    for att in traffic:
+        scalar_engine.admit_attestation(att.copy())
+
+    served = client.get_attestations_from_pool()
+    expect = [type(a).to_json(a) for a in scalar_pool.attestations_view()]
+    assert json.dumps(served, sort_keys=True) == json.dumps(
+        expect, sort_keys=True
+    )
+    one_slot = client.get_attestations_from_pool(slot=3, committee_index=0)
+    assert all(row["data"]["slot"] == "3" for row in one_slot)
+    assert len(one_slot) == 2
+
+    # rejected items surface per-index in the standard failure envelope
+    with pytest.raises(ApiError) as excinfo:
+        client.post_attestations(
+            [type(traffic[0]).to_json(traffic[0]), {"nonsense": "1"}]
+        )
+    assert "duplicate" in str(excinfo.value)
+    assert "malformed" in str(excinfo.value)
+
+
+@pytest.mark.pool_smoke
+def test_block_publication_roundtrip(served_pool):
+    publish_ex, ctx, store, pool, engine, server = served_pool
+    client = Client(server.url().rstrip("/"))
+    head_slot = int(store.head.slot)
+    signed = cu.produce_block_fork(
+        "altair", publish_ex.state.data.copy(), head_slot + 1, ctx
+    )
+    client.post_signed_beacon_block_v2(type(signed).to_json(signed), "altair")
+    assert int(store.head.slot) == head_slot + 1
+
+    bad = signed.copy()
+    bad.message.state_root = b"\x13" * 32
+    with pytest.raises(ApiError):
+        client.post_signed_beacon_block_v2(type(bad).to_json(bad), "altair")
+    assert int(store.head.slot) == head_slot + 1
+
+
+def test_pool_endpoint_introspection(served_pool):
+    publish_ex, ctx, store, pool, engine, server = served_pool
+    head = publish_ex.state.data
+    ticket = engine.admit_attestation(cu.make_attestation(head, 3, 0, ctx))
+    engine.settle()
+    assert ticket.status == "admitted"
+    with urllib.request.urlopen(server.url("/pool"), timeout=10) as response:
+        doc = json.loads(response.read())
+    assert doc["counts"]["attestation_rows"] >= 1
+    assert doc["admission"]["window_size"] == 4
+    assert "flushes" in doc and "rejected" in doc
+
+
+def test_exit_and_slashing_post_roundtrip(served_pool):
+    """Singleton-op POST/GET round-trips through the client: a surfaced
+    attester slashing serves back bit-identically."""
+    publish_ex, ctx, store, pool, engine, server = served_pool
+    head = publish_ex.state.data
+    honest = cu.make_attestation(head, 3, 0, ctx)
+    evil = cu.make_attestation(head, 3, 0, ctx,
+                               beacon_block_root=b"\x61" * 32)
+    client = Client(server.url().rstrip("/"))
+    client.post_attestations(
+        [type(honest).to_json(honest), type(evil).to_json(evil)]
+    )
+    slashings = client.get_attester_slashings_from_pool()
+    assert len(slashings) == 1
+    expect = pool.attester_slashings()[0]
+    assert json.dumps(slashings[0], sort_keys=True) == json.dumps(
+        type(expect).to_json(expect), sort_keys=True
+    )
+    # and the surfaced slashing re-posts as a no-op duplicate
+    with pytest.raises(ApiError) as excinfo:
+        client.post_attester_slashing(slashings[0])
+    assert "duplicate" in str(excinfo.value)
+
+
+# ---------------------------------------------------------------------------
+# production + the families
+# ---------------------------------------------------------------------------
+
+
+def test_produce_block_replays_bit_identically(altair_head):
+    """Pool-drain production: the produced block replays through the
+    pipeline AND the scalar oracle to the same state, and the scalar
+    pool + scalar selection produce the IDENTICAL block."""
+    ex, ctx, _shared_store, _ = altair_head
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    head = ex.state.data
+    traffic = _traffic(head, ctx, slots=(2, 3))
+    drains = {}
+    for rlc in (True, False):
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=4, rlc=rlc)
+        for att in traffic:
+            engine.admit_attestation(att.copy())
+        engine.settle()
+        drains[rlc] = produce_block(
+            store.head, pool, ctx, randao=cu.make_randao_reveal,
+            sign=cu.sign_block, scalar_selection=not rlc,
+        )
+    root_vec = type(drains[True].message).hash_tree_root(
+        drains[True].message
+    )
+    root_sca = type(drains[False].message).hash_tree_root(
+        drains[False].message
+    )
+    assert bytes(root_vec) == bytes(root_sca)
+    produced = drains[True]
+    assert len(produced.message.body.attestations) >= 2
+
+    pipe_ex = Executor(ex.state.copy(), ctx)
+    pipe_ex.stream([produced], policy=FlushPolicy(window_size=1))
+    oracle_ex, _ = oracle_replay(ex.state, ctx, [produced])
+    assert_bit_identical(pipe_ex.state, oracle_ex.state,
+                         "pool-drain production")
+
+
+def test_produce_block_deneb_with_payload_extras():
+    """Execution-payload forks produce through the body_extras seam."""
+    state, ctx = cu.fresh_genesis_fork("deneb", 64, "minimal")
+    blocks = cu.produce_chain(state, ctx, 2, fork_name="deneb",
+                              atts_per_block=1)
+    ex = Executor(state.copy(), ctx)
+    for block in blocks:
+        ex.apply_block(block)
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    head = ex.state.data
+    pool = OperationPool()
+    engine = AdmissionEngine(pool, store, ctx, window_size=2)
+    ticket = engine.admit_attestation(cu.make_attestation(head, 2, 0, ctx))
+    engine.settle()
+    assert ticket.status == "admitted"
+
+    def extras(state, slot, context):
+        return {
+            "execution_payload": cu.make_execution_payload_fork(
+                "deneb", state, context, block_number=slot
+            ),
+            "sync_aggregate": cu.make_sync_aggregate(state, context),
+        }
+
+    produced = produce_block(
+        store.head, pool, ctx, randao=cu.make_randao_reveal,
+        sign=cu.sign_block, body_extras=extras,
+    )
+    assert len(produced.message.body.attestations) == 1
+    pipe_ex = Executor(ex.state.copy(), ctx)
+    pipe_ex.stream([produced], policy=FlushPolicy(window_size=1))
+    oracle_ex, _ = oracle_replay(ex.state, ctx, [produced])
+    assert_bit_identical(pipe_ex.state, oracle_ex.state,
+                         "deneb pool production")
+
+
+def test_prune_included_and_expired(altair_head):
+    ex, ctx, _shared_store, _ = altair_head
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    head = ex.state.data
+    pool = OperationPool()
+    engine = AdmissionEngine(pool, store, ctx, window_size=2)
+    for att in _traffic(head, ctx, slots=(2, 3)):
+        engine.admit_attestation(att.copy())
+    engine.settle()
+    assert pool.counts()["attestation_groups"] == 2
+    produced = produce_block(store.head, pool, ctx,
+                             randao=cu.make_randao_reveal,
+                             sign=cu.sign_block)
+    pool.prune_included(produced.message.body)
+    assert pool.counts()["attestation_groups"] == 0
+
+    for att in _traffic(head, ctx, slots=(2,)):
+        engine.admit_attestation(att.copy())
+    engine.settle()
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    dropped = pool.prune_expired(2 + spe + 1, spe)
+    assert dropped == 1
+    assert pool.counts()["attestation_groups"] == 0
+
+
+@pytest.mark.pool_smoke
+def test_attester_slashing_storm_family():
+    """The acceptance family: equivocations through the pool surface a
+    slashing that EXECUTES through process_attester_slashing in a
+    produced, pipeline-replayed, oracle-identical block."""
+    out = attester_slashing_storm()
+    assert out["slashings_surfaced"] >= out["equivocations"]
+    assert out["validators_slashed"], "nobody was slashed"
+
+
+def test_run_storm_pool_spam_lane():
+    """The pool-spam mutator lane rides a real storm: full accounting,
+    no silent drops, reasons inside the taxonomy."""
+    from ethereum_consensus_tpu.scenarios import plan_storm, run_storm
+
+    state, ctx = cu.fresh_genesis_fork("deneb", 64, "minimal")
+    blocks = cu.produce_chain(state, ctx, 6, fork_name="deneb",
+                              atts_per_block=1)
+    plan = plan_storm(6, 0.2, random.Random(11))
+    report, _ = run_storm(state, ctx, blocks, plan, sign=cu.sign_block,
+                          pool_spam=2)
+    assert report.pool_spam is not None
+    assert report.pool_spam["fed"] == 2 * 7  # honest + 6 lanes per round
+    assert report.pool_spam["admitted"] + sum(
+        report.pool_spam["rejected"].values()
+    ) == report.pool_spam["fed"]
+
+
+# ---------------------------------------------------------------------------
+# scale: 2^17 ingest under concurrent readers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pool_ingest_2e17_under_concurrent_readers():
+    """The bench shape as a test: admit the mainnet-bundle's aggregate
+    traffic at the 2^17 registry through the RLC window while a reader
+    swarm hammers the read plane off the same store — all admitted, one
+    flush per window, views identical to the scalar twin."""
+    from ethereum_consensus_tpu.scenarios.harness import ReaderSwarm
+
+    validators, n_blocks, atts = 1 << 17, 16, 8
+    state, ctx, blocks = cu.mainnet_chain_bundle(
+        "deneb", validators, n_blocks, atts
+    )
+    ex = Executor(state.copy(), ctx)
+    ex.stream(blocks, policy=FlushPolicy(window_size=8, max_in_flight=2))
+    store = HeadStore()
+    store.publish(ex.state, ctx)
+    traffic = [
+        att.copy()
+        for block in blocks[-8:]
+        for att in block.message.body.attestations
+    ]
+    server = IntrospectionServer(port=0).start(start_flight=False)
+    server.mount(BeaconDataPlane(store))
+    swarm = ReaderSwarm(server.url(), n_readers=2)
+    try:
+        pool = OperationPool()
+        engine = AdmissionEngine(pool, store, ctx, window_size=32)
+        flushes_before = metrics.counter("pool.flushes").value()
+        tickets = [engine.admit_attestation(att) for att in traffic]
+        engine.settle()
+        flushes = metrics.counter("pool.flushes").value() - flushes_before
+        rejected = [t for t in tickets if t.status != "admitted"]
+        assert not rejected, [
+            (t.status, t.reason) for t in rejected[:4]
+        ]
+        expected = (len(traffic) + 31) // 32
+        assert flushes == expected, (flushes, expected)
+        scalar_pool = OperationPool()
+        scalar_engine = AdmissionEngine(scalar_pool, store, ctx, rlc=False)
+        for block in blocks[-8:]:
+            for att in block.message.body.attestations:
+                scalar_engine.admit_attestation(att.copy())
+        assert _view_doc(pool) == _view_doc(scalar_pool)
+    finally:
+        swarm.stop()
+        server.stop()
+        assert not swarm.errors, swarm.errors[:3]

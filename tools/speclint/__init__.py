@@ -66,6 +66,12 @@ def _default_targets(root: str) -> dict:
             # and the column views handed to reader threads are exactly
             # the alias class aliasflow guards
             os.path.join(root, _PKG, "serving"),
+            # the operation pool admits ops by running spec processors
+            # on scratch states and stores SSZ containers it later
+            # serves/produces — its writes must stay on the sanctioned
+            # surface, and its bitfield matrices are aliasflow's
+            # column-buffer class
+            os.path.join(root, _PKG, "pool"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -85,6 +91,11 @@ def _default_targets(root: str) -> dict:
             # the serving layer is concurrent by construction: handler
             # threads share the HeadStore and per-snapshot lazy builds
             os.path.join(root, _PKG, "serving"),
+            # the pool's admission windows, in-flight futures, and
+            # store maps are shared between POST handler threads, the
+            # settling thread, and the spam/producer drivers — lock
+            # discipline and acquisition order are load-bearing
+            os.path.join(root, _PKG, "pool"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
