@@ -79,6 +79,26 @@ _TREND_HEADLINE = (
     "scalar_ingest_s",
     "flushes",
     "fused_groups",
+    # the mesh scale-out axes (ISSUE 12): blocks/s and epoch seconds per
+    # virtual device count, scaling efficiency vs the 1-device run, and
+    # the lane occupancy the cores convert into throughput
+    "runs.1.blocks_per_s",
+    "runs.2.blocks_per_s",
+    "runs.4.blocks_per_s",
+    "runs.8.blocks_per_s",
+    "scaling_vs_1dev.2",
+    "scaling_vs_1dev.4",
+    "scaling_vs_1dev.8",
+    "runs.4.stage_a_occupancy",
+    "runs.4.stage_b_occupancy",
+    "forks.deneb.runs.1.epoch_s",
+    "forks.deneb.runs.4.epoch_s",
+    "forks.deneb.runs.8.epoch_s",
+    "forks.deneb.speedup_vs_1dev.4",
+    "forks.electra.runs.1.epoch_s",
+    "forks.electra.runs.4.epoch_s",
+    "forks.electra.runs.8.epoch_s",
+    "forks.electra.speedup_vs_1dev.4",
 )
 
 
@@ -106,6 +126,8 @@ def _numeric_leaves(obj, prefix="") -> dict:
 
 def _seconds_like(key: str) -> bool:
     leaf = key.rsplit(".", 1)[-1]
+    if leaf.endswith("_per_s"):  # a RATE: up is good, not a regression
+        return False
     return leaf.endswith("_s") or "_s_per_" in leaf or leaf.endswith("_ms")
 
 

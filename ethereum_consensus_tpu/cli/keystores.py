@@ -45,11 +45,115 @@ def _scrypt(passphrase: str, salt: bytes) -> bytes:
 
 
 def _aes_128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
-    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher,
+            algorithms,
+            modes,
+        )
+    except ImportError:
+        # the container image ships no `cryptography` wheel; EIP-2335
+        # payloads are 32 bytes, so the table-driven fallback below is
+        # plenty (and keeps the CLI dependency-free)
+        return _aes_128_ctr_py(key, iv, data)
 
     cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
     enc = cipher.encryptor()
     return enc.update(data) + enc.finalize()
+
+
+# -- pure-python AES-128-CTR fallback -----------------------------------------
+#
+# FIPS-197 with the standard 256-entry S-box/xtime tables. CTR mode only
+# ever ENCRYPTS the counter stream, so decrypt == encrypt and no inverse
+# cipher is needed. Keystore secrets are one or two blocks; throughput is
+# irrelevant, correctness is pinned by the round-trip + known-vector
+# tests in tests/test_cli.py.
+
+_SBOX = None
+
+
+def _aes_tables():
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    # generate the S-box from the field inverse + affine map rather than
+    # inlining 256 magic numbers
+    p, q, sbox = 1, 1, [0] * 256
+    while True:
+        # p := p * 3, q := q / 3 in GF(2^8)
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        q ^= q << 1
+        q ^= q << 2
+        q ^= q << 4
+        q &= 0xFF
+        if q & 0x80:
+            q ^= 0x09
+        x = (
+            q
+            ^ ((q << 1) | (q >> 7))
+            ^ ((q << 2) | (q >> 6))
+            ^ ((q << 3) | (q >> 5))
+            ^ ((q << 4) | (q >> 4))
+        )
+        sbox[p] = (x ^ 0x63) & 0xFF
+        if p == 1:
+            break
+    sbox[0] = 0x63
+    _SBOX = sbox
+    return sbox
+
+
+def _xtime(a: int) -> int:
+    return ((a << 1) ^ 0x1B) & 0xFF if a & 0x80 else a << 1
+
+
+def _aes_128_expand_key(key: bytes) -> "list[list[int]]":
+    sbox = _aes_tables()
+    words = [list(key[4 * i : 4 * i + 4]) for i in range(4)]
+    rcon = 1
+    for i in range(4, 44):
+        w = list(words[i - 1])
+        if i % 4 == 0:
+            w = [sbox[b] for b in w[1:] + w[:1]]
+            w[0] ^= rcon
+            rcon = _xtime(rcon)
+        words.append([a ^ b for a, b in zip(words[i - 4], w)])
+    return [sum(words[4 * r : 4 * r + 4], []) for r in range(11)]
+
+
+def _aes_128_encrypt_block(round_keys, block: bytes) -> bytes:
+    sbox = _aes_tables()
+    s = [b ^ k for b, k in zip(block, round_keys[0])]
+    for rnd in range(1, 11):
+        s = [sbox[b] for b in s]
+        # ShiftRows on the column-major state layout
+        s = [s[(i + 4 * (i % 4)) % 16] for i in range(16)]
+        if rnd < 10:
+            mixed = []
+            for c in range(4):
+                a = s[4 * c : 4 * c + 4]
+                t = a[0] ^ a[1] ^ a[2] ^ a[3]
+                mixed.extend(
+                    a[i] ^ t ^ _xtime(a[i] ^ a[(i + 1) % 4]) for i in range(4)
+                )
+            s = mixed
+        s = [b ^ k for b, k in zip(s, round_keys[rnd])]
+    return bytes(s)
+
+
+def _aes_128_ctr_py(key: bytes, iv: bytes, data: bytes) -> bytes:
+    round_keys = _aes_128_expand_key(key)
+    counter = int.from_bytes(iv, "big")
+    out = bytearray()
+    for off in range(0, len(data), 16):
+        stream = _aes_128_encrypt_block(
+            round_keys, (counter & (2**128 - 1)).to_bytes(16, "big")
+        )
+        block = data[off : off + 16]
+        out.extend(b ^ s for b, s in zip(block, stream))
+        counter += 1
+    return bytes(out)
 
 
 class Keystore(dict):

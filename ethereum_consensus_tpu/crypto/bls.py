@@ -829,11 +829,23 @@ def _batch_device_pairing(
         blinders = [int.from_bytes(sc, "big") for sc in scalars]
         import jax
 
-        if len(jax.devices()) > 1:
+        from ..parallel import runtime as _mesh_runtime
+
+        # the provisioned ECT_MESH mesh owns the sharded route (with its
+        # engage/decline journal); without one, any multi-device backend
+        # still shards over the default mesh (the dryrun_multichip shape)
+        mesh = _mesh_runtime.pairing_mesh(len(sets))
+        if mesh is None and len(jax.devices()) > 1:
             # multi-chip: the set axis shards over the mesh (SURVEY §2.5)
+            from ..parallel.mesh import default_device_mesh
+
+            mesh = default_device_mesh()
+        if mesh is not None:
             from ..parallel.pairing import batch_verify_sharded
 
-            return batch_verify_sharded(pk_raws, h_raws, sig_raws, blinders)
+            return batch_verify_sharded(
+                pk_raws, h_raws, sig_raws, blinders, mesh=mesh
+            )
         return device_pairing.batch_verify_device(
             pk_raws, h_raws, sig_raws, blinders
         )
@@ -872,35 +884,44 @@ def verify_signature_sets(
 # Async dispatch (the chain pipeline's stage-B hook, pipeline/scheduler.py)
 # ---------------------------------------------------------------------------
 
-_VERIFY_POOL = None
+_VERIFY_POOLS: dict = {}
 # double-checked creation: two racing first-dispatchers would otherwise
-# build TWO single-thread pools — and the pipeline's windows-settle-FIFO
-# guarantee only holds when every dispatch queues behind the SAME worker
+# build TWO single-thread pools for one lane — and the pipeline's
+# windows-settle-FIFO guarantee (per lane) only holds when every dispatch
+# to a lane queues behind the SAME worker
 _VERIFY_POOL_LOCK = threading.Lock()
 
 
-def _verify_pool():
-    """One process-wide single-thread verifier. ONE worker on purpose:
-    dispatches complete FIFO (the pipeline needs windows settled in chain
-    order), and the pairing engines underneath (native ctypes — which
-    releases the GIL for the whole multi-pairing — or the device route)
-    each already own their parallelism; stacking a second in-flight batch
-    on the same engine would only fight it for cores/chip."""
-    global _VERIFY_POOL
-    if _VERIFY_POOL is None:
+def _verify_pool(lane: int = 0):
+    """One process-wide single-thread verifier PER LANE. One worker per
+    lane on purpose: dispatches within a lane complete FIFO, and the
+    pairing engines underneath (native ctypes — which releases the GIL
+    for the whole multi-pairing — or the device route) each already own
+    their parallelism. Lane 0 is the historical single verifier (the
+    pool's flushes and unconfigured pipelines land there); the pipeline
+    scheduler fans windows over N lanes deterministically
+    (``seq % verify_lanes``, pipeline/scheduler.py) so a multi-core host
+    proves N windows CONCURRENTLY — the GIL-released native pairing
+    makes that real parallelism — while the engine's settle-oldest order
+    keeps commits in chain order regardless of which lane finishes
+    first."""
+    pool = _VERIFY_POOLS.get(lane)
+    if pool is None:
         with _VERIFY_POOL_LOCK:
-            if _VERIFY_POOL is None:
+            pool = _VERIFY_POOLS.get(lane)
+            if pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
-                _VERIFY_POOL = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="bls-verify"
+                pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=f"bls-verify-{lane}"
                 )
-    return _VERIFY_POOL
+                _VERIFY_POOLS[lane] = pool
+    return pool
 
 
 def verify_signature_sets_async(
     sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None, pre=None,
-    route_sink=None,
+    route_sink=None, lane: int = 0,
 ):
     """Dispatch one batched verification to the background verifier thread;
     returns a ``concurrent.futures.Future[list[bool]]``.
@@ -916,7 +937,10 @@ def verify_signature_sets_async(
     future exactly as a real worker fault would. ``route_sink``, if
     given, is called on the worker after verification with the batch's
     pairing route ("device"/"host"/None — ``last_batch_route``), the
-    flight recorder's per-window verify_route feed."""
+    flight recorder's per-window verify_route feed. ``lane`` picks the
+    single-thread verifier worker (default 0 — the historical shared
+    worker); batches dispatched to different lanes verify CONCURRENTLY,
+    batches on one lane stay FIFO."""
     sets = list(sets)
 
     def run() -> list[bool]:
@@ -937,4 +961,4 @@ def verify_signature_sets_async(
             if timer is not None:
                 timer(_time.perf_counter() - t0)
 
-    return _verify_pool().submit(run)
+    return _verify_pool(lane).submit(run)

@@ -121,6 +121,14 @@ def fallback(reason: str, **inputs) -> None:
                 trace.event("epoch_vector.fallback", reason=reason)
 
 
+def _mesh_requested() -> bool:
+    """Plain env read — the parallel.runtime import (and with it jax)
+    only happens when the mesh is actually switched on (ECT_MESH)."""
+    return os.environ.get("ECT_MESH", "").strip().lower() not in (
+        "", "off", "0", "none", "host",
+    )
+
+
 _JITTED_KERNELS = {}
 _JITTED_KERNELS_LOCK = threading.Lock()
 
@@ -308,6 +316,9 @@ class _EpochColumns:
         # epoch window — every spec write targets future epochs)
         "active_prev", "active_cur", "eligible",
         "credential_switches",
+        # the mesh runner for this pass (parallel/runtime.py) — None
+        # when the mesh is off/declined, and the host kernels run
+        "mesh",
     )
 
 
@@ -447,6 +458,7 @@ def _sync(state, context, fork):
     ec._total_active = None
     ec._active_cur_count = None
     ec.credential_switches = []
+    ec.mesh = None
     return ec
 
 
@@ -598,13 +610,32 @@ def _inactivity_updates(ec) -> None:
         & ~ec.slashed
         & _flag_mask(ec, ec.prev_part, _TIMELY_TARGET_FLAG_INDEX)
     )
+    bias = int(context.inactivity_score_bias)
+    recovery = int(context.inactivity_score_recovery_rate)
+    if ec.mesh is not None:
+        # the sharded sweep (parallel/epoch.py) reuses the SAME kernel
+        # body under shard_map; any device trouble journals and the
+        # host kernel below stays the live fallback
+        try:
+            ec.inact = ec.mesh.inactivity_scores(
+                ec.inact, ec.eligible, participating, bias, recovery,
+                leaking,
+            )
+            return
+        except Exception as exc:  # noqa: BLE001 — host fallback
+            from ..parallel import runtime as _mesh_runtime
+
+            _mesh_runtime.decline(
+                "epoch", "device_unusable", stage="inactivity",
+                error=repr(exc)[:160],
+            )
     ec.inact = inactivity_scores_kernel(
         ec.np,
         ec.inact,
         ec.eligible,
         participating,
-        int(context.inactivity_score_bias),
-        int(context.inactivity_score_recovery_rate),
+        bias,
+        recovery,
         leaking,
     )
 
@@ -641,6 +672,13 @@ def _rewards_altair(ec) -> None:
         get_finality_delay(ec.state, context)
         > context.MIN_EPOCHS_TO_INACTIVITY_PENALTY
     )
+    if ec.mesh is not None:
+        new_balances = _mesh_rewards(
+            ec, brpi, active_increments, leaking
+        )
+        if new_balances is not None:
+            ec.balances = new_balances
+            return
     unslashed_all = ~ec.slashed
     pairs = []
     target_unslashed = None
@@ -707,6 +745,65 @@ def _rewards_altair(ec) -> None:
             return _rewards_literal_apply(ec, pairs)
         balances = np.where(raised >= penalties, raised - penalties, zero)
     ec.balances = balances
+
+
+def _mesh_rewards(ec, brpi: int, active_increments: int,
+                  leaking: bool) -> "object | None":
+    """Route the whole rewards stage through the mesh runner (ONE
+    sharded sweep: per-flag psum reductions + flag deltas + inactivity
+    penalties + in-order application — parallel/epoch.py). Returns the
+    new balances column, or None with the decline journaled — the host
+    stage below then recomputes everything (live fallback AND
+    differential oracle; the bench asserts bit-identity between the
+    two)."""
+    from .altair.constants import (
+        PARTICIPATION_FLAG_WEIGHTS,
+        TIMELY_HEAD_FLAG_INDEX,
+        WEIGHT_DENOMINATOR,
+    )
+    from ..parallel import runtime as _mesh_runtime
+
+    context = ec.context
+    denominator = int(context.inactivity_score_bias) * int(
+        getattr(context, ec.cfg["quot"])
+    )
+    # the host stage clamps pathological eff*score products through exact
+    # python ints — a kernel cannot, so those states decline up front
+    if ec.n and int(ec.eff.max(initial=0)) * int(
+        ec.inact.max(initial=0)
+    ) >= 1 << 64:
+        _mesh_runtime.decline(
+            "epoch", "u64_product", stage="rewards", validators=ec.n
+        )
+        return None
+    try:
+        new_balances = ec.mesh.rewards(
+            ec.balances, ec.eff, ec.prev_part, ec.slashed, ec.active_prev,
+            ec.eligible, ec.inact,
+            increment=ec.increment,
+            brpi=brpi,
+            active_increments=active_increments,
+            denominator=denominator,
+            weights=tuple(int(w) for w in PARTICIPATION_FLAG_WEIGHTS),
+            weight_denominator=int(WEIGHT_DENOMINATOR),
+            leaking=leaking,
+            head_flag_index=int(TIMELY_HEAD_FLAG_INDEX),
+            target_flag_index=_TIMELY_TARGET_FLAG_INDEX,
+        )
+    except Exception as exc:  # noqa: BLE001 — host fallback
+        _mesh_runtime.decline(
+            "epoch", "device_unusable", stage="rewards",
+            error=repr(exc)[:160],
+        )
+        return None
+    if new_balances is None:
+        # a u64 wrap the lane guards should have made unreachable: the
+        # host path re-runs and its literal mirror raises the structured
+        # error at the exact index (the same terminal contract)
+        _mesh_runtime.decline(
+            "epoch", "wrap_guard", stage="rewards", validators=ec.n
+        )
+    return new_balances
 
 
 def _rewards_literal_apply(ec, pairs) -> None:
@@ -1136,6 +1233,14 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
     if ec is None:
         return False
     cfg = ec.cfg
+    if _mesh_requested():
+        # the mesh runtime consult (parallel/runtime.py): engage routes
+        # the inactivity + rewards sweeps through the sharded kernels;
+        # every decline is journaled by the runtime — the guard here is
+        # just the env read, so a mesh-off process never imports jax
+        from ..parallel import runtime as _mesh_runtime
+
+        ec.mesh = _mesh_runtime.epoch_sweeps(n, family=cfg["family"])
     if _device_obs.OBSERVATORY.active:
         # every guard passed: the engage decision, journaled next to the
         # declines so the /device routing journal tells the whole story

@@ -1,11 +1,20 @@
-"""Multi-chip parallelism: device meshes, sharded merkleization, and the
-distributed chain step.
+"""Multi-chip parallelism: device meshes, sharded merkleization, the
+distributed chain step — and the PRODUCTION mesh runtime.
 
 The reference is a single-process library (SURVEY.md §2.5); scale-out here is
 green-field TPU design: batch axes of the crypto kernels (merkle leaf ranges,
 signature batches, validator-registry sweeps) are sharded over a
 ``jax.sharding.Mesh`` with XLA collectives (``all_gather``/``psum``) riding
 ICI, per the shard_map recipe.
+
+``runtime.py`` is the production switch (``ECT_MESH=N|auto|off``): it
+provisions one mesh per process and routes the columnar epoch sweeps
+(``epoch.py``), the RLC flush windows (``pairing.py``), and large
+``hash_tree_root`` rebuilds (``merkle.py``) through it, with every
+engage/decline journaled and the host paths live as fallback +
+differential oracle (docs/PARALLEL_DESIGN.md). Deliberately NOT
+imported here: host-only processes consult a plain env read before
+paying this package's jax import.
 """
 
 from .._jax_cache import enable as _enable_jax_cache

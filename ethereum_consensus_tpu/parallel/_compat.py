@@ -1,0 +1,46 @@
+"""jax API-drift shims for the sharding layer.
+
+``shard_map`` has moved twice across the jax versions this repo meets:
+``jax.experimental.shard_map.shard_map`` (with ``check_rep``) on 0.4.x,
+``jax.shard_map`` (with ``check_vma``) on 0.6+. The sharded kernels in
+this package are written against the NEW surface; this shim maps the
+call onto whichever the installed jax provides, so the same code runs
+on the baked-in toolchain and on a future chip image. Resolution happens
+once per process (the first sharded trace), not per call.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["shard_map"]
+
+
+@functools.lru_cache(maxsize=1)
+def _resolve():
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native, "check_vma"
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the new keyword surface on any jax.
+
+    ``check_vma=False`` maps to ``check_rep=False`` on the legacy API —
+    both disable the replication/varying-axes type check that rejects
+    the SHA-256/Miller fori_loop carries mixing unvarying literals with
+    device-varying lanes (see parallel/step.py).
+    """
+    fn, check_kw = _resolve()
+    return fn(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{check_kw: check_vma},
+    )

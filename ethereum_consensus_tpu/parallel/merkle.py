@@ -25,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.merkle import reduce_levels, zero_hash_words
 from ..ssz.merkle import BYTES_PER_CHUNK, merkleize_chunks, next_pow_of_two, zero_hash
 from ..telemetry import device as _obs
+from ._compat import shard_map
 from .mesh import SHARD_AXIS
 
 __all__ = ["sharded_merkle_root_words", "sharded_merkleize_chunks"]
@@ -61,7 +62,7 @@ def sharded_merkle_root_words(
 
     # check_vma=False: see parallel/step.py — the SHA-256 fori_loop carry
     # mixes unvarying literals with varying lanes.
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, None)),

@@ -1,0 +1,328 @@
+"""Mesh runtime: one process-wide provisioned mesh behind ``ECT_MESH``.
+
+The virtual 8-device dryrun (``__graft_entry__.dryrun_multichip``)
+proved every layer shards; this module is the PRODUCTION switch that
+routes the three hot paths through the 1-D ``shard`` mesh:
+
+* the columnar epoch sweeps (models/epoch_vector.py → parallel/epoch.py
+  ``MeshEpochSweeps``: row-sharded kernels + psum reductions),
+* the RLC flush windows of the pipeline and the operation pool
+  (crypto/bls.py → parallel/pairing.py ``batch_verify_sharded``),
+* large ``hash_tree_root`` rebuilds (ssz/merkle.py's mesh hook →
+  parallel/merkle.py ``sharded_merkleize_chunks``).
+
+``ECT_MESH=N`` provisions a mesh over the first N devices (N=1 is legal
+— it exercises the sharded code paths on one device), ``ECT_MESH=auto``
+takes every device when there are at least two, and unset/``off``
+disables the runtime entirely — the host paths then never pay a jax
+import, let alone a dispatch. On a CPU-only box the devices come from
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (the
+virtual_mesh.py seam): a multi-core box is a mesh, no chip required.
+
+Observability contract (the PR 10 observatory, telemetry/device.py):
+every routing decision is journal-visible — engages bump ``mesh.engage``
+and journal ``mesh.{epoch,pairing,merkle}``/``device`` entries with the
+device count and per-device work split; EVERY decline bumps
+``mesh.decline.{reason}`` and fires a one-shot ``mesh.decline`` trace
+event carrying the device-count/threshold inputs (the
+epoch_vector.fallback idiom — no silent declines, ever). The host paths
+stay live as fallback AND differential oracle: any device trouble
+returns the work to the host without changing results.
+
+Provisioning happens ONCE per process (double-checked lock); a declined
+runtime stays declined (the reasons — bad env value, devices missing,
+jax unusable — do not heal mid-process).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..telemetry import device as _device_obs
+from ..telemetry import metrics as _metrics
+from ..utils import trace
+
+__all__ = [
+    "MESH_ENV",
+    "EPOCH_MIN_ENV",
+    "MERKLE_MIN_ENV",
+    "DEFAULT_EPOCH_MIN_N",
+    "DEFAULT_MERKLE_MIN_CHUNKS",
+    "requested",
+    "mesh",
+    "device_count",
+    "status",
+    "epoch_sweeps",
+    "pairing_mesh",
+    "reset",
+]
+
+MESH_ENV = "ECT_MESH"
+EPOCH_MIN_ENV = "ECT_MESH_EPOCH_MIN_N"
+MERKLE_MIN_ENV = "ECT_MESH_MERKLE_MIN_CHUNKS"
+
+# crossover defaults, matching the ops.install sweep thresholds: below
+# these sizes the dispatch + padding overhead loses to the host path
+DEFAULT_EPOCH_MIN_N = 1 << 17
+DEFAULT_MERKLE_MIN_CHUNKS = 1 << 15
+
+_LOCK = threading.Lock()
+# provisioning outcome, written once under _LOCK then read lock-free:
+# None = not yet attempted; (mesh_or_None, reason) afterwards
+_PROVISIONED: "tuple | None" = None
+
+_DECLINE_SEEN: set = set()
+_DECLINE_LOCK = threading.Lock()
+
+
+def requested() -> bool:
+    """Is the mesh runtime switched on at all? A plain env read — the
+    off path imports no jax and journals nothing (off is a
+    configuration, not a decline)."""
+    value = os.environ.get(MESH_ENV, "").strip().lower()
+    return value not in ("", "off", "0", "none", "host")
+
+
+def _decline(kind: str, reason: str, **inputs) -> None:
+    """Count + one-shot-event + journal one declined mesh route (the
+    epoch_vector.fallback idiom — a decline is a routing decision worth
+    seeing, so none are silent)."""
+    _metrics.counter(f"mesh.decline.{reason}").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route(f"mesh.{kind}", "host", reason, **inputs)
+    key = (kind, reason)
+    if key not in _DECLINE_SEEN:
+        with _DECLINE_LOCK:
+            if key not in _DECLINE_SEEN:
+                _DECLINE_SEEN.add(key)
+                trace.event(
+                    "mesh.decline", kind=kind, reason=reason, **inputs
+                )
+
+
+def decline(kind: str, reason: str, **inputs) -> None:
+    """Public decline seam for the routed call sites (epoch_vector's
+    mesh wrappers journal their stage-local declines through this)."""
+    _decline(kind, reason, **inputs)
+
+
+def _engage(kind: str, **inputs) -> None:
+    _metrics.counter("mesh.engage").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route(f"mesh.{kind}", "device", "engaged", **inputs)
+
+
+def _provision() -> "tuple":
+    """Resolve ECT_MESH into a provisioned Mesh (or a decline reason).
+    Runs at most once per process; the first caller pays the jax import
+    and mesh construction, everyone else reads the cached outcome."""
+    global _PROVISIONED
+    if _PROVISIONED is not None:
+        return _PROVISIONED
+    with _LOCK:
+        if _PROVISIONED is not None:
+            return _PROVISIONED
+        value = os.environ.get(MESH_ENV, "").strip().lower()
+        outcome = _provision_locked(value)
+        if outcome[0] is not None:
+            # the merkle hook rides provisioning: one install, and the
+            # pure-host ssz layer stays jax-free until a mesh engages
+            _install_merkle_hook(outcome[0])
+        _PROVISIONED = outcome
+    return _PROVISIONED
+
+
+def _provision_locked(value: str) -> "tuple":
+    try:
+        import jax
+
+        from .mesh import chip_mesh
+
+        jax.config.update("jax_enable_x64", True)
+        devices = jax.devices()
+    except Exception as exc:  # noqa: BLE001 — no usable jax: host paths
+        _decline("runtime", "no_jax", error=repr(exc)[:160])
+        return None, "no_jax"
+    if value == "auto":
+        if len(devices) < 2:
+            _decline("runtime", "single_device", devices=len(devices))
+            return None, "single_device"
+        n = len(devices)
+    else:
+        try:
+            n = int(value)
+        except ValueError:
+            _decline("runtime", "bad_value", value=value)
+            return None, "bad_value"
+        if n < 1:
+            _decline("runtime", "bad_value", value=value)
+            return None, "bad_value"
+        if n > len(devices):
+            _decline(
+                "runtime", "devices_unavailable",
+                requested=n, devices=len(devices),
+            )
+            return None, "devices_unavailable"
+    built = chip_mesh(n)
+    _metrics.gauge("mesh.devices").set(n)
+    trace.event("mesh.provisioned", devices=n, backend=jax.default_backend())
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route(
+            "mesh.runtime", "device", "provisioned",
+            devices=n, backend=jax.default_backend(),
+        )
+    return built, "engaged"
+
+
+def mesh():
+    """The provisioned mesh, or None (not requested / declined)."""
+    if not requested():
+        return None
+    return _provision()[0]
+
+
+def device_count() -> int:
+    m = mesh()
+    return int(m.devices.size) if m is not None else 0
+
+
+def status() -> dict:
+    """Runtime state for /device and the bench evidence blocks."""
+    value = os.environ.get(MESH_ENV, "").strip() or "off"
+    if not requested():
+        return {"requested": False, "env": value, "devices": 0}
+    m, reason = _provision()
+    return {
+        "requested": True,
+        "env": value,
+        "devices": int(m.devices.size) if m is not None else 0,
+        "reason": reason,
+    }
+
+
+def _threshold(env_key: str, default: int) -> int:
+    raw = os.environ.get(env_key, "").strip()
+    if not raw:
+        return default
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return default
+
+
+# -- the three routed hot paths ----------------------------------------------
+
+
+def epoch_sweeps(n_validators: int, family: str = "altair"):
+    """A ``MeshEpochSweeps`` runner for an ``n_validators`` registry, or
+    None with the decline journaled. Callers treat None as 'run the
+    host kernels' — the live fallback. Only the altair-family sweeps
+    (inactivity + flag rewards) have sharded twins; phase0's
+    pending-attestation rewards decline explicitly."""
+    if not requested():
+        return None
+    if family != "altair":
+        _decline(
+            "epoch", f"{family}_family", validators=n_validators
+        )
+        return None
+    m, reason = _provision()
+    if m is None:
+        _decline("epoch", reason, validators=n_validators)
+        return None
+    threshold = _threshold(EPOCH_MIN_ENV, DEFAULT_EPOCH_MIN_N)
+    if n_validators < threshold:
+        _decline(
+            "epoch", "below_threshold",
+            validators=n_validators, threshold=threshold,
+            devices=int(m.devices.size),
+        )
+        return None
+    try:
+        from .epoch import MeshEpochSweeps
+
+        runner = MeshEpochSweeps(m)
+    except Exception as exc:  # noqa: BLE001 — device trouble: host path
+        _decline("epoch", "device_unusable", error=repr(exc)[:160])
+        return None
+    _engage(
+        "epoch",
+        validators=n_validators,
+        devices=runner.n_dev,
+        rows_per_device=-(-n_validators // runner.n_dev),
+    )
+    return runner
+
+
+def pairing_mesh(n_sets: int):
+    """The mesh for one RLC flush window's sharded pairing, or None
+    (caller keeps the single-device/native route). The pairing-size
+    threshold itself lives in ops (_device_flags.pairing_enabled) — by
+    the time crypto/bls.py consults this, the batch is already routed
+    device-ward; this only decides single-device vs mesh-sharded."""
+    if not requested():
+        return None
+    m, reason = _provision()
+    if m is None:
+        _decline("pairing", reason, sets=n_sets)
+        return None
+    n_dev = int(m.devices.size)
+    _engage(
+        "pairing",
+        sets=n_sets,
+        devices=n_dev,
+        sets_per_device=-(-n_sets // n_dev),
+    )
+    return m
+
+
+def _install_merkle_hook(m) -> None:
+    """Point ssz/merkle.py's mesh seam at the sharded merkleizer: large
+    flat rebuilds (cold column materializations, whole-list roots)
+    divide their leaf ranges over the mesh. The hook returns None on any
+    trouble — the host merkleizer is always live underneath."""
+    from ..ssz import merkle as ssz_merkle
+
+    min_chunks = _threshold(MERKLE_MIN_ENV, DEFAULT_MERKLE_MIN_CHUNKS)
+    n_dev = int(m.devices.size)
+
+    def mesh_merkleize(chunks: bytes, limit: "int | None") -> "bytes | None":
+        # shape pre-check BEFORE dispatch: sharded_merkleize_chunks falls
+        # back to the host merkleizer for meshes that cannot own an
+        # aligned subtree per device — returning None here instead keeps
+        # the hook non-reentrant (the host path would re-enter the hook)
+        count = len(chunks) // 32
+        width = ssz_merkle.next_pow_of_two(
+            count if limit is None else limit
+        )
+        if n_dev & (n_dev - 1) or n_dev > width:
+            _decline(
+                "merkle", "mesh_shape",
+                chunks=count, devices=n_dev, width=width,
+            )
+            return None
+        try:
+            from .merkle import sharded_merkleize_chunks
+
+            root = sharded_merkleize_chunks(chunks, m, limit=limit)
+        except Exception as exc:  # noqa: BLE001 — host path must win
+            _decline("merkle", "device_unusable", error=repr(exc)[:160])
+            return None
+        _engage("merkle", chunks=count, devices=n_dev)
+        return root
+
+    ssz_merkle.register_mesh_merkleizer(mesh_merkleize, min_chunks)
+
+
+def reset() -> None:
+    """Drop the provisioned mesh + hooks (tests only: lets one process
+    exercise several ECT_MESH configurations)."""
+    global _PROVISIONED
+    with _LOCK:
+        _PROVISIONED = None
+        with _DECLINE_LOCK:
+            _DECLINE_SEEN.clear()
+        from ..ssz import merkle as ssz_merkle
+
+        ssz_merkle.register_mesh_merkleizer(None, None)

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.merkle import reduce_levels
 from ..ops.sha256 import sha256_64b
 from ..ssz.merkle import next_pow_of_two
+from ._compat import shard_map
 from .mesh import SHARD_AXIS
 
 __all__ = [
@@ -140,7 +141,7 @@ def make_chain_step(
     # system rejects; replication of the psum/top-tree outputs is guaranteed
     # by construction here.
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(
@@ -330,7 +331,7 @@ def make_epoch_sweep_step(
 
     spec = P(axis_name)
     jitted = jax.jit(
-        jax.shard_map(
+        shard_map(
             body,
             mesh=mesh,
             in_specs=(spec,) * 8,

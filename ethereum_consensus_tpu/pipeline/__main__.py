@@ -14,6 +14,10 @@ Two tiers, best available wins:
 
 Telemetry exports (docs/OBSERVABILITY.md):
 
+* ``--lanes N``          — run the chain tier with N verifier lanes
+  (``FlushPolicy.verify_lanes``): windows fan over N FIFO workers,
+  settle order preserved — the multi-core blocks/s shape.
+
 * ``--trace-out PATH``   — record every span/event of the selfcheck and
   write a Chrome trace-event JSON (Perfetto / ``chrome://tracing``):
   stage A and the background verifier render as separate tracks with
@@ -56,7 +60,7 @@ def _find_chain_utils() -> bool:
     return False
 
 
-def _selfcheck_chain() -> None:
+def _selfcheck_chain(lanes: int = 1) -> None:
     from chain_utils import fresh_genesis, make_attestation, produce_block
 
     from ..error import InvalidBlock
@@ -84,10 +88,17 @@ def _selfcheck_chain() -> None:
         sequential.apply_block(block)
     pipelined = Executor(state.copy(), ctx)
     stats = pipelined.stream(
-        blocks, policy=FlushPolicy(window_size=3, max_in_flight=2)
+        blocks,
+        policy=FlushPolicy(
+            window_size=3,
+            max_in_flight=max(2, lanes),
+            verify_lanes=lanes,
+        ),
     )
     if pipelined.state.hash_tree_root() != sequential.state.hash_tree_root():
         raise AssertionError("pipelined root != sequential root")
+    if lanes > 1:
+        print(f"chain tier: {lanes} verifier lanes, settle order preserved")
     if stats.blocks_committed != n_blocks:
         raise AssertionError(f"committed {stats.blocks_committed}/{n_blocks}")
     print(
@@ -174,6 +185,7 @@ def main(argv: "list[str]") -> int:
     device_out = _flag_value(argv, "--device-out")
     serve_port = _flag_value(argv, "--serve")
     hold_s = _flag_value(argv, "--hold")
+    lanes = int(_flag_value(argv, "--lanes") or "1")
     if "--selfcheck" not in argv:
         print(__doc__)
         return 2
@@ -208,7 +220,7 @@ def main(argv: "list[str]") -> int:
         device_obs.start()
     try:
         if _find_chain_utils():
-            _selfcheck_chain()
+            _selfcheck_chain(lanes=lanes)
         _selfcheck_window()
     except Exception as exc:  # noqa: BLE001 — smoke must report, not crash
         print(f"SELFCHECK FAILED: {type(exc).__name__}: {exc}")

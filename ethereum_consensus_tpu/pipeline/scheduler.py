@@ -76,17 +76,30 @@ class FlushPolicy:
     * ``retry_backoff_s`` — base backoff before retry k (linear:
       ``k * retry_backoff_s``), bounding total stall to
       ``flush_retries * (flush_retries + 1) / 2 * retry_backoff_s``.
+    * ``verify_lanes`` — how many single-thread verifier workers the
+      windows fan over (``crypto.bls`` keeps one FIFO pool per lane).
+      1 = the historical shared worker. With N lanes, window ``seq``
+      dispatches to lane ``seq % N`` — DETERMINISTIC, so a replay hits
+      the same lanes — and up to N windows verify concurrently (the
+      native pairing releases the GIL, so N cores prove N windows at
+      once). Settle order is untouched: the engine always settles the
+      OLDEST window first and blocks on its future, so commits stay in
+      chain order no matter which lane finishes first. Raise
+      ``max_in_flight`` to at least ``verify_lanes`` or the backpressure
+      wait will idle the extra lanes.
     """
 
     __slots__ = (
         "window_size", "max_in_flight", "checkpoint_interval", "flush_empty",
         "settle_timeout_s", "flush_retries", "retry_backoff_s",
+        "verify_lanes",
     )
 
     def __init__(self, window_size: int = 8, max_in_flight: int = 2,
                  checkpoint_interval: int = 8, flush_empty: bool = False,
                  settle_timeout_s: "float | None" = 300.0,
-                 flush_retries: int = 2, retry_backoff_s: float = 0.05):
+                 flush_retries: int = 2, retry_backoff_s: float = 0.05,
+                 verify_lanes: int = 1):
         if window_size < 1:
             raise ValueError("window_size must be >= 1")
         if max_in_flight < 1:
@@ -97,6 +110,8 @@ class FlushPolicy:
             raise ValueError("settle_timeout_s must be positive or None")
         if flush_retries < 0:
             raise ValueError("flush_retries must be >= 0")
+        if verify_lanes < 1:
+            raise ValueError("verify_lanes must be >= 1")
         self.window_size = window_size
         self.max_in_flight = max_in_flight
         self.checkpoint_interval = checkpoint_interval
@@ -104,13 +119,15 @@ class FlushPolicy:
         self.settle_timeout_s = settle_timeout_s
         self.flush_retries = flush_retries
         self.retry_backoff_s = retry_backoff_s
+        self.verify_lanes = verify_lanes
 
     def __repr__(self) -> str:
         return (
             f"FlushPolicy(window_size={self.window_size}, "
             f"max_in_flight={self.max_in_flight}, "
             f"checkpoint_interval={self.checkpoint_interval}, "
-            f"settle_timeout_s={self.settle_timeout_s})"
+            f"settle_timeout_s={self.settle_timeout_s}, "
+            f"verify_lanes={self.verify_lanes})"
         )
 
 
@@ -214,6 +231,10 @@ class VerifyScheduler:
             window.future = bls.verify_signature_sets_async(
                 window.batch.sets, timer=timer, pre=pre,
                 route_sink=route_sink,
+                # deterministic window→lane assignment: retries of one
+                # window stay on its lane (FIFO with its successors),
+                # consecutive windows round-robin over the lanes
+                lane=window.seq % self.policy.verify_lanes,
             )
         except RuntimeError:
             _metrics.counter("pipeline.fault.dispatch_failure").inc()

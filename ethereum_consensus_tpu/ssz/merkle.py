@@ -34,6 +34,28 @@ __all__ = [
 BYTES_PER_CHUNK = 32
 ZERO_CHUNK = b"\x00" * BYTES_PER_CHUNK
 
+# -- mesh merkleization seam --------------------------------------------------
+#
+# Installed by parallel/runtime.py when an ECT_MESH mesh provisions: large
+# flat rebuilds (cold column materializations, whole-list roots) divide
+# their leaf ranges over the device mesh (parallel/merkle.py). This module
+# stays jax-free: the hook is PUSHED in (the register_device_hasher idiom,
+# ssz/hash.py) and a None return — any device trouble, any shape the mesh
+# cannot own — falls through to the host merkleizer below, which remains
+# the differential oracle for every mesh root.
+
+_MESH_MERKLEIZER = None
+_MESH_MIN_CHUNKS: "int | None" = None
+
+
+def register_mesh_merkleizer(fn, min_chunks: "int | None") -> None:
+    """Install (or, with ``fn=None``, clear) the mesh merkleization hook:
+    ``fn(chunks, limit) -> root | None`` for flat trees of at least
+    ``min_chunks`` populated chunks."""
+    global _MESH_MERKLEIZER, _MESH_MIN_CHUNKS
+    _MESH_MERKLEIZER = fn
+    _MESH_MIN_CHUNKS = min_chunks
+
 # zero_hash(i) = root of a fully-zero subtree of depth i.
 _ZERO_HASHES: list[bytes] = [ZERO_CHUNK]
 
@@ -91,6 +113,27 @@ def merkleize_chunks(
 
     if count == 0:
         return zero_hash(depth + level_offset)
+
+    # mesh-sharded rebuilds (parallel/runtime.py hook): big flat trees
+    # split by leaf range over the device mesh. Bit-identical by
+    # construction; a None return (device trouble, un-ownable shape)
+    # falls through to the host path. Guarded to level_offset 0 — the
+    # sharded reducer pads with the standard zero table.
+    if (
+        _MESH_MERKLEIZER is not None
+        and level_offset == 0
+        and count >= _MESH_MIN_CHUNKS
+    ):
+        root = _MESH_MERKLEIZER(chunks, limit)
+        if root is not None:
+            # exact level-sum work accounting, as _native_tree_root does
+            n = count
+            total = 0
+            for _ in range(depth):
+                n = (n + 1) // 2
+                total += n
+            _hash_mod.add_digests(total)
+            return root
 
     # medium-to-large flat trees: one native call walks every level
     # (the per-level Python loop pays a join + two ctypes copies per

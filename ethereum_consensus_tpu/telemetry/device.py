@@ -48,6 +48,7 @@ metrics-registry increments (locked per metric) stay outside it.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from collections import deque
@@ -319,6 +320,21 @@ class DeviceObservatory:
         """The /device endpoint document: every ledger, JSON-ready."""
         from .._jax_cache import status as _jax_cache_status
 
+        # mesh runtime state (parallel/runtime.py): imported ONLY when
+        # ECT_MESH is switched on — this module stays jax-free otherwise
+        mesh_env = os.environ.get("ECT_MESH", "").strip()
+        mesh_state = {
+            "requested": False,
+            "env": mesh_env or "off",
+            "devices": 0,
+        }
+        if mesh_env.lower() not in ("", "off", "0", "none", "host"):
+            try:
+                from ..parallel import runtime as _mesh_runtime
+
+                mesh_state = _mesh_runtime.status()
+            except Exception as exc:  # noqa: BLE001 — report, not raise
+                mesh_state["error"] = repr(exc)[:160]
         compiles = self.compiles()
         return {
             "observing": self.active,
@@ -339,6 +355,7 @@ class DeviceObservatory:
                 "misses": _metrics.counter("device.jit_cache.misses").value(),
             },
             "persistent_cache": _jax_cache_status(),
+            "mesh": mesh_state,
         }
 
 

@@ -20,27 +20,22 @@ async def main() -> int:
     endpoint = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:5052"
     async with AsyncClient(endpoint) as client:
         # the point of the async transport: these four round-trips are
-        # in flight together on one connection pool
-        failure = None
-        try:
-            # TaskGroup cancels the in-flight siblings when one fails, so
-            # closing the session on the error path below is quiet
-            async with asyncio.TaskGroup() as tg:
-                t_genesis = tg.create_task(client.get_genesis_details())
-                t_root = tg.create_task(client.get_state_root("head"))
-                t_duties = tg.create_task(client.get_proposer_duties(0))
-                t_version = tg.create_task(client.get_node_version())
-        except* Exception as group:  # noqa: BLE001 — example: report, exit
-            failure = group.exceptions[0]
+        # in flight together on one connection pool (gather keeps the
+        # example on python 3.10 — TaskGroup/except* need 3.11+)
+        results = await asyncio.gather(
+            client.get_genesis_details(),
+            client.get_state_root("head"),
+            client.get_proposer_duties(0),
+            client.get_node_version(),
+            return_exceptions=True,
+        )
+        failure = next(
+            (r for r in results if isinstance(r, BaseException)), None
+        )
         if failure is not None:
             print(f"request failed ({failure}); is a beacon node at {endpoint}?")
             return 1
-        genesis, root, duties_root_and_list, version = (
-            t_genesis.result(),
-            t_root.result(),
-            t_duties.result(),
-            t_version.result(),
-        )
+        genesis, root, duties_root_and_list, version = results
         print(f"node {version}")
         print(f"genesis time {genesis.genesis_time}")
         print(f"head state root 0x{root.hex()}")

@@ -1,0 +1,433 @@
+"""Mesh runtime tests (parallel/runtime.py + parallel/epoch.py): the
+ECT_MESH switch's engage/decline guards (every decline journaled, none
+silent), non-power-of-two registry padding in the sharded epoch sweeps,
+the N-lane verifier's settle-order preservation, and rollback/blame
+identity under an invalid-block storm with the mesh engaged. The true
+2-device smoke (``mesh_smoke``) runs in a virtual-mesh subprocess; the
+guard/lane/storm tests engage an in-process 1-device mesh — the sharded
+code paths are identical, only the axis size differs."""
+
+import os
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from conftest import run_in_cpu_mesh  # noqa: E402
+
+from chain_utils import produce_multi_fork_chain  # noqa: E402
+
+from ethereum_consensus_tpu import _device_flags  # noqa: E402
+from ethereum_consensus_tpu.executor import Executor  # noqa: E402
+from ethereum_consensus_tpu.pipeline import FlushPolicy  # noqa: E402
+from ethereum_consensus_tpu.telemetry import device as tel_device  # noqa: E402
+from ethereum_consensus_tpu.telemetry import flight as tel_flight  # noqa: E402
+from ethereum_consensus_tpu.telemetry import metrics as tel_metrics  # noqa: E402
+
+
+@pytest.fixture
+def mesh_env(monkeypatch):
+    """Reset the mesh runtime around a test that reconfigures ECT_MESH:
+    provisioning is once-per-process, so each configuration needs a
+    fresh slate — and the suite must leave with the mesh OFF."""
+    from ethereum_consensus_tpu.parallel import runtime
+
+    runtime.reset()
+    yield monkeypatch
+    monkeypatch.delenv("ECT_MESH", raising=False)
+    runtime.reset()
+
+
+# ---------------------------------------------------------------------------
+# engage / decline guards
+# ---------------------------------------------------------------------------
+
+
+def test_off_is_silent_and_jax_free_at_the_seam(mesh_env):
+    from ethereum_consensus_tpu.parallel import runtime
+
+    mesh_env.delenv("ECT_MESH", raising=False)
+    with tel_device.observing() as obs:
+        assert runtime.requested() is False
+        assert runtime.mesh() is None
+        assert runtime.epoch_sweeps(1 << 20) is None
+        assert runtime.pairing_mesh(512) is None
+        # off is a configuration, not a decline: nothing journaled
+        assert not [r for r in obs.routes() if r["kind"].startswith("mesh")]
+    assert runtime.status() == {
+        "requested": False, "env": "off", "devices": 0,
+    }
+
+
+@pytest.mark.parametrize(
+    "value,reason",
+    [
+        ("bogus", "bad_value"),
+        ("0", None),      # "0" parses as off — requested() is False
+        ("9999", "devices_unavailable"),
+        ("auto", "single_device"),  # hermetic test process: one device
+    ],
+)
+def test_decline_guards_journal_every_reason(mesh_env, value, reason):
+    from ethereum_consensus_tpu.parallel import runtime
+
+    mesh_env.setenv("ECT_MESH", value)
+    if reason is None:
+        assert runtime.requested() is False
+        return
+    base = tel_metrics.counter(f"mesh.decline.{reason}").value()
+    with tel_device.observing() as obs:
+        assert runtime.mesh() is None
+        assert runtime.status()["reason"] == reason
+        journal = [r for r in obs.routes() if r["kind"] == "mesh.runtime"]
+        assert journal and journal[-1]["reason"] == reason
+        assert journal[-1]["choice"] == "host"
+    assert tel_metrics.counter(f"mesh.decline.{reason}").value() > base
+    # a declined runtime stays declined for every routed path — and each
+    # consumer's decline is journaled too, with the threshold inputs
+    with tel_device.observing() as obs:
+        assert runtime.epoch_sweeps(1 << 20) is None
+        epoch = [r for r in obs.routes() if r["kind"] == "mesh.epoch"]
+        assert epoch and epoch[-1]["reason"] == reason
+        assert epoch[-1]["inputs"]["validators"] == 1 << 20
+
+
+def test_single_device_mesh_engages_and_thresholds(mesh_env):
+    from ethereum_consensus_tpu.parallel import runtime
+
+    mesh_env.setenv("ECT_MESH", "1")
+    assert runtime.device_count() == 1
+    with tel_device.observing() as obs:
+        # below the epoch threshold: an explicit, journaled decline
+        base = tel_metrics.counter("mesh.decline.below_threshold").value()
+        assert runtime.epoch_sweeps(100) is None
+        assert (
+            tel_metrics.counter("mesh.decline.below_threshold").value()
+            > base
+        )
+        decline = [r for r in obs.routes() if r["kind"] == "mesh.epoch"][-1]
+        assert decline["inputs"]["threshold"] == runtime.DEFAULT_EPOCH_MIN_N
+        # phase0 has no sharded sweeps: explicit family decline
+        assert runtime.epoch_sweeps(1 << 20, family="phase0") is None
+        decline = [r for r in obs.routes() if r["kind"] == "mesh.epoch"][-1]
+        assert decline["reason"] == "phase0_family"
+        # above threshold: an engaged runner with the work split journaled
+        mesh_env.setenv("ECT_MESH_EPOCH_MIN_N", "1")
+        engage_base = tel_metrics.counter("mesh.engage").value()
+        runner = runtime.epoch_sweeps(1000)
+        assert runner is not None and runner.n_dev == 1
+        assert tel_metrics.counter("mesh.engage").value() == engage_base + 1
+        engage = [r for r in obs.routes() if r["kind"] == "mesh.epoch"][-1]
+        assert engage["choice"] == "device"
+        assert engage["inputs"]["rows_per_device"] == 1000
+
+
+# ---------------------------------------------------------------------------
+# non-power-of-two registry padding
+# ---------------------------------------------------------------------------
+
+
+def test_pad_to_mesh():
+    from ethereum_consensus_tpu.parallel.epoch import pad_to_mesh
+
+    assert pad_to_mesh(8, 4) == 8
+    assert pad_to_mesh(9, 4) == 12
+    assert pad_to_mesh(1, 8) == 8
+    assert pad_to_mesh(1003, 8) == 1008
+    assert pad_to_mesh(0, 4) == 0
+
+
+def _host_rewards_oracle(balances, eff, prev_part, slashed, active_prev,
+                         eligible, scores, increment, brpi,
+                         active_increments, denominator, weights,
+                         weight_denominator, leaking, head_flag_index,
+                         target_flag_index):
+    """The host stage's exact math (models/epoch_vector.py
+    _rewards_altair), reassembled from the host kernels — the
+    differential oracle for the sharded sweep."""
+    from ethereum_consensus_tpu.models.epoch_vector import (
+        flag_deltas_kernel,
+    )
+
+    base_pairs = []
+    target_unslashed = None
+    base_reward = (eff // np.uint64(increment)) * np.uint64(brpi)
+    for flag_index, weight in enumerate(weights):
+        unslashed = (
+            active_prev
+            & ~slashed
+            & (((prev_part >> np.uint8(flag_index)) & 1).astype(bool))
+        )
+        if flag_index == target_flag_index:
+            target_unslashed = unslashed
+        unslashed_increments = (
+            max(increment, int(eff[unslashed].sum())) // increment
+        )
+        base_pairs.append(
+            flag_deltas_kernel(
+                np, base_reward, eligible, unslashed, weight,
+                unslashed_increments, active_increments,
+                weight_denominator, leaking,
+                flag_index == head_flag_index,
+            )
+        )
+    missed = eligible & ~target_unslashed
+    penalties = np.zeros(len(eff), dtype=np.uint64)
+    penalties[missed] = (
+        eff[missed] * scores[missed] // np.uint64(denominator)
+    )
+    base_pairs.append((np.zeros(len(eff), dtype=np.uint64), penalties))
+    out = balances
+    zero = np.uint64(0)
+    for rewards, pens in base_pairs:
+        raised = out + rewards
+        out = np.where(raised >= pens, raised - pens, zero)
+    return out
+
+
+def test_sharded_sweeps_match_host_kernels_non_pow2(mesh_env):
+    """Random odd-length columns (padding is live on any mesh: the
+    padded neutral rows must not perturb the psums or the deltas) —
+    sharded inactivity + rewards sweeps == the host kernels, exactly."""
+    from ethereum_consensus_tpu.models.epoch_vector import (
+        inactivity_scores_kernel,
+    )
+    from ethereum_consensus_tpu.parallel import runtime
+
+    mesh_env.setenv("ECT_MESH", "1")
+    mesh_env.setenv("ECT_MESH_EPOCH_MIN_N", "1")
+    runner = runtime.epoch_sweeps(1003)
+    assert runner is not None
+
+    rng = np.random.default_rng(12)
+    n = 1003  # odd on purpose: pad_to_mesh is exercised on every mesh
+    eff = rng.integers(0, 33, n, dtype=np.uint64) * np.uint64(10**9)
+    balances = eff + rng.integers(0, 10**9, n, dtype=np.uint64)
+    prev_part = rng.integers(0, 8, n, dtype=np.uint8)
+    slashed = rng.random(n) < 0.05
+    active_prev = rng.random(n) < 0.9
+    eligible = active_prev | (rng.random(n) < 0.02)
+    scores = rng.integers(0, 50, n, dtype=np.uint64)
+
+    got = runner.inactivity_scores(scores, eligible, active_prev, 4, 16,
+                                   False)
+    want = inactivity_scores_kernel(np, scores, eligible, active_prev, 4,
+                                    16, False)
+    assert np.array_equal(got, want)
+
+    kwargs = dict(
+        increment=10**9,
+        brpi=31414,
+        active_increments=int(eff[active_prev].sum()) // 10**9 or 1,
+        denominator=4 * (1 << 24),
+        weights=(14, 26, 14),
+        weight_denominator=64,
+        leaking=False,
+        head_flag_index=2,
+        target_flag_index=1,
+    )
+    got = runner.rewards(balances, eff, prev_part, slashed, active_prev,
+                         eligible, scores, **kwargs)
+    want = _host_rewards_oracle(balances, eff, prev_part, slashed,
+                                active_prev, eligible, scores, **kwargs)
+    assert got is not None and np.array_equal(got, want)
+
+    # the wrap census: a balance at the u64 ceiling plus any reward must
+    # come home as None (the host literal mirror owns that terminal)
+    hot = balances.copy()
+    hot[1] = np.uint64((1 << 64) - 1)
+    prev_part_all = np.full(n, 0b111, dtype=np.uint8)
+    wrapped = runner.rewards(
+        hot, eff, prev_part_all, np.zeros(n, bool), np.ones(n, bool),
+        np.ones(n, bool), scores, **kwargs
+    )
+    assert wrapped is None
+
+
+def test_mesh_merkle_hook_identity_and_reset(mesh_env):
+    """The provisioned mesh installs the ssz merkleization hook; routed
+    roots are bit-identical to the host merkleizer, and reset() clears
+    the hook."""
+    from ethereum_consensus_tpu.parallel import runtime
+    from ethereum_consensus_tpu.ssz import merkle as ssz_merkle
+
+    mesh_env.setenv("ECT_MESH", "1")
+    mesh_env.setenv("ECT_MESH_MERKLE_MIN_CHUNKS", "64")
+    assert runtime.mesh() is not None
+    assert ssz_merkle._MESH_MERKLEIZER is not None
+    rng = np.random.default_rng(5)
+    chunks = rng.integers(0, 256, 256 * 32, dtype=np.uint8).tobytes()
+    engage_base = tel_metrics.counter("mesh.engage").value()
+    routed = ssz_merkle.merkleize_chunks(chunks, limit=2**40)
+    assert tel_metrics.counter("mesh.engage").value() > engage_base
+    runtime.reset()
+    assert ssz_merkle._MESH_MERKLEIZER is None
+    host = ssz_merkle.merkleize_chunks(chunks, limit=2**40)
+    assert routed == host
+
+
+# ---------------------------------------------------------------------------
+# N-lane verifier: settle order, bit-identity, storm blame
+# ---------------------------------------------------------------------------
+
+
+def test_verify_lanes_preserve_settle_order_and_identity():
+    """Windows fan over 3 verifier lanes; commits must still land in
+    chain order (the engine settles oldest-first regardless of which
+    lane finishes) and the final state must match sequential exactly."""
+    state, ctx, blocks = produce_multi_fork_chain(64)
+    sequential = Executor(state.copy(), ctx)
+    for block in blocks:
+        sequential.apply_block(block)
+
+    commits = []
+
+    def on_event(kind, payload):
+        if kind == "commit":
+            commits.append(tuple(payload["slots"]))
+
+    tel_flight.HOOK.subscribe(on_event)
+    try:
+        pipelined = Executor(state.copy(), ctx)
+        stats = pipelined.stream(
+            blocks,
+            policy=FlushPolicy(
+                window_size=2, max_in_flight=4, verify_lanes=3
+            ),
+        )
+    finally:
+        tel_flight.HOOK.unsubscribe(on_event)
+    assert pipelined.state.hash_tree_root() == sequential.state.hash_tree_root()
+    assert pipelined.state.serialize() == sequential.state.serialize()
+    assert stats.rollbacks == 0
+    committed_slots = [s for window in commits for s in window]
+    assert committed_slots == sorted(committed_slots)
+    assert len(committed_slots) == len(blocks)
+
+
+def test_verify_lanes_rejects_bad_policy():
+    with pytest.raises(ValueError):
+        FlushPolicy(verify_lanes=0)
+
+
+def test_storm_rollback_blame_identity_with_mesh_engaged(mesh_env):
+    """An invalid-block storm with the mesh pairing route OWNING the
+    flush windows: same blame attribution, same recovery, bit-identical
+    final state (run_storm asserts identity internally), and the mesh
+    journal proves the sharded route actually ran."""
+    from ethereum_consensus_tpu.scenarios import families
+
+    mesh_env.setenv("ECT_MESH", "1")
+    prior = _device_flags.PAIRING_MIN_SETS
+    _device_flags.PAIRING_MIN_SETS = 1
+    engage_base = tel_metrics.counter("mesh.engage").value()
+    device_base = tel_metrics.counter("bls.pairing_route.device").value()
+    try:
+        from ethereum_consensus_tpu.scenarios.mutators import (
+            bad_proposer_signature,
+            bad_state_root,
+        )
+
+        report, ex = families.invalid_block_storm(
+            n_blocks=10,
+            plan={3: bad_proposer_signature, 7: bad_state_root},
+        )
+    finally:
+        _device_flags.PAIRING_MIN_SETS = prior
+    assert [f.index for f in report.failures] == [3, 7]
+    assert report.failures[0].error is not None
+    # the sharded pairing really proved windows (and the storm's bad
+    # proposer signature really rolled one back through it)
+    assert tel_metrics.counter("mesh.engage").value() > engage_base
+    assert (
+        tel_metrics.counter("bls.pairing_route.device").value()
+        > device_base
+    )
+
+
+# ---------------------------------------------------------------------------
+# the 2-device smoke (subprocess: a REAL multi-device platform)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh_smoke
+def test_mesh_smoke_two_devices():
+    """The ``make mesh-smoke`` gate: on a 2-device virtual mesh, one
+    mesh-sharded epoch pass (odd registry — padding live) and one
+    mesh-sharded RLC flush window, each bit-identical to the host path,
+    with engage evidence in the journal."""
+    out = run_in_cpu_mesh(
+        """
+import os
+os.environ["ECT_MESH"] = "2"
+os.environ["ECT_MESH_EPOCH_MIN_N"] = "1"
+os.environ["ECT_MESH_MERKLE_MIN_CHUNKS"] = "64"
+import sys
+sys.path.insert(0, "tests")
+import numpy as np
+import chain_utils
+from ethereum_consensus_tpu import _device_flags
+from ethereum_consensus_tpu.crypto import bls
+from ethereum_consensus_tpu.models.deneb import containers as dc
+from ethereum_consensus_tpu.models.deneb.slot_processing import process_slots
+from ethereum_consensus_tpu.telemetry import device as tel_device
+from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+ctx = chain_utils.Context.for_mainnet()
+ns = dc.build(ctx.preset)
+slots = int(ctx.SLOTS_PER_EPOCH)
+n = 4099  # odd: non-power-of-two padding live on both devices
+state, _ = chain_utils.fast_registry_state(n, "deneb")
+process_slots(state, slots, ctx)
+state.previous_epoch_participation = [0b111] * n
+for i in range(0, n, 5):
+    state.previous_epoch_participation[i] = 0b001
+for i in range(0, n, 9):
+    state.inactivity_scores[i] = 7
+
+tel_device.start()
+mesh_state = state.copy()
+process_slots(mesh_state, 2 * slots, ctx)
+engages = tel_metrics.counter("mesh.engage").value()
+assert engages >= 1, "mesh epoch pass did not engage"
+os.environ["ECT_MESH"] = "off"
+host_state = state.copy()
+process_slots(host_state, 2 * slots, ctx)
+assert ns.BeaconState.hash_tree_root(mesh_state) == ns.BeaconState.hash_tree_root(host_state)
+assert ns.BeaconState.serialize(mesh_state) == ns.BeaconState.serialize(host_state)
+os.environ["ECT_MESH"] = "2"
+print("epoch-identical")
+
+# one sharded RLC flush window vs the host engine, incl. a tampered set
+sks = [bls.SecretKey(i + 7) for i in range(1, 7)]
+msgs = [bytes([i]) * 32 for i in range(6)]
+sets = [
+    bls.SignatureSet([sk.public_key()], m, sk.sign(m))
+    for sk, m in zip(sks, msgs)
+]
+host = bls.verify_signature_sets(sets)
+host_route = bls.last_batch_route()
+_device_flags.PAIRING_MIN_SETS = 1
+mesh_v = bls.verify_signature_sets(sets)
+mesh_route = bls.last_batch_route()
+assert mesh_v == host == [True] * 6
+assert mesh_route == "device" and host_route == "host", (mesh_route, host_route)
+bad = list(sets)
+bad[2] = bls.SignatureSet(bad[2].public_keys, b"x" * 32, bad[2].signature)
+assert bls.verify_signature_sets(bad) == [True, True, False, True, True, True]
+_device_flags.PAIRING_MIN_SETS = None
+tallies = tel_device.OBSERVATORY.route_tallies()
+assert tallies.get("mesh.pairing", {}).get("device", 0) >= 2, tallies
+assert tallies.get("mesh.epoch", {}).get("device", 0) >= 1, tallies
+print("pairing-identical")
+print("mesh-smoke-ok", tallies.get("mesh.epoch"), tallies.get("mesh.pairing"))
+""",
+        n_devices=2,
+        timeout=420,
+    )
+    assert "epoch-identical" in out
+    assert "pairing-identical" in out
+    assert "mesh-smoke-ok" in out

@@ -72,6 +72,11 @@ def _default_targets(root: str) -> dict:
             # surface, and its bitfield matrices are aliasflow's
             # column-buffer class
             os.path.join(root, _PKG, "pool"),
+            # the mesh layer pads/ships epoch columns and flush batches
+            # to devices — any in-place write to a shared column buffer
+            # before the dispatch would corrupt the host twin it must
+            # stay bit-identical to (aliasflow's column-buffer class)
+            os.path.join(root, _PKG, "parallel"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -96,6 +101,11 @@ def _default_targets(root: str) -> dict:
             # settling thread, and the spam/producer drivers — lock
             # discipline and acquisition order are load-bearing
             os.path.join(root, _PKG, "pool"),
+            # the mesh runtime provisions once per process under a
+            # double-checked lock while epoch passes, verifier lanes,
+            # and merkle rebuilds consult it concurrently; its decline
+            # one-shot set mirrors epoch_vector's fallback discipline
+            os.path.join(root, _PKG, "parallel"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
