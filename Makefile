@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke pool-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke pool-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
 
 PROFILE_DIR ?= profile_artifacts
 
@@ -30,8 +30,8 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke'
 
 mesh-smoke:  ## 2-device virtual mesh: one sharded epoch pass + one sharded RLC flush window, bit-identical to host
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_runtime.py -q -m mesh_smoke
@@ -41,6 +41,9 @@ chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundar
 
 pool-smoke:  ## operation-pool write plane: client round-trips, block publication, attester-slashing storm
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pool.py -q -m pool_smoke
+
+soak-smoke:  ## short deterministic production soak: storm + faults + readers + SSE + pool traffic, all three gates asserted
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m soak_smoke
 
 bench-diff:  ## per-phase diff of two bench evidence files: make bench-diff A=old.json B=new.json
 	$(PY) bench_compare.py $(A) $(B)

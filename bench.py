@@ -2447,6 +2447,189 @@ def _pool_backend_name() -> str:
         return "unknown"
 
 
+def _soak_mesh_fault_segment() -> dict:
+    """Fault injection under the MESH route (ISSUE 13 acceptance): the
+    same short storm schedule runs twice — once with ``ECT_MESH=1``
+    (the sharded pairing + epoch routes forced on, device faults
+    injected via ``FaultInjector.fail_mesh``) and once host-routed —
+    and must land on the SAME final root with every corruption blamed
+    exactly (``run_storm`` asserts blame internally). Journal evidence:
+    the injected-fault declines and the mesh engages both routes paid
+    around them (recovery = the host fallback, bit-identical)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import chain_utils
+
+    from ethereum_consensus_tpu import _device_flags
+    from ethereum_consensus_tpu.parallel import runtime as mesh_runtime
+    from ethereum_consensus_tpu.pipeline import FaultInjector
+    from ethereum_consensus_tpu.scenarios import (
+        bad_proposer_signature,
+        bad_state_root,
+        run_storm,
+    )
+    from ethereum_consensus_tpu.scenarios.harness import forced_columnar
+    from ethereum_consensus_tpu.telemetry import device as tel_device
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    # 18 blocks = TWO epoch boundaries on the minimal preset: the
+    # second one's transition runs the inactivity/rewards sweeps (the
+    # first is the genesis epoch, which skips them), so the epoch
+    # fault point is actually reachable
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 64, "minimal")
+    blocks = chain_utils.produce_chain(state, ctx, 18, fork_name="deneb",
+                                       atts_per_block=1)
+    plan = {3: bad_proposer_signature, 12: bad_state_root}
+
+    def storm(fault_injector=None):
+        with forced_columnar():
+            report, ex = run_storm(
+                state, ctx, blocks, plan, sign=chain_utils.sign_block,
+                fault_injector=fault_injector, check_states=False,
+                check_columns=False,
+            )
+        raw = ex.state.data
+        return report, bytes(type(raw).hash_tree_root(raw))
+
+    prior_env = os.environ.get("ECT_MESH")
+    prior_epoch_min = os.environ.get("ECT_MESH_EPOCH_MIN_N")
+    prior_pairing = _device_flags.PAIRING_MIN_SETS
+    os.environ["ECT_MESH"] = "1"
+    os.environ["ECT_MESH_EPOCH_MIN_N"] = "1"
+    mesh_runtime.reset()
+    _device_flags.PAIRING_MIN_SETS = 1
+    injector = FaultInjector()
+    injector.fail_mesh("pairing", 2).fail_mesh("epoch", 1).install_mesh()
+    injected_base = tel_metrics.counter(
+        "mesh.decline.injected_fault"
+    ).value()
+    routes_base = tel_device.OBSERVATORY.route_tallies()
+    try:
+        mesh_report, mesh_root = storm(fault_injector=injector)
+    finally:
+        injector.uninstall_mesh()
+        _device_flags.PAIRING_MIN_SETS = prior_pairing
+        if prior_env is None:
+            os.environ.pop("ECT_MESH", None)
+        else:
+            os.environ["ECT_MESH"] = prior_env
+        if prior_epoch_min is None:
+            os.environ.pop("ECT_MESH_EPOCH_MIN_N", None)
+        else:
+            os.environ["ECT_MESH_EPOCH_MIN_N"] = prior_epoch_min
+        mesh_runtime.reset()
+    injected = (
+        tel_metrics.counter("mesh.decline.injected_fault").value()
+        - injected_base
+    )
+    routes_now = tel_device.OBSERVATORY.route_tallies()
+
+    def engages(kind):
+        return routes_now.get(kind, {}).get("device", 0) - routes_base.get(
+            kind, {}
+        ).get("device", 0)
+
+    host_report, host_root = storm()
+    fault_kinds = sorted(
+        kind for _s, _a, kind in injector.injected
+    )
+    return {
+        "ok": bool(
+            mesh_root == host_root
+            and injected == 3
+            and fault_kinds == ["mesh_epoch", "mesh_pairing",
+                                "mesh_pairing"]
+            and engages("mesh.pairing") >= 1
+            and engages("mesh.epoch") >= 1
+            and len(mesh_report.failures) == len(plan)
+            and len(host_report.failures) == len(plan)
+        ),
+        "final_root_identical": bool(mesh_root == host_root),
+        "final_root": "0x" + mesh_root.hex(),
+        "injected_faults": injected,
+        "fault_kinds": fault_kinds,
+        "mesh_pairing_engages": engages("mesh.pairing"),
+        "mesh_epoch_engages": engages("mesh.epoch"),
+        "storm_failures": len(mesh_report.failures),
+        "blame": [
+            {"index": f.index, "mutator": f.mutator.name,
+             "error": type(f.error).__name__}
+            for f in mesh_report.failures
+        ],
+        "note": (
+            "same schedule, mesh vs host route: injected device faults "
+            "on the sharded pairing/epoch paths journal as "
+            "mesh.decline.injected_fault and recover through the host "
+            "fallback — blame and the final root are differential-"
+            "identical to the host-route run"
+        ),
+    }
+
+
+def bench_soak(cycles: int = 150, deadline_s: float = 210.0,
+               min_windows: int = 800):
+    """Production soak (soak/, docs/SOAK.md — ISSUE 13): the sustained
+    mixed-load run the north star asks for. Fork-boundary storm cycles
+    + rotating fault injection + a reader swarm + SSE subscribers +
+    pool ingestion spam + deterministic equivocation (double AND
+    surround) traffic, for thousands of flush windows under a deadline
+    budget, with the three hard gates folded into ``ok``: p99
+    verify/settle/gather SLOs off the reservoir histograms with
+    /healthz pinned to ``ok``, flat RSS via the leak sentinel, and
+    end-of-run bit-identity (cycle roots vs the scalar oracle, exact
+    blame, equivocation-ledger refeed identity, surfaced slashings —
+    surround included — executing in soak-produced blocks). A second
+    segment proves fault injection under the MESH route:
+    differential-identical to the host-route run of the same schedule.
+
+    Headline: the sustained blocks/s + queries/s pair."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from ethereum_consensus_tpu.soak import SoakConfig, run_soak
+
+    if _fast_test():
+        cycles, deadline_s, min_windows = 3, 60.0, 20
+    elif _degraded():
+        cycles = min(cycles, 120)
+    config = SoakConfig(
+        cycles=cycles,
+        deadline_s=deadline_s,
+        min_windows=min_windows,
+        readers=2,
+        sse_subscribers=1,
+        pool_spam_rounds=200,
+        equivocate_every=3,
+        rss_budget_mb=192.0,
+        rss_warmup_cycles=5,
+        seed=0x5013,
+    )
+    report = run_soak(config)
+    mesh_segment = _soak_mesh_fault_segment()
+    return {
+        "ok": bool(report["ok"] and mesh_segment["ok"]),
+        "blocks_per_s": report["blocks_per_s"],
+        "queries_per_s": report["queries_per_s"],
+        "cycles": report["cycles"],
+        "windows": report["windows"],
+        "blocks_committed": report["blocks_committed"],
+        "wall_s": report["wall_s"],
+        "storm_failures": report["storm_failures"],
+        "faults_injected": report["faults_injected"],
+        "gates": report["gates"],
+        "pool_spam": report["pool_spam"],
+        "readers": report["readers"],
+        "sse_events": report["sse_events"],
+        "verify_lanes": report["config"]["verify_lanes"],
+        "mesh_fault_injection": mesh_segment,
+        "note": (
+            "sustained mixed load over the phase0->electra upgrade "
+            "chain: every cycle replays the storm-corrupted chain "
+            "through the pipeline with recovery while readers, SSE "
+            "subscribers, and pool spam run concurrently; ok folds the "
+            "three soak gates (SLO/healthz, flat RSS, bit-identity) "
+            "AND the mesh-route fault-injection differential"
+        ),
+    }
+
+
 def bench_process_block():
     """Full block application incl. batched signature verification and the
     per-slot state HTR (minimal preset — the Python orchestration floor;
@@ -2514,6 +2697,11 @@ CONFIGS = [
     ("multichip_pipeline", bench_multichip_pipeline),
     ("serving_queries", bench_serving_queries),
     ("pool_ingest", bench_pool_ingest),
+    # the sustained mixed-load soak (ISSUE 13): composes the pipeline,
+    # scenario, serving, pool, and mesh layers above into one run with
+    # SLO / flat-RSS / bit-identity gates — before the tail configs so
+    # the deadline can never starve the acceptance
+    ("soak", bench_soak),
     # the single heaviest cold-cache build (2^20-validator registry):
     # after the priority numbers, and self-bounding via _child_elapsed
     ("state_htr", bench_state_htr),

@@ -623,12 +623,16 @@ def _inactivity_updates(ec) -> None:
             )
             return
         except Exception as exc:  # noqa: BLE001 — host fallback
-            from ..parallel import runtime as _mesh_runtime
+            # an injected fault (runtime.fault_point) already journaled
+            # its own decline as injected_fault; journaling it again as
+            # device_unusable would double-count the one routing decision
+            if not getattr(exc, "mesh_fault", False):
+                from ..parallel import runtime as _mesh_runtime
 
-            _mesh_runtime.decline(
-                "epoch", "device_unusable", stage="inactivity",
-                error=repr(exc)[:160],
-            )
+                _mesh_runtime.decline(
+                    "epoch", "device_unusable", stage="inactivity",
+                    error=repr(exc)[:160],
+                )
     ec.inact = inactivity_scores_kernel(
         ec.np,
         ec.inact,
@@ -791,10 +795,13 @@ def _mesh_rewards(ec, brpi: int, active_increments: int,
             target_flag_index=_TIMELY_TARGET_FLAG_INDEX,
         )
     except Exception as exc:  # noqa: BLE001 — host fallback
-        _mesh_runtime.decline(
-            "epoch", "device_unusable", stage="rewards",
-            error=repr(exc)[:160],
-        )
+        # injected faults journaled at the seam (fault_point) — see the
+        # inactivity catch site
+        if not getattr(exc, "mesh_fault", False):
+            _mesh_runtime.decline(
+                "epoch", "device_unusable", stage="rewards",
+                error=repr(exc)[:160],
+            )
         return None
     if new_balances is None:
         # a u64 wrap the lane guards should have made unreachable: the

@@ -208,7 +208,15 @@ class MeshEpochSweeps:
                           recovery_rate: int, leaking: bool):
         """Sharded ``process_inactivity_updates`` sweep; returns the new
         scores column (numpy uint64, original length)."""
+        from . import runtime as _runtime
+
         n = scores.shape[0]
+        # fault-injection seam: an injected fault raises before any
+        # dispatch, and the caller's device-trouble fallback (the host
+        # kernel) recovers bit-identically — blame journaled by the seam
+        _runtime.fault_point(
+            "epoch", stage="inactivity", validators=n, devices=self.n_dev
+        )
         kernel = _inactivity_sharded(
             self.mesh, int(bias), int(recovery_rate), bool(leaking)
         )
@@ -229,7 +237,12 @@ class MeshEpochSweeps:
         """The full rewards stage, sharded; returns the new balances
         column — or ``None`` when a u64 wrap surfaced (caller falls back
         to the host path and its literal overflow mirror)."""
+        from . import runtime as _runtime
+
         n = balances.shape[0]
+        _runtime.fault_point(
+            "epoch", stage="rewards", validators=n, devices=self.n_dev
+        )
         kernel = _rewards_sharded(
             self.mesh,
             tuple(int(w) for w in weights),

@@ -91,6 +91,13 @@ def miller_partials_sharded(mesh, pk_raws, h_raws, sig_raws, scalars):
     n = len(pk_raws)
     n_dev = mesh.devices.size
     assert n and len(h_raws) == n and len(sig_raws) == n and len(scalars) == n
+    # fault-injection seam (runtime.install_fault_hook): an injected
+    # fault surfaces here exactly where real device trouble would — the
+    # caller's device-unusable fallback recovers on the host engine with
+    # identical verdicts, the decline journaled as injected_fault
+    from . import runtime as _runtime
+
+    _runtime.fault_point("pairing", sets=n, devices=int(n_dev))
 
     k = _pad_width(n, n_dev)
     width = n_dev * k

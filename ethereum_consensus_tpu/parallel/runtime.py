@@ -49,12 +49,15 @@ __all__ = [
     "MERKLE_MIN_ENV",
     "DEFAULT_EPOCH_MIN_N",
     "DEFAULT_MERKLE_MIN_CHUNKS",
+    "MeshFaultInjected",
     "requested",
     "mesh",
     "device_count",
     "status",
     "epoch_sweeps",
     "pairing_mesh",
+    "install_fault_hook",
+    "fault_point",
     "reset",
 ]
 
@@ -72,8 +75,23 @@ _LOCK = threading.Lock()
 # None = not yet attempted; (mesh_or_None, reason) afterwards
 _PROVISIONED: "tuple | None" = None
 
-_DECLINE_SEEN: set = set()
+# one-shot decline events re-arm on reason CHANGE: the event marks the
+# newest distinct decline cause per route kind, so a long soak that
+# flips thresholds mid-run (A -> B -> back to A) journals every
+# transition instead of going silent after each reason's first firing
+# (the counters still count every occurrence)
+_DECLINE_LAST: dict = {}
 _DECLINE_LOCK = threading.Lock()
+
+
+class MeshFaultInjected(RuntimeError):
+    """An injected mesh-route fault (pipeline/faults.FaultInjector's
+    device lane): raised from inside a sharded path so the host fallback
+    recovers exactly as it would from real device trouble. ``mesh_fault``
+    marks it for the catch sites that must not double-journal (the
+    fault point already declined as ``injected_fault``)."""
+
+    mesh_fault = True
 
 
 def requested() -> bool:
@@ -91,11 +109,10 @@ def _decline(kind: str, reason: str, **inputs) -> None:
     _metrics.counter(f"mesh.decline.{reason}").inc()
     if _device_obs.OBSERVATORY.active:
         _device_obs.route(f"mesh.{kind}", "host", reason, **inputs)
-    key = (kind, reason)
-    if key not in _DECLINE_SEEN:
+    if _DECLINE_LAST.get(kind) != reason:
         with _DECLINE_LOCK:
-            if key not in _DECLINE_SEEN:
-                _DECLINE_SEEN.add(key)
+            if _DECLINE_LAST.get(kind) != reason:
+                _DECLINE_LAST[kind] = reason
                 trace.event(
                     "mesh.decline", kind=kind, reason=reason, **inputs
                 )
@@ -211,6 +228,46 @@ def _threshold(env_key: str, default: int) -> int:
         return default
 
 
+# -- fault injection under the mesh route ------------------------------------
+
+# one process-wide hook, written under _FAULT_LOCK and read lock-free on
+# the routed paths (a plain attribute load; None = no injector armed).
+# The hook is a callable (kind: str) -> bool: True consumes one planned
+# fault for that route kind (pipeline/faults.FaultInjector.mesh_hook).
+_FAULT_HOOK = None
+_FAULT_LOCK = threading.Lock()
+
+
+def install_fault_hook(hook) -> None:
+    """Arm (or with ``None`` disarm) the mesh fault-injection seam. The
+    sharded paths (parallel/pairing.py, parallel/epoch.py) call
+    ``fault_point`` on entry; a consumed fault raises
+    ``MeshFaultInjected`` there, and the host fallback that catches real
+    device trouble recovers it the same way — degrade, blame, recover,
+    all journaled (``mesh.decline.injected_fault``)."""
+    global _FAULT_HOOK
+    with _FAULT_LOCK:
+        _FAULT_HOOK = hook
+
+
+def fault_point(kind: str, **inputs) -> None:
+    """The injection seam the sharded paths run on entry: when an
+    installed hook consumes a planned fault for ``kind``, journal the
+    decline (counter + re-armable event + routing-journal entry, the
+    standard no-silent-declines treatment) and raise
+    ``MeshFaultInjected`` — the caller's existing device-trouble
+    fallback then recovers on the host path with identical results."""
+    hook = _FAULT_HOOK
+    if hook is None:
+        return
+    if not hook(kind):
+        return
+    _decline(kind, "injected_fault", **inputs)
+    raise MeshFaultInjected(
+        f"injected mesh fault on the {kind} route"
+    )
+
+
 # -- the three routed hot paths ----------------------------------------------
 
 
@@ -322,7 +379,8 @@ def reset() -> None:
     with _LOCK:
         _PROVISIONED = None
         with _DECLINE_LOCK:
-            _DECLINE_SEEN.clear()
+            _DECLINE_LAST.clear()
+        install_fault_hook(None)
         from ..ssz import merkle as ssz_merkle
 
         ssz_merkle.register_mesh_merkleizer(None, None)

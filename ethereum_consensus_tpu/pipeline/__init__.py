@@ -19,7 +19,7 @@ the device pairing route engages underneath ``crypto.bls`` exactly when
 from .engine import ChainPipeline
 from .errors import PipelineBrokenError, TransientFlushError, WorkerKilled
 from .faults import FaultInjector
-from .scheduler import FlushPolicy, VerifyScheduler, Window
+from .scheduler import FlushPolicy, VerifyScheduler, Window, auto_verify_lanes
 from .stats import PipelineStats
 
 __all__ = [
@@ -32,4 +32,5 @@ __all__ = [
     "VerifyScheduler",
     "Window",
     "WorkerKilled",
+    "auto_verify_lanes",
 ]

@@ -22,6 +22,17 @@ Three fault shapes, matching the hardening they exercise
   bounded settle raises ``PipelineBrokenError`` with the stuck window's
   attribution instead of deadlocking the submitter.
 
+A fourth lane targets the MESH route (parallel/runtime.py): the injector
+holds a per-kind budget of device faults (``fail_mesh("pairing"|
+"epoch", times)``) consumed by ``runtime.fault_point`` inside the
+sharded paths (parallel/pairing.py, parallel/epoch.py) while the
+injector is installed (``install_mesh``/``uninstall_mesh``) — an
+injected fault surfaces exactly where real device trouble would, the
+decline is journaled (``mesh.decline.injected_fault``), and the host
+fallback recovers with bit-identical results. Mesh injections land in
+the same ``injected`` audit log with seq/attempt ``None`` (the mesh
+seam is route-scoped, not window-scoped).
+
 Thread-safety: the plan is written from the test/driver thread and read
 from both the engine thread (hook_for) and the worker (the hook itself);
 every access holds the instance lock.
@@ -47,6 +58,7 @@ class FaultInjector:
         self._transient: dict = {}   # seq -> remaining failures
         self._kill: set = set()      # seqs whose worker dies mid-flush
         self._delay: dict = {}       # seq -> seconds of worker stall
+        self._mesh: dict = {}        # route kind -> remaining device faults
         self._injected: list = []    # (seq, attempt, kind) audit log
 
     # -- plan construction (driver side) -------------------------------------
@@ -69,6 +81,42 @@ class FaultInjector:
         with self._lock:
             self._delay[seq] = float(seconds)
         return self
+
+    def fail_mesh(self, kind: str, times: int = 1) -> "FaultInjector":
+        """Plan ``times`` device faults on the mesh route ``kind``
+        (``"pairing"`` / ``"epoch"``), consumed by
+        ``parallel.runtime.fault_point`` while this injector is
+        installed (``install_mesh``)."""
+        with self._lock:
+            self._mesh[kind] = self._mesh.get(kind, 0) + int(times)
+        return self
+
+    def install_mesh(self) -> "FaultInjector":
+        """Arm the process-wide mesh fault seam with this injector's
+        plan (parallel/runtime.install_fault_hook). Callers must
+        ``uninstall_mesh`` when done — the seam is process-wide."""
+        from ..parallel import runtime as _mesh_runtime
+
+        _mesh_runtime.install_fault_hook(self.mesh_hook)
+        return self
+
+    def uninstall_mesh(self) -> None:
+        from ..parallel import runtime as _mesh_runtime
+
+        _mesh_runtime.install_fault_hook(None)
+
+    def mesh_hook(self, kind: str) -> bool:
+        """The seam's consumption callback: True exactly when a planned
+        mesh fault for ``kind`` exists (one is consumed and audited)."""
+        with self._lock:
+            remaining = self._mesh.get(kind, 0)
+            if remaining <= 0:
+                return False
+            self._mesh[kind] = remaining - 1
+            self._injected.append((None, None, f"mesh_{kind}"))
+        metrics.counter(f"pipeline.fault.injected.mesh_{kind}").inc()
+        trace.event("pipeline.fault.injected", kind=f"mesh_{kind}")
+        return True
 
     @property
     def injected(self) -> list:

@@ -34,6 +34,7 @@ window degraded.
 
 from __future__ import annotations
 
+import os
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 
@@ -43,7 +44,35 @@ from ..utils import trace
 from .errors import PipelineBrokenError, TransientFlushError, WorkerKilled
 from .stats import PipelineStats
 
-__all__ = ["FlushPolicy", "VerifyScheduler", "Window"]
+__all__ = ["FlushPolicy", "VerifyScheduler", "Window", "auto_verify_lanes"]
+
+# verifier-lane auto-sizing cap: each lane is one persistent
+# single-thread pool (crypto/bls._verify_pool), so an unbounded core
+# count must not spawn an unbounded worker census
+_AUTO_LANES_CAP = 8
+
+
+def auto_verify_lanes() -> int:
+    """The lane count an unset ``FlushPolicy(verify_lanes=...)``
+    resolves to: ``min(cpu_cores, mesh devices)`` when the mesh runtime
+    is switched on (``ECT_MESH`` — each mesh dispatch already owns the
+    device axis, so more lanes than devices just queue), plain
+    ``cpu_cores`` otherwise (one GIL-released native pairing per core),
+    capped at 8 lanes and floored at 1. The consult is a plain env read
+    first — a mesh-off process never imports jax here."""
+    cores = os.cpu_count() or 1
+    lanes = cores
+    # the env read duplicates runtime.requested() on purpose: importing
+    # ethereum_consensus_tpu.parallel pays the jax import, so the
+    # mesh-off path must decide without it (the epoch_vector idiom)
+    value = os.environ.get("ECT_MESH", "").strip().lower()
+    if value not in ("", "off", "0", "none", "host"):
+        from ..parallel import runtime as _mesh_runtime
+
+        devices = _mesh_runtime.device_count()
+        if devices:
+            lanes = min(cores, devices)
+    return max(1, min(lanes, _AUTO_LANES_CAP))
 
 
 class FlushPolicy:
@@ -86,7 +115,11 @@ class FlushPolicy:
       OLDEST window first and blocks on its future, so commits stay in
       chain order no matter which lane finishes first. Raise
       ``max_in_flight`` to at least ``verify_lanes`` or the backpressure
-      wait will idle the extra lanes.
+      wait will idle the extra lanes. Unset (``None``) auto-sizes from
+      the machine: ``min(cpu_cores, mesh devices)`` under ``ECT_MESH``,
+      ``cpu_cores`` otherwise, capped at 8 (``auto_verify_lanes`` — the
+      production-soak default; a single-core box resolves to the
+      historical 1).
     """
 
     __slots__ = (
@@ -99,7 +132,7 @@ class FlushPolicy:
                  checkpoint_interval: int = 8, flush_empty: bool = False,
                  settle_timeout_s: "float | None" = 300.0,
                  flush_retries: int = 2, retry_backoff_s: float = 0.05,
-                 verify_lanes: int = 1):
+                 verify_lanes: "int | None" = None):
         if window_size < 1:
             raise ValueError("window_size must be >= 1")
         if max_in_flight < 1:
@@ -110,6 +143,8 @@ class FlushPolicy:
             raise ValueError("settle_timeout_s must be positive or None")
         if flush_retries < 0:
             raise ValueError("flush_retries must be >= 0")
+        if verify_lanes is None:
+            verify_lanes = auto_verify_lanes()
         if verify_lanes < 1:
             raise ValueError("verify_lanes must be >= 1")
         self.window_size = window_size
@@ -285,6 +320,19 @@ class VerifyScheduler:
         finally:
             window.verify_s += time.perf_counter() - t0
 
+    @staticmethod
+    def _observe_settled(window: Window) -> None:
+        """Feed the window's stage-B latencies into the process-wide SLO
+        histograms (bounded reservoirs, telemetry/metrics.py) — the
+        production soak's p99 gates read these directly, so they observe
+        unconditionally (two reservoir inserts per WINDOW, not per
+        block; noise against a multi-pairing)."""
+        _metrics.histogram("pipeline.verify_s").observe(window.verify_s)
+        if window.t_dispatch is not None and window.t_settled is not None:
+            _metrics.histogram("pipeline.settle_s").observe(
+                max(0.0, window.t_settled - window.t_dispatch)
+            )
+
     def settle_oldest(self) -> "tuple[Window, list[bool]]":
         """Block until the oldest in-flight window's verdicts are in;
         returns (window, per-set verdicts in call-site order).
@@ -306,6 +354,7 @@ class VerifyScheduler:
                         timeout=policy.settle_timeout_s
                     )
                     window.t_settled = time.perf_counter()
+                    self._observe_settled(window)
                     return window, verdicts
                 except (_FutureTimeout, TimeoutError):
                     _metrics.counter("pipeline.fault.settle_timeout").inc()
@@ -338,6 +387,7 @@ class VerifyScheduler:
                         )
                         verdicts = self._verify_inline(window)
                         window.t_settled = time.perf_counter()
+                        self._observe_settled(window)
                         return window, verdicts
                     _metrics.counter("pipeline.fault.retries").inc()
                     self.stats.fault_retry()
@@ -364,6 +414,7 @@ class VerifyScheduler:
                     )
                     verdicts = self._verify_inline(window)
                     window.t_settled = time.perf_counter()
+                    self._observe_settled(window)
                     return window, verdicts
 
     def drop_all(self) -> "list[Window]":

@@ -170,15 +170,19 @@ class AggregateGroup:
 
 class _VoteRecord:
     """One verified attester vote per (validator, target_epoch): enough
-    of the indexed attestation to rebuild it for a slashing."""
+    of the indexed attestation to rebuild it for a slashing. The source
+    epoch is denormalized out of ``data`` so the surround scan reads an
+    int per record instead of walking SSZ containers."""
 
-    __slots__ = ("data_root", "indices", "data", "signature")
+    __slots__ = ("data_root", "indices", "data", "signature",
+                 "source_epoch")
 
     def __init__(self, data_root: bytes, indices, data, signature: bytes):
         self.data_root = bytes(data_root)
         self.indices = tuple(int(i) for i in indices)
         self.data = data
         self.signature = bytes(signature)
+        self.source_epoch = int(data.source.epoch)
 
 
 class OperationPool:
@@ -272,12 +276,24 @@ class OperationPool:
     def note_votes(self, attesting_indices, data, data_root: bytes,
                    signature: bytes, builder) -> list:
         """Record one verified attestation's votes; returns any
-        ``AttesterSlashing`` containers surfaced by a contradiction
-        (same validator, same target epoch, different data — the
-        double-vote arm of ``is_slashable_attestation_data``).
+        ``AttesterSlashing`` containers surfaced by a contradiction —
+        BOTH arms of ``is_slashable_attestation_data``:
+
+        * **double vote** — same validator, same target epoch, different
+          data (the ledger's primary key collides);
+        * **surround vote** — the same validator's vote in ANOTHER
+          target epoch where one vote's (source, target) span strictly
+          contains the other's (``source_1 < source_2`` and
+          ``target_2 < target_1``). The scan walks the validator's
+          records across the ledger's target-epoch maps — O(live
+          epochs) int compares per attester, and the spec's surround
+          arm needs exactly the cross-epoch records the ledger already
+          keeps (docs/POOL.md).
 
         ``builder`` is the fork namespace used to rebuild the two
-        ``IndexedAttestation`` halves. Slashings land in the pool's own
+        ``IndexedAttestation`` halves; ``attestation_1`` is always the
+        half the spec predicate orders first (the earlier double vote /
+        the SURROUNDING vote). Slashings land in the pool's own
         attester-slashing pool as well as being returned."""
         data_root = bytes(data_root)
         target_epoch = int(data.target.epoch)
@@ -290,30 +306,47 @@ class OperationPool:
                 epoch_votes = self._votes[target_epoch] = {}
             if len(epoch_votes) >= self._max_votes:
                 epoch_votes.clear()  # bounded ledger, epoch-scoped
+            pairs = []  # (surrounding-or-earlier, other) in spec order
             for index in record.indices:
                 prior = epoch_votes.setdefault(index, record)
                 if prior is not record and prior.data_root != data_root:
-                    slashing = builder.AttesterSlashing(
-                        attestation_1=builder.IndexedAttestation(
-                            attesting_indices=list(prior.indices),
-                            data=prior.data.copy(),
-                            signature=prior.signature,
-                        ),
-                        attestation_2=builder.IndexedAttestation(
-                            attesting_indices=list(record.indices),
-                            data=record.data.copy(),
-                            signature=record.signature,
-                        ),
-                    )
-                    root = bytes(
-                        type(slashing).hash_tree_root(slashing)
-                    )
-                    if root not in self._attester_slashings:
-                        self._attester_slashings[root] = slashing
-                        surfaced.append(slashing)
+                    pairs.append((prior, record))
+                for other_epoch, other_votes in self._votes.items():
+                    if other_epoch == target_epoch:
+                        continue
+                    other = other_votes.get(index)
+                    if other is None:
+                        continue
+                    if (other.source_epoch < record.source_epoch
+                            and target_epoch < other_epoch):
+                        # the OTHER vote surrounds the new one
+                        pairs.append((other, record))
+                    elif (record.source_epoch < other.source_epoch
+                            and other_epoch < target_epoch):
+                        # the new vote surrounds the other
+                        pairs.append((record, other))
+            for first, second in pairs:
+                slashing = _build_slashing(first, second, builder)
+                root = bytes(type(slashing).hash_tree_root(slashing))
+                if root not in self._attester_slashings:
+                    self._attester_slashings[root] = slashing
+                    surfaced.append(slashing)
         for _ in surfaced:
             _metrics.counter("pool.slashings_surfaced").inc()
         return surfaced
+
+    def vote_ledger_digest(self) -> "list":
+        """A deterministic digest of the equivocation ledger — one
+        ``(target_epoch, validator, data_root hex, source_epoch)`` row
+        per recorded vote, sorted — the production soak's end-of-run
+        ledger bit-identity comparand (docs/SOAK.md)."""
+        with self._lock:
+            return sorted(
+                (epoch, index, record.data_root.hex(),
+                 record.source_epoch)
+                for epoch, votes in self._votes.items()
+                for index, record in votes.items()
+            )
 
     # -- singleton op pools --------------------------------------------------
     def insert_voluntary_exit(self, signed_exit) -> bool:
@@ -475,6 +508,24 @@ class OperationPool:
             f"{c['voluntary_exits']} exits, "
             f"{c['attester_slashings']} att-slashings)"
         )
+
+
+def _build_slashing(first, second, builder):
+    """One ``AttesterSlashing`` from two vote records already ordered
+    for ``is_slashable_attestation_data`` (attestation_1 = the earlier
+    double vote / the surrounding vote)."""
+    return builder.AttesterSlashing(
+        attestation_1=builder.IndexedAttestation(
+            attesting_indices=list(first.indices),
+            data=first.data.copy(),
+            signature=first.signature,
+        ),
+        attestation_2=builder.IndexedAttestation(
+            attesting_indices=list(second.indices),
+            data=second.data.copy(),
+            signature=second.signature,
+        ),
+    )
 
 
 def _group_sort_key(key):

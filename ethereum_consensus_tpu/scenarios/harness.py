@@ -181,16 +181,45 @@ def oracle_replay(pre_state, context, blocks, capture_at=()):
 
 
 def _advance_to_slot(state_wrapper, slot: int, context):
-    """A copy of the wrapped state advanced to ``slot`` under its own
-    fork's rules (the mutator pre-state for proposer re-signing)."""
-    from ..types import fork_module
+    """A copy of the wrapped state advanced to ``slot`` — UPGRADE-AWARE
+    (the mutator pre-state for proposer re-signing): when ``slot``
+    crosses a fork activation, the intermediate boundaries run exactly
+    the executor's ladder (slots under the old fork's rules, then the
+    upgrade function), so a block sitting ON an upgrade slot re-signs
+    under the NEW fork's domain. Advancing with only the old fork's
+    ``process_slots`` — the pre-soak behavior — produced a state whose
+    fork version (and therefore signing domain) was stale, turning a
+    re-signed ``bad_state_root`` corruption into a bogus
+    ``InvalidBlock`` at the proposer-signature check."""
+    from ..executor import _UPGRADE_FN
+    from ..types import FORK_SEQUENCE, fork_module
 
     copied = state_wrapper.copy()
-    if int(copied.data.slot) < slot:
-        fork_module(copied.version()).slot_processing.process_slots(
-            copied.data, slot, context
+    state = copied.data
+    fork = copied.version()
+    target_epoch = slot // int(context.SLOTS_PER_EPOCH)
+    destination = fork
+    for candidate in FORK_SEQUENCE[fork + 1:]:
+        if int(context.fork_activation_epoch(candidate)) <= target_epoch:
+            destination = candidate
+    for next_fork in FORK_SEQUENCE[fork + 1: destination + 1]:
+        fork_slot = (
+            int(context.fork_activation_epoch(next_fork))
+            * int(context.SLOTS_PER_EPOCH)
         )
-    return copied.data
+        if int(state.slot) < fork_slot:
+            fork_module(fork).slot_processing.process_slots(
+                state, fork_slot, context
+            )
+        state = getattr(fork_module(next_fork), _UPGRADE_FN[next_fork])(
+            state, context
+        )
+        fork = next_fork
+    if int(state.slot) < slot:
+        fork_module(fork).slot_processing.process_slots(
+            state, slot, context
+        )
+    return state
 
 
 def build_corrupted_stream(pre_state, context, blocks, plan, sign=None,
@@ -291,13 +320,23 @@ class ReaderSwarm:
       snapshots cannot equal any single state's document.
 
     Threads come from a ``ThreadPoolExecutor`` (the repo's sanctioned
-    worker primitive); stop is a lock-held flag."""
+    worker primitive); stop is a lock-held flag.
 
-    def __init__(self, base_url: str, n_readers: int = 2, ids=(0, 1, 2, 3)):
+    ``max_samples`` bounds the RETAINED responses (every response past
+    the cap is still counted in ``samples_seen``, just not kept for the
+    offline verification) — a soak-length run would otherwise retain
+    hundreds of MB of response bodies and read as a leak to the very
+    sentinel it runs under (docs/SOAK.md). ``None`` keeps everything
+    (the storm families' historical behavior)."""
+
+    def __init__(self, base_url: str, n_readers: int = 2, ids=(0, 1, 2, 3),
+                 max_samples: "int | None" = None):
         self._lock = threading.Lock()
         self._base = base_url.rstrip("/")
         self._ids = tuple(int(i) for i in ids)
         self._stop = False
+        self._max_samples = max_samples
+        self.samples_seen = 0  # lock-held
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, n_readers), thread_name_prefix="chaos-reader"
         )
@@ -306,6 +345,12 @@ class ReaderSwarm:
         ]
         self.samples: list = []  # (endpoint, root_hex, data) — lock-held
         self.errors: list = []
+        # connection-level failures (timeout, reset, refused — no HTTP
+        # status): counted, not fatal. The torn-read contract is about
+        # response CONTENT; a loaded box stalling one urlopen is not
+        # evidence, and a genuinely dead server yields zero samples,
+        # which the callers' sample assertions catch.
+        self.connection_errors = 0
 
     def _should_stop(self) -> bool:
         with self._lock:
@@ -313,8 +358,11 @@ class ReaderSwarm:
 
     def _record(self, endpoint: str, doc) -> None:
         with self._lock:
-            self.samples.append((endpoint, doc.get("snapshot_root"),
-                                 doc.get("data")))
+            self.samples_seen += 1
+            if (self._max_samples is None
+                    or len(self.samples) < self._max_samples):
+                self.samples.append((endpoint, doc.get("snapshot_root"),
+                                     doc.get("data")))
 
     def _reader_loop(self, seed: int) -> None:
         import json as _json
@@ -337,10 +385,14 @@ class ReaderSwarm:
                 ) as response:
                     doc = _json.loads(response.read())
             except OSError as exc:
-                # 404 pre-first-commit is expected; anything else is
-                # evidence
+                # 404 pre-first-commit is expected; another HTTP status
+                # is evidence; a connection-level failure (no status —
+                # timeout/reset under load) is counted, not fatal
                 code = getattr(exc, "code", None)
-                if code != 404:
+                if code is None:
+                    with self._lock:
+                        self.connection_errors += 1
+                elif code != 404:
                     with self._lock:
                         self.errors.append((endpoint, repr(exc)))
                 continue

@@ -1,0 +1,138 @@
+"""Leak sentinel: the production soak's flat-RSS gate (docs/SOAK.md).
+
+The PR 9 copy-on-write test bounded ONE structure (four snapshot
+bundles' shared columns) for one operation. A sustained run leaks
+through any of half a dozen other retainers — serving snapshots pinned
+past the head-store horizon, flight-ring records that stopped
+evicting, pool aggregate matrices that never prune, mesh staging
+buffers kept alive by a stale closure — and a per-structure test can't
+see a leak it didn't anticipate. The sentinel watches the one number
+every leak eventually moves — process RSS — across the soak's cycles,
+plus an explicit census of the bounded structures so a tripped gate
+names its suspect instead of just "memory grew".
+
+Gate semantics (``LeakSentinel.gate``):
+
+* samples during the ``warmup`` cycles are recorded but EXCLUDED from
+  the verdict — caches (chain bundles, jit executables, pubkey FIFO,
+  committee memos) legitimately fill early;
+* after warmup, ``growth_mb`` = last sample − first post-warmup sample
+  must stay within ``budget_mb`` (``max_growth_mb`` is reported too —
+  a sawtooth that returns to baseline passes, a ratchet fails);
+* every watched census (``watch(name, fn, bound)``) must satisfy its
+  declared bound at the final sample — a structure that silently grew
+  past its design capacity trips the gate even before RSS notices.
+
+The gate is deliberately trip-ABLE: ``tests/test_soak.py`` runs a
+deliberately-leaky snapshot retainer through it and asserts the
+verdict comes back False — a sentinel that cannot fail is not a gate.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["LeakSentinel", "rss_mb"]
+
+
+def rss_mb() -> float:
+    """Current process resident set in MiB (/proc on Linux, ru_maxrss
+    peak as the degraded fallback elsewhere — the gate still bounds
+    growth, just of the high-watermark)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+class LeakSentinel:
+    """RSS + structure-census sampler with a flat-memory verdict.
+
+    Lock discipline: samples and watches are written from the soak
+    driver thread and read by ``gate()`` on the same thread in
+    production, but the instance lock guards every mutation anyway so a
+    background sampler (a future periodic thread) can share it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: list = []  # (label, rss_mb, {census name: value})
+        self._watches: list = []  # (name, fn, bound)
+
+    def watch(self, name: str, fn, bound: "int | None" = None) -> "LeakSentinel":
+        """Record ``fn()`` (an int census — ring length, snapshots held,
+        pool rows, cache size) at every sample; when ``bound`` is given,
+        the final census must be ``<= bound`` or the gate trips."""
+        with self._lock:
+            self._watches.append((name, fn, bound))
+        return self
+
+    def sample(self, label) -> float:
+        """Take one sample; returns the RSS read (MiB)."""
+        census = {}
+        with self._lock:
+            watches = list(self._watches)
+        for name, fn, _bound in watches:
+            try:
+                census[name] = int(fn())
+            except Exception:  # noqa: BLE001 — a census must not kill the run
+                census[name] = -1
+        rss = rss_mb()
+        with self._lock:
+            self._samples.append((label, rss, census))
+        return rss
+
+    def samples(self) -> list:
+        with self._lock:
+            return list(self._samples)
+
+    def gate(self, budget_mb: float, warmup: int = 2) -> dict:
+        """The flat-RSS verdict over the recorded samples (see module
+        docstring for semantics). Returns a JSON-ready dict with ``ok``
+        plus the evidence a tripped gate needs to be debugged."""
+        with self._lock:
+            samples = list(self._samples)
+            watches = list(self._watches)
+        verdict: dict = {
+            "budget_mb": float(budget_mb),
+            "warmup_samples": int(warmup),
+            "samples": len(samples),
+        }
+        if len(samples) <= warmup + 1:
+            # nothing measurable after warmup: vacuous passes are worse
+            # than loud ones — fail closed
+            verdict.update(ok=False, error="too few post-warmup samples")
+            return verdict
+        post = samples[warmup:]
+        rss_series = [s[1] for s in post]
+        baseline = rss_series[0]
+        final = rss_series[-1]
+        growth = final - baseline
+        max_growth = max(rss_series) - baseline
+        census_ok = True
+        census_verdicts = {}
+        final_census = post[-1][2]
+        for name, _fn, bound in watches:
+            value = final_census.get(name)
+            bounded = bound is None or (value is not None and 0 <= value <= bound)
+            census_verdicts[name] = {
+                "final": value,
+                "bound": bound,
+                "ok": bounded,
+            }
+            census_ok = census_ok and bounded
+        verdict.update(
+            ok=bool(growth <= budget_mb and census_ok),
+            baseline_mb=round(baseline, 1),
+            final_mb=round(final, 1),
+            growth_mb=round(growth, 1),
+            max_growth_mb=round(max_growth, 1),
+            census=census_verdicts,
+        )
+        return verdict

@@ -285,16 +285,21 @@ def sign_block(state, block, context) -> bytes:
 
 
 def make_attestation(state, slot: int, index: int, context, participation=1.0,
-                     beacon_block_root=None):
+                     beacon_block_root=None, source=None):
     """A valid attestation for (slot, committee index) on ``state`` (which
     must be at a slot where [slot]'s data is known, i.e. state.slot >= slot).
     ``beacon_block_root`` overrides the honest head vote — a PROPERLY
     SIGNED equivocation (same slot/committee/target, different data): the
-    attester-slashing scenario's double-vote half."""
+    attester-slashing scenario's double-vote half. ``source`` overrides
+    the honest source checkpoint (a ``Checkpoint`` container): a properly
+    signed SURROUND vote — pair one widened-source attestation in a later
+    epoch against an honest one in an earlier epoch and the spans nest."""
     ns = build(context.preset)
     committee = h.get_beacon_committee(state, slot, index, context)
     epoch = slot // context.SLOTS_PER_EPOCH
-    if epoch == h.get_current_epoch(state, context):
+    if source is not None:
+        source = source.copy()
+    elif epoch == h.get_current_epoch(state, context):
         source = state.current_justified_checkpoint.copy()
     else:
         source = state.previous_justified_checkpoint.copy()
@@ -603,9 +608,12 @@ def produce_block_electra(state, slot: int, context, attestations=(),
     )
 
 
-def make_attestation_electra(state, slot: int, context, participation=1.0):
+def make_attestation_electra(state, slot: int, context, participation=1.0,
+                             beacon_block_root=None, source=None):
     """One committee-spanning electra attestation covering ALL committees of
-    ``slot`` (EIP-7549)."""
+    ``slot`` (EIP-7549). ``beacon_block_root``/``source`` override the
+    honest vote exactly like ``make_attestation``'s equivocation and
+    surround-vote seams."""
     from ethereum_consensus_tpu.models.electra import build as electra_build
 
     ns = electra_build(context.preset)
@@ -615,7 +623,9 @@ def make_attestation_electra(state, slot: int, context, participation=1.0):
         h.get_beacon_committee(state, slot, index, context)
         for index in range(committee_count)
     ]
-    if epoch == h.get_current_epoch(state, context):
+    if source is not None:
+        source = source.copy()
+    elif epoch == h.get_current_epoch(state, context):
         source = state.current_justified_checkpoint.copy()
     else:
         source = state.previous_justified_checkpoint.copy()
@@ -623,7 +633,11 @@ def make_attestation_electra(state, slot: int, context, participation=1.0):
     data = ns.AttestationData(
         slot=slot,
         index=0,
-        beacon_block_root=_block_root_at_or_latest(state, slot),
+        beacon_block_root=(
+            _block_root_at_or_latest(state, slot)
+            if beacon_block_root is None
+            else bytes(beacon_block_root)
+        ),
         source=source,
         target=ns.Checkpoint(
             epoch=epoch, root=_block_root_at_or_latest(state, start_slot)

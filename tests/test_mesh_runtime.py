@@ -349,6 +349,144 @@ def test_storm_rollback_blame_identity_with_mesh_engaged(mesh_env):
 
 
 # ---------------------------------------------------------------------------
+# fault injection under the mesh route (ISSUE 13: the soak's device lane)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_fault_injection_pairing_recovers_host_identical(mesh_env):
+    """An injected device fault on the sharded pairing route must
+    degrade to the host engine with IDENTICAL verdicts (incl. a
+    tampered set's blame), journaled as ``mesh.decline.injected_fault``
+    — exactly the real-device-trouble contract."""
+    from ethereum_consensus_tpu.crypto import bls
+    from ethereum_consensus_tpu.pipeline import FaultInjector
+
+    mesh_env.setenv("ECT_MESH", "1")
+    sks = [bls.SecretKey(i + 11) for i in range(1, 6)]
+    msgs = [bytes([i]) * 32 for i in range(5)]
+    sets = [
+        bls.SignatureSet([sk.public_key()], m, sk.sign(m))
+        for sk, m in zip(sks, msgs)
+    ]
+    bad = list(sets)
+    bad[2] = bls.SignatureSet(bad[2].public_keys, b"y" * 32,
+                              bad[2].signature)
+    prior = _device_flags.PAIRING_MIN_SETS
+    _device_flags.PAIRING_MIN_SETS = 1
+    injector = FaultInjector().fail_mesh("pairing", 2).install_mesh()
+    base = tel_metrics.counter("mesh.decline.injected_fault").value()
+    try:
+        assert bls.verify_signature_sets(sets) == [True] * 5
+        assert bls.verify_signature_sets(bad) == [
+            True, True, False, True, True,
+        ]
+    finally:
+        injector.uninstall_mesh()
+        _device_flags.PAIRING_MIN_SETS = prior
+    assert (
+        tel_metrics.counter("mesh.decline.injected_fault").value()
+        == base + 2
+    )
+    kinds = [kind for _s, _a, kind in injector.injected]
+    assert kinds == ["mesh_pairing", "mesh_pairing"]
+    # the plan is exhausted: the next batch rides the mesh again
+    _device_flags.PAIRING_MIN_SETS = 1
+    try:
+        injector.install_mesh()
+        assert bls.verify_signature_sets(sets) == [True] * 5
+        assert len(injector.injected) == 2
+    finally:
+        injector.uninstall_mesh()
+        _device_flags.PAIRING_MIN_SETS = prior
+
+
+def test_mesh_fault_injection_epoch_recovers_host_identical(mesh_env):
+    """Injected device faults on the sharded epoch sweeps: the pass
+    falls back to the host kernels mid-epoch and the boundary state is
+    bit-identical to the mesh-off run."""
+    from chain_utils import Context, fast_registry_state
+    from ethereum_consensus_tpu.models.deneb import containers as dc
+    from ethereum_consensus_tpu.models.deneb.slot_processing import (
+        process_slots,
+    )
+    from ethereum_consensus_tpu.pipeline import FaultInjector
+    from ethereum_consensus_tpu.scenarios.harness import forced_columnar
+
+    ctx = Context.for_mainnet()
+    ns = dc.build(ctx.preset)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    state, _ = fast_registry_state(1003, "deneb")
+    process_slots(state, spe, ctx)
+    state.previous_epoch_participation = [0b111] * 1003
+
+    mesh_env.setenv("ECT_MESH", "1")
+    mesh_env.setenv("ECT_MESH_EPOCH_MIN_N", "1")
+    injector = FaultInjector().fail_mesh("epoch", 1).install_mesh()
+    base = tel_metrics.counter("mesh.decline.injected_fault").value()
+    try:
+        with forced_columnar():
+            faulted = state.copy()
+            process_slots(faulted, 2 * spe, ctx)
+    finally:
+        injector.uninstall_mesh()
+    assert (
+        tel_metrics.counter("mesh.decline.injected_fault").value() > base
+    )
+    assert [k for _s, _a, k in injector.injected] == ["mesh_epoch"]
+
+    mesh_env.setenv("ECT_MESH", "off")
+    from ethereum_consensus_tpu.parallel import runtime
+
+    runtime.reset()
+    host = state.copy()
+    with forced_columnar():
+        process_slots(host, 2 * spe, ctx)
+    assert ns.BeaconState.hash_tree_root(faulted) == (
+        ns.BeaconState.hash_tree_root(host)
+    )
+    assert ns.BeaconState.serialize(faulted) == ns.BeaconState.serialize(
+        host
+    )
+
+
+def test_decline_events_rearm_on_reason_change(mesh_env):
+    """The one-shot ``mesh.decline`` trace event re-arms when the
+    decline REASON for a route kind changes — a soak that flips
+    thresholds mid-run journals every distinct cause transition (ISSUE
+    13 satellite; previously A→B→A went silent on the return to A)."""
+    from ethereum_consensus_tpu.parallel import runtime
+    from ethereum_consensus_tpu.telemetry import spans
+
+    mesh_env.setenv("ECT_MESH", "1")
+    assert runtime.mesh() is not None
+
+    def decline_events():
+        doc = spans.RECORDER.chrome_trace()
+        return [
+            e["args"]["reason"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "i" and e.get("name") == "mesh.decline"
+            and e["args"].get("kind") == "epoch"
+        ]
+
+    spans.start_recording()
+    try:
+        mesh_env.setenv("ECT_MESH_EPOCH_MIN_N", str(1 << 20))
+        assert runtime.epoch_sweeps(1000) is None  # below_threshold
+        assert runtime.epoch_sweeps(2000) is None  # same reason: silent
+        assert decline_events() == ["below_threshold"]
+        assert runtime.epoch_sweeps(1000, family="phase0") is None
+        assert decline_events() == ["below_threshold", "phase0_family"]
+        # the REASON flips back: the event must re-arm, not stay silent
+        assert runtime.epoch_sweeps(3000) is None
+        assert decline_events() == [
+            "below_threshold", "phase0_family", "below_threshold",
+        ]
+    finally:
+        spans.stop_recording()
+
+
+# ---------------------------------------------------------------------------
 # the 2-device smoke (subprocess: a REAL multi-device platform)
 # ---------------------------------------------------------------------------
 

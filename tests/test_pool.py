@@ -580,6 +580,111 @@ def test_attester_slashing_storm_family():
     assert out["validators_slashed"], "nobody was slashed"
 
 
+# ---------------------------------------------------------------------------
+# surround-vote detection (ISSUE 13 satellite; docs/POOL.md residue)
+# ---------------------------------------------------------------------------
+
+
+def _vote_builder(ctx):
+    import importlib
+
+    from ethereum_consensus_tpu.ssz.core import hash_tree_root
+
+    ns = importlib.import_module(
+        "ethereum_consensus_tpu.models.altair"
+    ).build(ctx.preset)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+
+    def vote(source_epoch: int, target_epoch: int, tag: int):
+        data = ns.AttestationData(
+            slot=target_epoch * spe,
+            index=0,
+            beacon_block_root=bytes([tag]) * 32,
+            source=ns.Checkpoint(epoch=source_epoch, root=b"\x01" * 32),
+            target=ns.Checkpoint(epoch=target_epoch, root=b"\x02" * 32),
+        )
+        return data, bytes(hash_tree_root(data))
+
+    return ns, vote
+
+
+def test_surround_vote_surfaces_slashing(altair_head):
+    """Both surround directions surface an ``AttesterSlashing`` whose
+    halves are ordered for ``is_slashable_attestation_data`` —
+    attestation_1 is always the SURROUNDING vote."""
+    from ethereum_consensus_tpu.models.phase0.helpers import (
+        is_slashable_attestation_data,
+    )
+
+    _ex, ctx, _store, _blocks = altair_head
+    ns, vote = _vote_builder(ctx)
+
+    # prior surrounds new: (source 0, target 3) then (1, 2)
+    pool = OperationPool()
+    outer_data, outer_root = vote(0, 3, 0x11)
+    inner_data, inner_root = vote(1, 2, 0x22)
+    assert pool.note_votes([1, 2, 3], outer_data, outer_root,
+                           b"\x0a" * 96, ns) == []
+    surfaced = pool.note_votes([2, 3, 4], inner_data, inner_root,
+                               b"\x0b" * 96, ns)
+    assert len(surfaced) == 1
+    slashing = surfaced[0]
+    assert int(slashing.attestation_1.data.target.epoch) == 3
+    assert int(slashing.attestation_2.data.target.epoch) == 2
+    assert is_slashable_attestation_data(
+        slashing.attestation_1.data, slashing.attestation_2.data
+    )
+    assert len(pool.attester_slashings()) == 1
+    # re-noting the same votes surfaces nothing new (root dedup)
+    assert pool.note_votes([2, 3, 4], inner_data, inner_root,
+                           b"\x0b" * 96, ns) == []
+
+    # new surrounds prior: (1, 2) recorded first, then (0, 3) arrives
+    pool = OperationPool()
+    assert pool.note_votes([5, 6], inner_data, inner_root,
+                           b"\x0b" * 96, ns) == []
+    surfaced = pool.note_votes([6, 7], outer_data, outer_root,
+                               b"\x0a" * 96, ns)
+    assert len(surfaced) == 1
+    assert int(surfaced[0].attestation_1.data.target.epoch) == 3
+    assert is_slashable_attestation_data(
+        surfaced[0].attestation_1.data, surfaced[0].attestation_2.data
+    )
+
+
+def test_non_overlapping_spans_do_not_surface(altair_head):
+    """Chained (non-nested) spans and disjoint validators are NOT
+    slashable — the surround scan must stay quiet."""
+    _ex, ctx, _store, _blocks = altair_head
+    ns, vote = _vote_builder(ctx)
+    pool = OperationPool()
+    a_data, a_root = vote(0, 2, 0x31)
+    b_data, b_root = vote(2, 3, 0x32)
+    assert pool.note_votes([1, 2], a_data, a_root, b"\x0c" * 96, ns) == []
+    assert pool.note_votes([1, 2], b_data, b_root, b"\x0d" * 96, ns) == []
+    # a genuine surround for OTHER validators doesn't implicate these
+    outer_data, outer_root = vote(0, 3, 0x33)
+    assert pool.note_votes([8, 9], outer_data, outer_root,
+                           b"\x0e" * 96, ns) == []
+    assert pool.attester_slashings() == []
+    assert len(pool.vote_ledger_digest()) == 6
+
+
+def test_vote_ledger_digest_deterministic(altair_head):
+    """The digest is order-insensitive on its sort key — the soak's
+    refeed identity comparand."""
+    _ex, ctx, _store, _blocks = altair_head
+    ns, vote = _vote_builder(ctx)
+    a_data, a_root = vote(1, 2, 0x41)
+    b_data, b_root = vote(2, 3, 0x42)
+    p1, p2 = OperationPool(), OperationPool()
+    p1.note_votes([3, 1], a_data, a_root, b"\x0f" * 96, ns)
+    p1.note_votes([2], b_data, b_root, b"\x10" * 96, ns)
+    p2.note_votes([2], b_data, b_root, b"\x10" * 96, ns)
+    p2.note_votes([1, 3], a_data, a_root, b"\x0f" * 96, ns)
+    assert p1.vote_ledger_digest() == p2.vote_ledger_digest()
+
+
 def test_run_storm_pool_spam_lane():
     """The pool-spam mutator lane rides a real storm: full accounting,
     no silent drops, reasons inside the taxonomy."""

@@ -77,6 +77,11 @@ def _default_targets(root: str) -> dict:
             # before the dispatch would corrupt the host twin it must
             # stay bit-identical to (aliasflow's column-buffer class)
             os.path.join(root, _PKG, "parallel"),
+            # the soak runner holds committed states, oracle prefixes,
+            # and pool schedules across thousands of cycles — a stray
+            # write through any of them breaks the bit-identity gate it
+            # itself asserts
+            os.path.join(root, _PKG, "soak"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -106,6 +111,10 @@ def _default_targets(root: str) -> dict:
             # and merkle rebuilds consult it concurrently; its decline
             # one-shot set mirrors epoch_vector's fallback discipline
             os.path.join(root, _PKG, "parallel"),
+            # the soak drives reader/SSE/spam threads against the
+            # pipeline driver concurrently; its sentinel and subscriber
+            # state must stay lock-disciplined
+            os.path.join(root, _PKG, "soak"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
     }
