@@ -30,8 +30,8 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke'
 
 mesh-smoke:  ## 2-device virtual mesh: one sharded epoch pass + one sharded RLC flush window, bit-identical to host
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_runtime.py -q -m mesh_smoke

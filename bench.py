@@ -549,14 +549,19 @@ _EPOCH_SWEEP_SPANS = (
 
 
 def _epoch_phase_split(records) -> dict:
-    """Per-stage seconds from the columnar pass's own spans plus the
-    32 per-slot state HTRs — the epoch configs' ``phases`` block."""
+    """Per-stage seconds from the columnar pass's own spans (including
+    the committee-mask kernel's build span) plus the 32 per-slot state
+    HTRs — the epoch configs' ``phases`` block."""
     sums: dict = {}
     for r in records:
         name = r.name
-        if name.startswith("epoch_vector.") or name in (
-            "transition.state_htr",
-            "transition.process_epoch",
+        if (
+            name.startswith("epoch_vector.")
+            or name.startswith("committees.")
+            or name in (
+                "transition.state_htr",
+                "transition.process_epoch",
+            )
         ):
             key = name.split(".", 1)[1] + "_s"
             sums[key] = sums.get(key, 0.0) + r.duration_s
@@ -650,6 +655,19 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
         "column_builds": d.get("ops_vector.columns.builds", 0),
         "sweep_spans_in_pass": sweep_spans,
         "validator_writes": d.get("epoch_vector.validator_writes", 0),
+        # the committee-mask kernel's engagement (ISSUE 14): a consumed
+        # bundle is a build OR a memo hit; any committees.fallback.*
+        # means a spec-helper walk ran inside the pass
+        "masks": {
+            "builds": d.get("committees.masks.builds", 0),
+            "hits": d.get("committees.masks.hits", 0),
+            "shuffles": d.get("committees.shuffles", 0),
+            "fallbacks": {
+                key.split("committees.fallback.", 1)[1]: value
+                for key, value in d.items()
+                if key.startswith("committees.fallback.") and value
+            },
+        },
     }
     evidence["elem_materialization_absent"] = bool(
         evidence["columnar_epochs"] >= 1
@@ -690,6 +708,111 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
         "rss_mb": round(now_mb, 1),
         "columnar": evidence,
     }
+
+
+def _fused_jit_evidence(state_type, loaded, process_slots, slots,
+                        ctx) -> dict:
+    """Prove the FUSED device epoch kernel (ISSUE 14) on this backend:
+    route TWO warm epochs through the ops.install sweeps flag (the
+    columnar pass then dispatches inactivity + rewards as the ONE jitted
+    ``epoch_vector.fused_epoch_kernel``) and assert, from the device
+    observatory's own ledgers: exactly ONE compile of the fused kernel
+    across both epochs (zero RECOMPILE events — dynamic per-epoch
+    scalars, static chain constants), the packed columns uploaded at the
+    SINGLE ``epoch_vector.fused`` site (the per-stage
+    inactivity/rewards upload sites stay silent), and the fused state
+    bit-identical to the host pass."""
+    import gc
+    import hashlib
+
+    from ethereum_consensus_tpu import _device_flags
+    from ethereum_consensus_tpu.telemetry import device as tel_device
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+
+    host = loaded.copy()
+    process_slots(host, 2 * slots, ctx)
+    host_root = state_type.hash_tree_root(host)
+    host_bytes = hashlib.sha256(state_type.serialize(host)).hexdigest()
+    del host
+    gc.collect()
+
+    obs = tel_device.OBSERVATORY
+    started_here = not obs.active
+    if started_here:
+        tel_device.start()
+    compiles_before = [
+        c for c in obs.compiles()
+        if c["fn"] == "epoch_vector.fused_epoch_kernel"
+    ]
+    sites_before = dict(obs.transfer_summary().get("sites", {}))
+    metrics_base = tel_metrics.snapshot()
+    saved = _device_flags.SWEEPS_MIN_N
+    _device_flags.SWEEPS_MIN_N = 1
+    try:
+        times = []
+        fused_state = None
+        for _ in range(2):
+            s = loaded.copy()
+            gc.collect()
+            t0 = time.perf_counter()
+            process_slots(s, 2 * slots, ctx)
+            times.append(time.perf_counter() - t0)
+            fused_state = s
+    finally:
+        _device_flags.SWEEPS_MIN_N = saved
+    d = tel_metrics.delta(metrics_base)
+    fused_compiles = [
+        c for c in obs.compiles()
+        if c["fn"] == "epoch_vector.fused_epoch_kernel"
+    ][len(compiles_before):]
+    sites_after = obs.transfer_summary().get("sites", {})
+
+    def _site_delta(site: str, field: str) -> int:
+        now = sites_after.get(site, {}).get(field, 0)
+        return now - sites_before.get(site, {}).get(field, 0)
+
+    identical = bool(
+        state_type.hash_tree_root(fused_state) == host_root
+        and hashlib.sha256(
+            state_type.serialize(fused_state)
+        ).hexdigest() == host_bytes
+    )
+    if started_here:
+        tel_device.stop()
+    out = {
+        "engaged": d.get("epoch_vector.fused.jit", 0),
+        "compiles": len(fused_compiles),
+        "recompiles": sum(1 for c in fused_compiles if c["recompile"]),
+        "epoch_s_first": times[0],
+        "epoch_s_warm": times[1],
+        "fused_h2d_count": _site_delta("epoch_vector.fused", "h2d_count"),
+        "fused_h2d_bytes": _site_delta("epoch_vector.fused", "h2d_bytes"),
+        "staged_h2d_count": (
+            _site_delta("parallel.epoch.inactivity", "h2d_count")
+            + _site_delta("parallel.epoch.rewards", "h2d_count")
+        ),
+        "fused_fallbacks": {
+            key.split("epoch_vector.fused_fallback.", 1)[1]: value
+            for key, value in d.items()
+            if key.startswith("epoch_vector.fused_fallback.") and value
+        },
+        "bit_identical_vs_host": identical,
+    }
+    # compiles == 0 is the compile-once discipline working ACROSS
+    # configs: an earlier epoch config in the same battery already
+    # compiled the kernel and these two epochs were pure cache hits —
+    # record it, and gate on "at most one compile, never a recompile"
+    out["compile_reused_from_earlier_config"] = out["compiles"] == 0
+    out["ok"] = bool(
+        out["engaged"] >= 2
+        and out["compiles"] <= 1
+        and out["recompiles"] == 0
+        and out["fused_h2d_count"] > 0
+        and out["staged_h2d_count"] == 0
+        and not out["fused_fallbacks"]
+        and identical
+    )
+    return out
 
 
 def bench_epoch_mainnet(validators: "int | None" = None):
@@ -735,12 +858,30 @@ def bench_epoch_mainnet(validators: "int | None" = None):
     out = _epoch_cold_warm(
         ns.BeaconState, loaded, process_slots, slots, ctx, fork="phase0"
     )
+    # ISSUE 14 acceptance: at the 2^21+ flagship shape the committee-mask
+    # kernel must be ENGAGED (bundles consumed, zero committees.fallback.*
+    # inside the pass), the pass columnar with zero epoch_vector.fallback.*
+    # (elem_materialization_absent covers it), and warm epoch_s <= 0.5 s
+    flagship = validators >= (1 << 21)
+    masks = out["columnar"]["masks"]
+    masks_engaged = bool(
+        (masks["builds"] + masks["hits"]) >= 1 and not masks["fallbacks"]
+    )
+    ok = bool(
+        out["columnar"]["bit_identical_vs_oracle"]
+        and out["columnar"]["elem_materialization_absent"]
+        and masks_engaged
+    )
+    if flagship:
+        ok = ok and out["epoch_s"] <= 0.5
     out.update(
         validators=validators,
         slots=slots,
         pending_attestations=n_atts,
         ms_per_slot=1e3 * out["epoch_s"] / slots,
-        ok=bool(out["columnar"]["bit_identical_vs_oracle"]),
+        mask_engaged=masks_engaged,
+        target_epoch_s=0.5 if flagship else None,
+        ok=ok,
     )
     return out
 
@@ -828,10 +969,16 @@ def bench_epoch_deneb(validators: "int | None" = None):
     out = _epoch_cold_warm(
         ns.BeaconState, loaded, process_slots, slots, ctx, fork="deneb"
     )
+    # the fused device epoch kernel's proof (ISSUE 14): one compile, one
+    # upload site, bit-identical — on this backend (cpu or chip alike)
+    out["fused"] = _fused_jit_evidence(
+        ns.BeaconState, loaded, process_slots, slots, ctx
+    )
     flagship = validators >= (1 << 21)
     ok = bool(
         out["columnar"]["bit_identical_vs_oracle"]
         and out["columnar"]["elem_materialization_absent"]
+        and out["fused"]["ok"]
     )
     if flagship:
         ok = ok and out["epoch_s"] <= 1.0
@@ -892,6 +1039,9 @@ def bench_epoch_electra(validators: "int | None" = None):
     out = _epoch_cold_warm(
         ns.BeaconState, loaded, process_slots, slots, ctx, fork="electra"
     )
+    out["fused"] = _fused_jit_evidence(
+        ns.BeaconState, loaded, process_slots, slots, ctx
+    )
     out.update(
         validators=validators,
         slots=slots,
@@ -901,6 +1051,7 @@ def bench_epoch_electra(validators: "int | None" = None):
         ok=bool(
             out["columnar"]["bit_identical_vs_oracle"]
             and out["columnar"]["elem_materialization_absent"]
+            and out["fused"]["ok"]
         ),
     )
     return out
@@ -1048,6 +1199,15 @@ def _prime_warm_state(fork: str, state, ctx) -> None:
     for e in {epoch, max(0, epoch - 1)}:
         hmod.get_active_validator_indices(state, e)
     hmod.get_total_active_balance(state, ctx)
+    if fork == "phase0":
+        # prime the committee-mask bundles (ISSUE 14) on the ORIGINAL:
+        # the memo travels across copies (guarded by the pending lists'
+        # full-walk freshness), so the timed warm runs consume the
+        # boundary masks a resident client would already hold
+        from ethereum_consensus_tpu.models import committees
+
+        for e in {epoch, max(0, epoch - 1)}:
+            committees.pending_masks_for(state, e, ctx)
     from ethereum_consensus_tpu.models.phase0.helpers import (
         _registry_pubkey_objects,
     )

@@ -51,6 +51,17 @@ _TREND_HEADLINE = (
     "epoch_s",
     "cold_epoch_s",
     "oracle_epoch_s",
+    # the epoch-tail axes (ISSUE 14): the committee-mask kernel's
+    # engagement (builds/hits — mask-build seconds ride the phases rows
+    # as phases.mask_build_s) and the fused device epoch kernel's
+    # compile discipline (one compile, zero recompiles, single-site
+    # uploads)
+    "columnar.masks.builds",
+    "columnar.masks.hits",
+    "fused.compiles",
+    "fused.recompiles",
+    "fused.fused_h2d_count",
+    "fused.epoch_s_warm",
     "adversarial_s",
     "recovery_latency_mean_s",
     # the serving data plane's trend axes (PR 8): gather core seconds

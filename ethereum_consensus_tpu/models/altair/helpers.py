@@ -28,6 +28,7 @@ from ..phase0.helpers import (  # noqa: F401 — fork-diff re-exports
     compute_proposer_index,
     compute_shuffled_index,
     compute_shuffled_indices,
+    shuffled_active_array,
     compute_start_slot_at_epoch,
     decrease_balance,
     get_active_validator_indices,

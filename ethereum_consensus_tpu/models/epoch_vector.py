@@ -47,7 +47,16 @@ Python branching — they run under numpy on the host path and are
 XLA-jittable as-is (tests/test_epoch_vector.py jits them under
 ``jax.numpy`` with x64 enabled and asserts bit-identical outputs); the
 u64-overflow guards live in the CALLER, which routes pathological
-states to exact Python-int fallbacks before any kernel runs.
+states to exact Python-int fallbacks before any kernel runs. On the
+device routes the altair-family inactivity + rewards stages collapse
+into the ONE ``fused_epoch_kernel`` dispatch (``jitted_kernels()``'s
+``fused_epoch`` via the ops.install sweeps flag; ``MeshEpochSweeps
+.fused`` under ``ECT_MESH``) — packed columns upload once and stay on
+device across the stages, with the staged host kernels as the live
+fallback (declines in ``epoch_vector.fused_fallback.{reason}``).
+phase0's justification and rewards are fed by the committee-mask
+kernel (``models/committees.py``), with the spec-helper walks as
+fallback + oracle.
 
 Telemetry: ``epoch_vector.epochs`` counts engaged passes,
 ``epoch_vector.fallback.{reason}`` every decline (one-shot trace event
@@ -72,6 +81,7 @@ __all__ = [
     "inactivity_scores_kernel",
     "flag_deltas_kernel",
     "apply_delta_pairs_kernel",
+    "fused_epoch_kernel",
     "jitted_kernels",
     "EPOCH_VECTOR_MIN_VALIDATORS",
 ]
@@ -174,6 +184,19 @@ def jitted_kernels() -> dict:
                 jax.jit(functools.partial(apply_delta_pairs_kernel, jnp)),
                 "epoch_vector.apply_delta_pairs_kernel",
             ),
+            # the FUSED device epoch kernel (ISSUE 14): inactivity +
+            # flag deltas + inactivity penalties + application as ONE
+            # dispatch — dynamic per-epoch u64 scalars, static chain
+            # constants, so a steady-state replay compiles exactly once
+            "fused_epoch": _device_obs.observe_jit(
+                jax.jit(
+                    functools.partial(fused_epoch_kernel, jnp),
+                    # bias, recovery, weights, weight_denominator,
+                    # leaking, head/target flag indices
+                    static_argnums=(11, 12, 13, 14, 15, 16, 17),
+                ),
+                "epoch_vector.fused_epoch_kernel",
+            ),
         }
         _JITTED_KERNELS.update(built)
     return _JITTED_KERNELS
@@ -257,6 +280,109 @@ def apply_delta_pairs_kernel(xp, balances, pairs):
     return balances
 
 
+def fused_epoch_kernel(xp, balances, eff, prev_part, slashed, active_prev,
+                       eligible, scores, increment, brpi, active_increments,
+                       denominator, bias, recovery_rate, weights,
+                       weight_denominator, leaking, head_flag_index,
+                       target_flag_index, psum=None):
+    """The altair-family epoch delta passes FUSED into one kernel:
+    inactivity score update → three flag-delta pairs off in-kernel
+    masked effective-balance sums → inactivity penalties off the
+    POST-update scores → in-order saturating application with a wrap
+    census. Operation-for-operation the staged kernels above (which stay
+    the live host fallback), so the outputs are bit-identical u64.
+
+    ``increment``/``brpi``/``active_increments``/``denominator`` are
+    DYNAMIC u64 scalars (a steady-state replay compiles once);
+    ``bias``/``recovery_rate``/``weights``/``weight_denominator``/
+    ``leaking``/flag indices are static chain constants. ``psum`` wraps
+    the scalar reductions for the mesh-sharded twin
+    (parallel/epoch.py); None runs them whole-array.
+
+    Returns ``(new_scores, new_balances, wrapped_lanes)`` — a nonzero
+    wrap count means a u64 wrap the caller's lane guards should have
+    made unreachable; the caller re-runs the staged path so the literal
+    overflow mirror raises its structured error."""
+    zero = xp.uint64(0)
+    one = xp.uint64(1)
+    unslashed_all = ~slashed
+    target_bit = (
+        (prev_part >> xp.uint8(target_flag_index)) & xp.uint8(1)
+    ).astype(bool)
+    participating = active_prev & unslashed_all & target_bit
+
+    # process_inactivity_updates (spec order: before the reward deltas)
+    new_scores = xp.where(
+        eligible & participating, scores - xp.minimum(one, scores), scores
+    )
+    new_scores = xp.where(
+        eligible & ~participating, new_scores + xp.uint64(bias), new_scores
+    )
+    if not leaking:
+        rec = xp.uint64(recovery_rate)
+        new_scores = xp.where(
+            eligible, new_scores - xp.minimum(rec, new_scores), new_scores
+        )
+
+    base_reward = (eff // increment) * brpi
+    divisor = active_increments * xp.uint64(weight_denominator)
+    pairs = []
+    target_unslashed = None
+    for flag_index, weight in enumerate(weights):
+        flag_bit = (
+            (prev_part >> xp.uint8(flag_index)) & xp.uint8(1)
+        ).astype(bool)
+        unslashed = active_prev & unslashed_all & flag_bit
+        if flag_index == target_flag_index:
+            target_unslashed = unslashed
+        flag_sum = xp.sum(xp.where(unslashed, eff, zero))
+        if psum is not None:
+            flag_sum = psum(flag_sum)
+        # get_total_balance floors at one increment
+        unslashed_increments = xp.maximum(increment, flag_sum) // increment
+        w = xp.uint64(weight)
+        if leaking:
+            rewards = xp.zeros_like(base_reward)
+        else:
+            rewards = xp.where(
+                eligible & unslashed,
+                base_reward * w * unslashed_increments // divisor,
+                zero,
+            )
+        if flag_index == head_flag_index:
+            penalties = xp.zeros_like(base_reward)
+        else:
+            penalties = xp.where(
+                eligible & ~unslashed,
+                base_reward * w // xp.uint64(weight_denominator),
+                zero,
+            )
+        pairs.append((rewards, penalties))
+
+    # inactivity penalties off the POST-update scores (spec order)
+    missed = eligible & ~target_unslashed
+    pairs.append(
+        (
+            xp.zeros_like(base_reward),
+            xp.where(missed, eff * new_scores // denominator, zero),
+        )
+    )
+
+    # apply in spec sequence with zero saturation BETWEEN pairs, keeping
+    # the per-pair wrap census the staged path checks
+    wrapped = zero
+    new_balances = balances
+    for rewards, penalties in pairs:
+        raised = new_balances + rewards
+        wrapped = wrapped + xp.sum((raised < new_balances).astype(xp.uint64))
+        new_balances = xp.where(
+            raised >= penalties, raised - penalties, zero
+        )
+    if psum is not None:
+        wrapped = psum(wrapped)
+    return new_scores, new_balances, wrapped
+
+
 # ---------------------------------------------------------------------------
 # fork knobs
 # ---------------------------------------------------------------------------
@@ -319,6 +445,9 @@ class _EpochColumns:
         # the mesh runner for this pass (parallel/runtime.py) — None
         # when the mesh is off/declined, and the host kernels run
         "mesh",
+        # the jitted fused epoch kernel when ops.install routed the
+        # sweeps device-ward (None = host/mesh routes decide)
+        "fused",
     )
 
 
@@ -459,6 +588,7 @@ def _sync(state, context, fork):
     ec._active_cur_count = None
     ec.credential_switches = []
     ec.mesh = None
+    ec.fused = None
     return ec
 
 
@@ -562,6 +692,7 @@ def _justification_altair(ec) -> None:
 def _justification_phase0(ec) -> None:
     if ec.cur <= GENESIS_EPOCH + 1:
         return
+    from .committees import pending_masks_for
     from .phase0 import epoch_processing as pep
     from .phase0 import helpers as h
     from .phase0.epoch_processing import weigh_justification_and_finalization
@@ -569,6 +700,29 @@ def _justification_phase0(ec) -> None:
     state, context, np = ec.state, ec.context, ec.np
     _seed_active_indices(ec, ec.prev, ec.active_prev)
     _seed_active_indices(ec, ec.cur, ec.active_cur)
+
+    # the committee-mask kernel (models/committees.py): target masks for
+    # both epochs off ONE shuffled table + bitfield pass per epoch; its
+    # bundle is memoized on the state, so the rewards stage reuses it
+    prev_bundle = pending_masks_for(state, ec.prev, context)
+    cur_bundle = (
+        pending_masks_for(state, ec.cur, context)
+        if prev_bundle is not None
+        else None
+    )
+    if prev_bundle is not None and cur_bundle is not None:
+        unslashed = ~ec.slashed
+        previous_target = max(
+            ec.increment, int(ec.eff[prev_bundle.target & unslashed].sum())
+        )
+        current_target = max(
+            ec.increment, int(ec.eff[cur_bundle.target & unslashed].sum())
+        )
+        weigh_justification_and_finalization(
+            state, _total_active(ec), previous_target, current_target,
+            context,
+        )
+        return
 
     def attesting_balance(atts) -> int:
         mask = np.zeros(ec.n, dtype=bool)
@@ -832,6 +986,167 @@ def _rewards_literal_apply(ec, pairs) -> None:
     raise _PassComplete()
 
 
+def _fused_fallback(ec, reason: str, **inputs) -> None:
+    """A fused-route decline is NOT an engine fallback (the staged host
+    kernels run and the pass stays columnar) — separate counter +
+    journal kind so the bench can assert zero ``epoch_vector.fallback.*``
+    while still seeing every fused routing decision."""
+    metrics.counter(f"epoch_vector.fused_fallback.{reason}").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route("epoch_fused", "staged", reason, **inputs)
+
+
+def _fused_route(ec, leaking: bool) -> bool:
+    """Run inactivity + rewards as ONE fused dispatch — mesh-sharded
+    (parallel/epoch.py) when the mesh owns the pass, jitted
+    (``jitted_kernels()['fused_epoch']``) when ``ops.install`` routed the
+    sweeps device-ward. Returns True with ``ec.inact``/``ec.balances``
+    rebound; False = run the staged host kernels (live fallback,
+    bit-identical)."""
+    if ec.mesh is None and ec.fused is None:
+        return False
+    np = ec.np
+    context = ec.context
+    from .altair.constants import (
+        PARTICIPATION_FLAG_WEIGHTS,
+        TIMELY_HEAD_FLAG_INDEX,
+        WEIGHT_DENOMINATOR,
+    )
+    from .phase0.helpers import integer_squareroot
+
+    bias = int(context.inactivity_score_bias)
+    recovery = int(context.inactivity_score_recovery_rate)
+    # the staged host path clamps pathological eff*score products through
+    # exact Python ints — a kernel cannot; post-update scores are bounded
+    # by pre-update max + bias, so this guard covers the fused product
+    if ec.n and int(ec.eff.max(initial=0)) * (
+        int(ec.inact.max(initial=0)) + bias
+    ) >= 1 << 64:
+        _fused_fallback(ec, "u64_product", validators=ec.n)
+        return False
+    total_active = _total_active(ec)
+    increment = ec.increment
+    brpi = (
+        increment
+        * int(context.BASE_REWARD_FACTOR)
+        // integer_squareroot(total_active)
+    )
+    active_increments = total_active // increment
+    denominator = bias * int(getattr(context, ec.cfg["quot"]))
+    weights = tuple(int(w) for w in PARTICIPATION_FLAG_WEIGHTS)
+    if ec.mesh is not None:
+        try:
+            with trace.span(
+                "epoch_vector.fused", validators=ec.n, route="mesh"
+            ):
+                out = ec.mesh.fused(
+                    ec.balances, ec.eff, ec.prev_part, ec.slashed,
+                    ec.active_prev, ec.eligible, ec.inact,
+                    increment=increment,
+                    brpi=brpi,
+                    active_increments=active_increments,
+                    denominator=denominator,
+                    bias=bias,
+                    recovery_rate=recovery,
+                    weights=weights,
+                    weight_denominator=int(WEIGHT_DENOMINATOR),
+                    leaking=leaking,
+                    head_flag_index=int(TIMELY_HEAD_FLAG_INDEX),
+                    target_flag_index=_TIMELY_TARGET_FLAG_INDEX,
+                )
+        except Exception as exc:  # noqa: BLE001 — host fallback
+            # injected faults journal at the seam (runtime.fault_point)
+            if not getattr(exc, "mesh_fault", False):
+                from ..parallel import runtime as _mesh_runtime
+
+                _mesh_runtime.decline(
+                    "epoch", "device_unusable", stage="fused",
+                    error=repr(exc)[:160],
+                )
+            return False
+        if out is None:
+            # a wrap the guards should have made unreachable: the staged
+            # path re-runs and its literal mirror raises the structured
+            # error at the exact index
+            from ..parallel import runtime as _mesh_runtime
+
+            _mesh_runtime.decline(
+                "epoch", "wrap_guard", stage="fused", validators=ec.n
+            )
+            return False
+        ec.inact, ec.balances = out
+        metrics.counter("epoch_vector.fused.mesh").inc()
+        return True
+    try:
+        import jax.numpy as jnp
+
+        with trace.span(
+            "epoch_vector.fused", validators=ec.n, route="jit"
+        ):
+            # ONE upload of the packed columns for BOTH stages — the
+            # per-stage h2d transfers the staged device route paid are
+            # gone (the transfer ledger proves it: a single
+            # epoch_vector.fused site instead of inactivity + rewards)
+            arrays = _device_obs.h2d(
+                "epoch_vector.fused",
+                ec.balances, ec.eff, ec.prev_part, ec.slashed,
+                ec.active_prev, ec.eligible, ec.inact,
+            )
+            scores, balances, wrapped = ec.fused(
+                *arrays,
+                jnp.uint64(increment),
+                jnp.uint64(brpi),
+                jnp.uint64(active_increments),
+                jnp.uint64(denominator),
+                bias,
+                recovery,
+                weights,
+                int(WEIGHT_DENOMINATOR),
+                leaking,
+                int(TIMELY_HEAD_FLAG_INDEX),
+                _TIMELY_TARGET_FLAG_INDEX,
+            )
+            if int(wrapped):
+                _fused_fallback(ec, "wrap_guard", validators=ec.n)
+                return False
+            new_scores = _device_obs.d2h("epoch_vector.fused", scores)
+            new_balances = _device_obs.d2h("epoch_vector.fused", balances)
+    except Exception as exc:  # noqa: BLE001 — host fallback
+        _fused_fallback(
+            ec, "device_unusable", error=repr(exc)[:160], validators=ec.n
+        )
+        return False
+    ec.inact = new_scores
+    ec.balances = new_balances
+    metrics.counter("epoch_vector.fused.jit").inc()
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route(
+            "epoch_fused", "device", "engaged", validators=ec.n
+        )
+    return True
+
+
+def _inactivity_and_rewards(ec) -> None:
+    """The altair-family inactivity + rewards stages: ONE fused dispatch
+    on the device routes (mesh / jitted kernel), the staged host kernels
+    otherwise — and always when the fused route declines (every decline
+    counted + journaled, none silent)."""
+    if ec.cur == GENESIS_EPOCH:
+        return
+    from .phase0.epoch_processing import get_finality_delay
+
+    leaking = (
+        get_finality_delay(ec.state, ec.context)
+        > ec.context.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    )
+    if _fused_route(ec, leaking):
+        return
+    with trace.span("epoch_vector.inactivity"):
+        _inactivity_updates(ec)
+    with trace.span("epoch_vector.rewards"):
+        _rewards_altair(ec)
+
+
 def _rewards_phase0(ec) -> None:
     if ec.cur == GENESIS_EPOCH:
         return
@@ -840,8 +1155,24 @@ def _rewards_phase0(ec) -> None:
 
     np = ec.np
     _seed_active_indices(ec, ec.prev, ec.active_prev)
+    _seed_active_indices(ec, ec.cur, ec.active_cur)
+    # seed the total-active-balance memo from the columns BEFORE the
+    # deltas consult it: at the epoch-1 boundary justification is
+    # skipped (cur <= GENESIS+1) and nothing else has seeded it — an
+    # unseeded memo costs get_total_active_balance a full per-validator
+    # Python sweep inside the hot pass
+    _total_active(ec)
+    # hand the deltas the pass's own column views (working = base here:
+    # nothing earlier in the pass mutates these columns for phase0), so
+    # the activity masks aren't re-derived mid-pass
     rewards, penalties = pep._attestation_deltas_vectorized(
-        ec.state, ec.context
+        ec.state, ec.context,
+        packed={
+            "effective_balance": ec.eff,
+            "slashed": ec.slashed,
+            "active_previous": ec.active_prev,
+            "eligible": ec.eligible,
+        },
     )
     raised = ec.balances + rewards
     if bool((raised < ec.balances).any()):
@@ -1218,14 +1549,23 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
     if _disabled():
         fallback("disabled", validators=n)
         return False
+    fused_jit = False
     if _device_flags.sweeps_enabled(n):
-        # the installed device sweeps keep their routing
-        fallback(
-            "device_sweeps",
-            validators=n,
-            sweeps_min_n=_device_flags.SWEEPS_MIN_N,
-        )
-        return False
+        if _FORK_CFG[fork]["family"] == "altair":
+            # ops.install routed the sweeps device-ward: the pass stays
+            # COLUMNAR and runs inactivity + rewards as the ONE jitted
+            # fused kernel (ISSUE 14) — the per-stage device sweeps the
+            # literal path would have dispatched collapse into a single
+            # compile + a single column upload
+            fused_jit = True
+        else:
+            # phase0 keeps the literal path's device hysteresis routing
+            fallback(
+                "device_sweeps",
+                validators=n,
+                sweeps_min_n=_device_flags.SWEEPS_MIN_N,
+            )
+            return False
     if _np() is None:
         fallback("no_numpy", validators=n)
         return False
@@ -1240,6 +1580,11 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
     if ec is None:
         return False
     cfg = ec.cfg
+    if fused_jit:
+        try:
+            ec.fused = jitted_kernels()["fused_epoch"]
+        except Exception:  # noqa: BLE001 — jax unusable: host kernels
+            _fused_fallback(ec, "jit_unavailable", validators=n)
     if _mesh_requested():
         # the mesh runtime consult (parallel/runtime.py): engage routes
         # the inactivity + rewards sweeps through the sharded kernels;
@@ -1262,13 +1607,10 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
                 else:
                     _justification_altair(ec)
             if cfg["family"] == "altair":
-                with trace.span("epoch_vector.inactivity"):
-                    _inactivity_updates(ec)
-            with trace.span("epoch_vector.rewards"):
-                if cfg["family"] == "phase0":
+                _inactivity_and_rewards(ec)
+            else:
+                with trace.span("epoch_vector.rewards"):
                     _rewards_phase0(ec)
-                else:
-                    _rewards_altair(ec)
             with trace.span("epoch_vector.registry"):
                 _registry_updates(ec)
             with trace.span("epoch_vector.slashings"):
@@ -1306,6 +1648,10 @@ def process_epoch_columnar(state, context, fork: str) -> bool:
             process_historical_summaries_update(state, context)
         with trace.span("epoch_vector.rotation"):
             if cfg["family"] == "phase0":
+                from .committees import drop_masks_memo
+
+                # pending lists swap: this epoch's mask bundles are done
+                drop_masks_memo(state)
                 state.previous_epoch_attestations = (
                     state.current_epoch_attestations
                 )

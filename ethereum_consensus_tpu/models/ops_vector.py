@@ -474,7 +474,10 @@ def adopt_list_column(lst, arr, changed_indices, vmax) -> None:
         return
     changed = np.asarray(changed_indices, dtype=np.int64)
     if changed.size:
-        bulk_store(lst, arr.tolist(), changed)
+        # hand bulk_store the wire-width column itself: ONE tolist boxing
+        # inside it, uniformity certified from the dtype — the old
+        # tolist-here-then-type-scan-there double materialization is gone
+        bulk_store(lst, arr, changed)
         metrics.counter("ops_vector.bulk_store.calls").inc()
         metrics.counter("ops_vector.bulk_store.elements").inc(
             int(changed.size)

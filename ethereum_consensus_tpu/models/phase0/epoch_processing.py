@@ -94,10 +94,45 @@ def get_attesting_balance(state, attestations, context) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _masked_target_balances(state, context) -> "tuple[int, int] | None":
+    """(previous, current) target attesting balances off the committee-
+    mask kernel (models/committees.py) — one vectorized pass per epoch
+    instead of a ``get_attesting_indices`` set walk per attestation.
+    None = the kernel declined (counted + journaled); the caller runs
+    the spec-helper walk, which stays the oracle."""
+    from ..committees import pending_masks_for
+    from ..ops_vector import pack_registry_cached
+
+    previous_epoch = h.get_previous_epoch(state, context)
+    current_epoch = h.get_current_epoch(state, context)
+    prev_bundle = pending_masks_for(state, previous_epoch, context)
+    if prev_bundle is None:
+        return None
+    cur_bundle = pending_masks_for(state, current_epoch, context)
+    if cur_bundle is None:
+        return None
+    packed = pack_registry_cached(state, previous_epoch)
+    eff = packed["effective_balance"]
+    unslashed = ~packed["slashed"]
+    increment = int(context.EFFECTIVE_BALANCE_INCREMENT)
+    return (
+        max(increment, int(eff[prev_bundle.target & unslashed].sum())),
+        max(increment, int(eff[cur_bundle.target & unslashed].sum())),
+    )
+
+
 def process_justification_and_finalization(state, context) -> None:
     """(epoch_processing.rs:173)"""
     if h.get_current_epoch(state, context) <= GENESIS_EPOCH + 1:
         return
+    total_active = h.get_total_active_balance(state, context)
+    if len(state.validators) >= _VECTORIZED_REWARDS_MIN_N:
+        balances = _masked_target_balances(state, context)
+        if balances is not None:
+            weigh_justification_and_finalization(
+                state, total_active, balances[0], balances[1], context
+            )
+            return
     previous_epoch = h.get_previous_epoch(state, context)
     current_epoch = h.get_current_epoch(state, context)
     previous_attestations = get_matching_target_attestations(
@@ -106,7 +141,6 @@ def process_justification_and_finalization(state, context) -> None:
     current_attestations = get_matching_target_attestations(
         state, current_epoch, context
     )
-    total_active = h.get_total_active_balance(state, context)
     previous_target = get_attesting_balance(state, previous_attestations, context)
     current_target = get_attesting_balance(state, current_attestations, context)
     weigh_justification_and_finalization(
@@ -348,45 +382,70 @@ def _get_attestation_deltas_literal(state, context):
 _VECTORIZED_REWARDS_MIN_N = 1 << 12
 
 
-def _attestation_deltas_vectorized(state, context):
+def _attestation_deltas_vectorized(state, context, packed=None):
     """numpy twin of the five delta components over validator columns —
     identical integer semantics to the literal path (the literal stays
     the oracle + small-registry path and the spec-test rewards runner's
     per-component surface). Every quotient mirrors the spec's two-step
     floor division; products stay far below 2^64 (base_reward < 2^41,
-    attesting increments < 2^23)."""
+    attesting increments < 2^23). ``packed`` lets the columnar epoch
+    pass hand in its already-derived column views (epoch_vector
+    ``_rewards_phase0``) instead of re-deriving the activity masks."""
     import numpy as np
-
-    from ..ops_vector import pack_registry_cached
 
     n = len(state.validators)
     prev = h.get_previous_epoch(state, context)
-    # delta-refreshed registry-column cache (models/ops_vector.py); the
-    # literal fromiter packing is its internal fallback
-    packed = pack_registry_cached(state, prev)
+    if packed is None:
+        from ..ops_vector import pack_registry_cached
+
+        # delta-refreshed registry-column cache (models/ops_vector.py);
+        # the literal fromiter packing is its internal fallback
+        packed = pack_registry_cached(state, prev)
     eff = packed["effective_balance"]
     slashed = packed["slashed"]
     active_prev = packed["active_previous"]
     eligible = packed["eligible"]
 
-    source_atts = get_matching_source_attestations(state, prev, context)
-    target_root = h.get_block_root(state, prev, context)
-    target_atts = [a for a in source_atts if a.data.target.root == target_root]
-    head_atts = [
-        a
-        for a in target_atts
-        if a.data.beacon_block_root
-        == h.get_block_root_at_slot(state, a.data.slot)
-    ]
+    # the committee-mask kernel (models/committees.py): source/target/
+    # head masks + the min-inclusion-delay columns in one vectorized
+    # pass; the per-attestation spec walk below stays the live fallback
+    from ..committees import pending_masks_for
 
-    def attesting_mask(atts):
-        m = np.zeros(n, dtype=bool)
-        for a in atts:
-            idx = h.get_attesting_indices(
-                state, a.data, a.aggregation_bits, context
-            )
-            m[np.fromiter(idx, dtype=np.int64, count=len(idx))] = True
-        return m & ~slashed
+    bundle = pending_masks_for(state, prev, context)
+    if bundle is not None:
+        source_mask = bundle.source & ~slashed
+        target_masked = bundle.target & ~slashed
+        head_masked = bundle.head & ~slashed
+        masks_iter = (source_mask, target_masked, head_masked)
+        have = bundle.covered
+        best_delay = bundle.inclusion_delay
+        best_proposer = bundle.inclusion_proposer
+    else:
+        source_atts = get_matching_source_attestations(state, prev, context)
+        target_root = h.get_block_root(state, prev, context)
+        target_atts = [
+            a for a in source_atts if a.data.target.root == target_root
+        ]
+        head_atts = [
+            a
+            for a in target_atts
+            if a.data.beacon_block_root
+            == h.get_block_root_at_slot(state, a.data.slot)
+        ]
+
+        def attesting_mask(atts):
+            m = np.zeros(n, dtype=bool)
+            for a in atts:
+                idx = h.get_attesting_indices(
+                    state, a.data, a.aggregation_bits, context
+                )
+                m[np.fromiter(idx, dtype=np.int64, count=len(idx))] = True
+            return m & ~slashed
+
+        masks_iter = tuple(
+            attesting_mask(atts)
+            for atts in (source_atts, target_atts, head_atts)
+        )
 
     total_balance = h.get_total_active_balance(state, context)
     sqrt_total = h.integer_squareroot(total_balance)
@@ -399,44 +458,49 @@ def _attestation_deltas_vectorized(state, context):
 
     rewards = np.zeros(n, dtype=np.uint64)
     penalties = np.zeros(n, dtype=np.uint64)
+    zero = np.uint64(0)
     tgt_mask = None
-    for atts in (source_atts, target_atts, head_atts):
-        mask = attesting_mask(atts)
-        if atts is target_atts:
+    for which, mask in enumerate(masks_iter):
+        if which == 1:
             tgt_mask = mask
         # get_total_balance floors at one increment
         attesting_balance = max(increment, int(eff[mask].sum()))
         att_incr = np.uint64(attesting_balance // increment)
         attesting = eligible & mask
+        # whole-array where-adds: ~3× cheaper than boolean-gather adds
+        # at registry scale, same u64 values (products are guarded far
+        # below 2^64 — base_reward < 2^41, att_incr < 2^23)
         if leaking:
-            rewards[attesting] += base_reward[attesting]
+            rewards += np.where(attesting, base_reward, zero)
         else:
-            rewards[attesting] += (
-                base_reward[attesting] * att_incr // total_incr
+            rewards += np.where(
+                attesting, base_reward * att_incr // total_incr, zero
             )
-        absent = eligible & ~mask
-        penalties[absent] += base_reward[absent]
+        penalties += np.where(eligible & ~mask, base_reward, zero)
 
-    # inclusion delay: first assignment in stable inclusion_delay order
-    # IS the spec's min(candidates); proposer scatter-adds
-    have = np.zeros(n, dtype=bool)
-    best_delay = np.ones(n, dtype=np.uint64)
-    best_proposer = np.zeros(n, dtype=np.int64)
-    for a in sorted(source_atts, key=lambda a: a.inclusion_delay):
-        idx_set = h.get_attesting_indices(
-            state, a.data, a.aggregation_bits, context
-        )
-        idx = np.fromiter(idx_set, dtype=np.int64, count=len(idx_set))
-        newly = idx[~have[idx]]
-        have[newly] = True
-        best_delay[newly] = int(a.inclusion_delay)
-        best_proposer[newly] = int(a.proposer_index)
+    if bundle is None:
+        # inclusion delay: first assignment in stable inclusion_delay
+        # order IS the spec's min(candidates); proposer scatter-adds
+        have = np.zeros(n, dtype=bool)
+        best_delay = np.ones(n, dtype=np.uint64)
+        best_proposer = np.zeros(n, dtype=np.int64)
+        for a in sorted(source_atts, key=lambda a: a.inclusion_delay):
+            idx_set = h.get_attesting_indices(
+                state, a.data, a.aggregation_bits, context
+            )
+            idx = np.fromiter(idx_set, dtype=np.int64, count=len(idx_set))
+            newly = idx[~have[idx]]
+            have[newly] = True
+            best_delay[newly] = int(a.inclusion_delay)
+            best_proposer[newly] = int(a.proposer_index)
     prq = np.uint64(context.PROPOSER_REWARD_QUOTIENT)
     covered = have & ~slashed
     proposer_reward = base_reward // prq
-    rewards[covered] += (
-        base_reward[covered] - proposer_reward[covered]
-    ) // best_delay[covered]
+    # best_delay is 1 on uncovered lanes (never selected), so the whole-
+    # array quotient is division-safe and the where gate discards it
+    rewards += np.where(
+        covered, (base_reward - proposer_reward) // best_delay, zero
+    )
     np.add.at(rewards, best_proposer[covered], proposer_reward[covered])
 
     if leaking:
@@ -488,9 +552,11 @@ def process_rewards_and_penalties(state, context) -> None:
 
         # dirty-range bulk write (one C-speed splice instead of 2n
         # __setitem__ calls): only the 4096-element groups whose balances
-        # actually changed re-merkleize on the next state root
+        # actually changed re-merkleize on the next state root; the
+        # column goes in wire-width (bulk_store boxes it ONCE and
+        # certifies uniformity from the dtype)
         bulk_store(
-            state.balances, final.tolist(), np.nonzero(final != balances)[0]
+            state.balances, final, np.nonzero(final != balances)[0]
         )
         return
     rewards, penalties = _get_attestation_deltas_literal(state, context)
@@ -750,6 +816,10 @@ def process_historical_roots_update(state, context) -> None:
 
 
 def process_participation_record_updates(state, context) -> None:
+    from ..committees import drop_masks_memo
+
+    # the pending lists swap: any mask bundle built this epoch is done
+    drop_masks_memo(state)
     state.previous_epoch_attestations = state.current_epoch_attestations
     state.current_epoch_attestations = []
 

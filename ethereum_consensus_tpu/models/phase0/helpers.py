@@ -20,6 +20,7 @@ import hashlib
 from ... import _device_flags
 from ...crypto import bls
 from ...domains import DomainType
+from ...telemetry import metrics
 from ...utils import trace
 from ...error import (
     InvalidIndexedAttestation,
@@ -41,6 +42,7 @@ __all__ = [
     "compute_activation_exit_epoch",
     "compute_shuffled_index",
     "compute_shuffled_indices",
+    "shuffled_active_array",
     "compute_committee",
     "compute_proposer_index",
     "compute_fork_data_root",
@@ -199,7 +201,12 @@ def compute_shuffled_indices(indices: list[int], seed: bytes, context) -> list[i
 # (device kernel or the vectorized host map below) serves them all.
 # Keyed by (seed, round count, len); two active sets CAN alias a key, so
 # each entry stores its index list and hits are equality-guarded — an
-# alias costs a recompute, never a wrong committee.
+# alias costs a recompute, never a wrong committee. Entries are
+# three-slot lists ``[stored_indices, shuffled_list, shuffled_array]``:
+# the list serves the committee slicers, the int64 array serves the
+# committee-mask kernel (models/committees.py) — ONE permutation compute
+# feeds both sides (``committees.shuffles`` counts every actual compute,
+# so the one-shuffle-per-epoch contract is testable).
 _SHUFFLE_CACHE: dict = {}
 _SHUFFLE_CACHE_MAX = 4
 
@@ -208,20 +215,16 @@ _SHUFFLE_CACHE_MAX = 4
 HOST_SHUFFLE_MIN_N = 256
 
 
-def compute_shuffled_indices_vectorized(
-    indices: list[int], seed: bytes, context
-) -> list[int]:
+def _shuffled_array_vectorized(indices, seed: bytes, context):
     """The per-index swap-or-not map for ALL indices at once as numpy
     column ops: result[i] == indices[compute_shuffled_index(i, n, seed)]
     bit-for-bit, with ~rounds·(1 + n/256) digests instead of rounds·n —
     the host twin of the device kernel (ops/shuffle.py), playing the
     role of the reference's `shuffling` optimized feature
-    (helpers.rs:287)."""
+    (helpers.rs:287). Returns an int64 array."""
     import numpy as _np
 
     n = len(indices)
-    if n == 0:
-        return []
     idx = _np.arange(n, dtype=_np.int64)
     n_chunks = ((n - 1) >> 8) + 1
     for round_ in range(context.SHUFFLE_ROUND_COUNT):
@@ -236,47 +239,103 @@ def compute_shuffled_indices_vectorized(
         source = _np.frombuffer(blob, dtype=_np.uint8)
         bit = (source[pos >> 3] >> (pos & 7).astype(_np.uint8)) & 1
         idx = _np.where(bit.astype(bool), flip, idx)
-    arr = _np.asarray(indices, dtype=_np.int64)
-    return arr[idx].tolist()
+    arr = _np.fromiter(indices, dtype=_np.int64, count=n)
+    return arr[idx]
 
 
-def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
-    # key on (seed, rounds, len) with a stored-list equality guard: a
-    # C-speed list compare replaces the old per-lookup SHA-256 digest of
-    # the whole index list, which cost more than the cached shuffle it
-    # guarded (tens of thousands of committee lookups per epoch)
+def compute_shuffled_indices_vectorized(
+    indices: list[int], seed: bytes, context
+) -> list[int]:
+    """List-returning wrapper of ``_shuffled_array_vectorized`` (the
+    public drop-in for ``compute_shuffled_indices``)."""
+    if len(indices) == 0:
+        return []
+    return _shuffled_array_vectorized(indices, seed, context).tolist()
+
+
+def _compute_shuffled_pair(indices, seed: bytes, context):
+    """ONE whole-list shuffle compute → (list, int64 array). Every
+    actual permutation compute in the process flows through here, so
+    ``committees.shuffles`` counts exactly the work the per-epoch memo
+    contract bounds (one per (seed, active set))."""
+    import numpy as _np
+
+    metrics.counter("committees.shuffles").inc()
+    if _device_flags.shuffle_enabled(len(indices)):
+        from ...ops.shuffle import shuffled_indices_device
+        from ...telemetry import device as _obs
+
+        mapping = _obs.d2h(
+            "ops.shuffle",
+            shuffled_indices_device(
+                len(indices), seed, context.SHUFFLE_ROUND_COUNT
+            ),
+        )
+        arr = _np.fromiter(indices, dtype=_np.int64, count=len(indices))[
+            mapping
+        ]
+    else:
+        arr = _shuffled_array_vectorized(indices, seed, context)
+    arr.flags.writeable = False
+    return arr.tolist(), arr
+
+
+def _shuffle_cache_entry(indices, seed: bytes, context) -> list:
+    """The cached ``[stored_indices, shuffled_list, shuffled_array]``
+    entry for this (seed, active set), computing at most once per key.
+
+    Key on (seed, rounds, len) with a stored-list equality guard: a
+    C-speed list compare replaces the old per-lookup SHA-256 digest of
+    the whole index list, which cost more than the cached shuffle it
+    guarded (tens of thousands of committee lookups per epoch)."""
     key = (seed, context.SHUFFLE_ROUND_COUNT, len(indices))
     hit = _SHUFFLE_CACHE.get(key)
     if hit is not None:
         if hit[0] is indices:
             # fires on every lookup within one state now that
             # get_active_validator_indices returns a stable tuple
-            return hit[1]
+            return hit
         if tuple(hit[0]) == tuple(indices):
             # same active set from a DIFFERENT state object (fresh
             # deserialize of the same chain position): rebind the entry
             # so the O(n) equality check is paid once, not per lookup.
             # Never store a caller's mutable list — an in-place edit
             # would make the identity fast path serve a stale shuffle.
-            _SHUFFLE_CACHE[key] = (
-                indices if isinstance(indices, tuple) else tuple(indices),
-                hit[1],
-            )
-            return hit[1]
-    if _device_flags.shuffle_enabled(len(indices)):
-        from ...ops.shuffle import compute_shuffled_indices_device
-
-        shuffled = compute_shuffled_indices_device(indices, seed, context)
-    else:
-        shuffled = compute_shuffled_indices_vectorized(indices, seed, context)
+            hit[0] = indices if isinstance(indices, tuple) else tuple(indices)
+            return hit
+    shuffled, arr = _compute_shuffled_pair(indices, seed, context)
     # overwrite in place on key aliasing; evict only for genuinely new keys
     if key not in _SHUFFLE_CACHE and len(_SHUFFLE_CACHE) >= _SHUFFLE_CACHE_MAX:
         _SHUFFLE_CACHE.pop(next(iter(_SHUFFLE_CACHE)))
-    _SHUFFLE_CACHE[key] = (
+    entry = [
         indices if isinstance(indices, tuple) else list(indices),
         shuffled,
-    )
-    return shuffled
+        arr,
+    ]
+    _SHUFFLE_CACHE[key] = entry
+    return entry
+
+
+def _shuffled_active_set(indices: list[int], seed: bytes, context) -> list[int]:
+    return _shuffle_cache_entry(indices, seed, context)[1]
+
+
+def shuffled_active_array(indices, seed: bytes, context):
+    """The whole shuffled active set as a READ-ONLY int64 numpy array —
+    the committee-mask kernel's index table (models/committees.py).
+    Shares the per-seed cache with the list-serving committee path, so
+    one epoch costs ONE shuffle no matter which side asks first."""
+    entry = _shuffle_cache_entry(indices, seed, context)
+    arr = entry[2]
+    if arr is None:
+        # entry predates the array slot (or was built by a legacy path):
+        # derive once from the list and memoize alongside it
+        import numpy as _np
+
+        arr = _np.fromiter(entry[1], dtype=_np.int64, count=len(entry[1]))
+        arr.flags.writeable = False
+        entry[2] = arr
+    return arr
 
 
 def compute_committee(

@@ -84,6 +84,50 @@ def _inactivity_sharded(mesh, bias: int, recovery: int, leaking: bool):
 
 
 @functools.lru_cache(maxsize=16)
+def _fused_sharded(
+    mesh,
+    bias: int,
+    recovery_rate: int,
+    weights: tuple,
+    weight_denominator: int,
+    leaking: bool,
+    head_flag_index: int,
+    target_flag_index: int,
+):
+    """The FUSED epoch kernel (ISSUE 14), mesh-sharded: the SAME
+    ``epoch_vector.fused_epoch_kernel`` body the jit route runs, with
+    its scalar reductions wrapped in ``psum`` — inactivity update, flag
+    deltas, inactivity penalties, and in-order application in ONE
+    dispatch, so the packed columns ship to the devices once and stay
+    there across every stage."""
+    from ..models.epoch_vector import fused_epoch_kernel
+
+    def body(balances, eff, prev_part, slashed, active_prev, eligible,
+             scores, increment, brpi, active_increments, denominator):
+        return fused_epoch_kernel(
+            jnp, balances, eff, prev_part, slashed, active_prev, eligible,
+            scores, increment, brpi, active_increments, denominator,
+            bias, recovery_rate, weights, weight_denominator, leaking,
+            head_flag_index, target_flag_index,
+            psum=lambda v: jax.lax.psum(v, SHARD_AXIS),
+        )
+
+    spec = P(SHARD_AXIS)
+    return _obs.observe_jit(
+        jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(spec,) * 7 + (P(),) * 4,
+                out_specs=(spec, spec, P()),
+                check_vma=False,
+            )
+        ),
+        "parallel.epoch.fused_sweep",
+    )
+
+
+@functools.lru_cache(maxsize=16)
 def _rewards_sharded(
     mesh,
     weights: tuple,
@@ -228,6 +272,56 @@ class MeshEpochSweeps:
         )
         out = kernel(*args)
         return _obs.d2h("parallel.epoch.inactivity", out)[:n]
+
+    def fused(self, balances, eff, prev_part, slashed, active_prev,
+              eligible, scores, increment: int, brpi: int,
+              active_increments: int, denominator: int, bias: int,
+              recovery_rate: int, weights: tuple, weight_denominator: int,
+              leaking: bool, head_flag_index: int,
+              target_flag_index: int) -> "tuple | None":
+        """Inactivity + the full rewards stage as ONE sharded dispatch;
+        returns ``(new_scores, new_balances)`` as numpy columns — or
+        ``None`` when a u64 wrap surfaced (caller falls back to the
+        staged host path and its literal overflow mirror)."""
+        from . import runtime as _runtime
+
+        n = balances.shape[0]
+        _runtime.fault_point(
+            "epoch", stage="fused", validators=n, devices=self.n_dev
+        )
+        kernel = _fused_sharded(
+            self.mesh,
+            int(bias),
+            int(recovery_rate),
+            tuple(int(w) for w in weights),
+            int(weight_denominator),
+            bool(leaking),
+            int(head_flag_index),
+            int(target_flag_index),
+        )
+        sharded = _obs.h2d(
+            "parallel.epoch.fused",
+            self._pad(balances),
+            self._pad(eff),
+            self._pad(prev_part),
+            self._pad(slashed, False),
+            self._pad(active_prev, False),
+            self._pad(eligible, False),
+            self._pad(scores),
+        )
+        scalars = (
+            jnp.uint64(increment),
+            jnp.uint64(brpi),
+            jnp.uint64(active_increments),
+            jnp.uint64(denominator),
+        )
+        new_scores, new_balances, wrapped = kernel(*sharded, *scalars)
+        if int(wrapped):
+            return None
+        return (
+            _obs.d2h("parallel.epoch.fused", new_scores)[:n],
+            _obs.d2h("parallel.epoch.fused", new_balances)[:n],
+        )
 
     def rewards(self, balances, eff, prev_part, slashed, active_prev,
                 eligible, scores, increment: int, brpi: int,

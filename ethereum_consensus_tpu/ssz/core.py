@@ -617,6 +617,8 @@ def _instrument(name):
                 v = args[value_pos]
                 if kind[0] == "bytes":
                     keep = type(v) is bytes and len(v) == kind[1]
+                elif kind[0] == "bool":  # bitfield lists
+                    keep = type(v) is bool
                 else:  # ("int",)
                     keep = type(v) is int
                 if name == "__setitem__" and type(args[0]) is not int:
@@ -1294,8 +1296,19 @@ def bulk_store(values, new_values, changed_indices=None) -> None:
     the fork models' vectorized epoch sweeps use instead of
     ``values[:] = new`` — a whole-registry balance write that really
     changed a few thousand entries re-merkleizes a few groups, not the
-    whole collection (docs/INCREMENTAL_HTR.md)."""
+    whole collection (docs/INCREMENTAL_HTR.md).
+
+    ``new_values`` may be a 1-D unsigned numpy array (the columnar epoch
+    commit's wire-width buffer): the content splices in via ONE
+    ``tolist`` boxing and the uniformity verdict is certified from the
+    dtype — no second per-element materialization, no type scan."""
     n = len(values)
+    uint_column = (
+        getattr(getattr(new_values, "dtype", None), "kind", "") == "u"
+        and getattr(new_values, "ndim", 0) == 1
+    )
+    if uint_column:
+        new_values = new_values.tolist()
     if (
         values.__class__ is not CachedRootList
         or len(new_values) != n
@@ -1307,17 +1320,23 @@ def bulk_store(values, new_values, changed_indices=None) -> None:
     values._root_cache.clear()
     values._elems_fresh = False
     values._mut_gen += 1
-    # re-certify uniformity NOW (one C-speed pass): the dirty-group splice
-    # only engages on a certified collection, and deferring the scan to
-    # the next walk would demote every bulk_store to a full re-pack —
-    # exactly the cost this entry point exists to avoid
-    kinds = set(map(type, new_values))
-    if kinds == {int}:
+    # re-certify uniformity NOW (one C-speed pass — or for free from an
+    # adopted column's dtype): the dirty-group splice only engages on a
+    # certified collection, and deferring the scan to the next walk
+    # would demote every bulk_store to a full re-pack — exactly the cost
+    # this entry point exists to avoid
+    if uint_column:
         values._uniform_kind = ("int",)
-    elif kinds == {bytes} and len(set(map(len, new_values))) == 1:
-        values._uniform_kind = ("bytes", len(new_values[0]))
     else:
-        values._uniform_kind = None
+        kinds = set(map(type, new_values))
+        if kinds == {int}:
+            values._uniform_kind = ("int",)
+        elif kinds == {bool}:
+            values._uniform_kind = ("bool",)
+        elif kinds == {bytes} and len(set(map(len, new_values))) == 1:
+            values._uniform_kind = ("bytes", len(new_values[0]))
+        else:
+            values._uniform_kind = None
     cps = values._container_parents
     if cps is not None:
         for ref in cps:
@@ -1509,6 +1528,20 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
                 [v.__dict__.get("_htr_cache") or htr(v) for v in values]
             )
         else:
+            if (
+                isinstance(values, CachedRootList)
+                and values._elems_fresh
+            ):
+                # NESTED-container freshness (pending attestations): the
+                # last full walk registered this list as every element's
+                # weak parent and every element held its instance root —
+                # any later element/field/nested mutation cleared the
+                # flag through the notify chain, so a set flag proves
+                # the joined leaf roots are unchanged and the memo root
+                # stands without re-probing ~2k element roots per slot
+                memo = values._root_cache.get(("tree", elem, limit_elems))
+                if memo is not None:
+                    return memo[1]
             chunks = b"".join(elem.hash_tree_root(v) for v in values)
     if freshable:
         root = _finish_container_walk(values, tkey, chunks, limit_elems, tm)
@@ -1573,8 +1606,48 @@ def _merkleize_homogeneous(elem: SSZType, values: list, limit_elems: int) -> byt
         else:
             root = merkleize_chunks(chunks, limit=limit_elems)
             values._root_cache[("tree", elem, limit_elems)] = (chunks, root)
+        if values and isinstance(list.__getitem__(values, 0), Container):
+            _register_nested_freshness(values)
         return root
     return merkleize_chunks(chunks, limit=limit_elems)
+
+
+def _register_nested_freshness(values) -> None:
+    """Post-full-walk bookkeeping for a NESTED-container list (the
+    pending-attestation shape): wire every element to this list as a
+    weak parent, then mark element freshness iff every element finished
+    the walk holding its instance root. ``_try_cache_nested_root`` wired
+    each element's OWN children during that walk, so any nested mutation
+    propagates up (``_ssz_root_dirty`` → parent-list
+    ``_elems_fresh = False``) and direct field writes notify through
+    ``Container.__setattr__`` — a set flag therefore proves the joined
+    leaf roots are unchanged and the ``("tree", ...)`` memo root can be
+    served without the per-element probe walk. An element that failed to
+    cache (a mutable buffer in some field) leaves the flag False and
+    every walk honest."""
+    if not values._parents_registered:
+        import weakref
+
+        ref = values._self_ref
+        if ref is None:
+            ref = weakref.ref(values)
+            values._self_ref = ref
+        n_v = len(values)
+        for i, v in enumerate(values):
+            d = v.__dict__
+            d["_ssz_idx"] = i
+            parents = d.get("_ssz_parents")
+            if parents is None:
+                d["_ssz_parents"] = [ref]
+            elif not any(p is ref for p in parents):
+                # identity, never == (weakref equality compares live
+                # referents by value — a value-equal sibling list would
+                # be mistaken for self)
+                if len(parents) > 16:  # prune dead lineages
+                    parents[:] = [p for p in parents if p() is not None]
+                parents.append(ref)
+        values._parents_registered = True
+    values._elems_fresh = all("_htr_cache" in v.__dict__ for v in values)
 
 
 class Vector(_Parametrized, SSZType):
@@ -1693,6 +1766,25 @@ class List(_Parametrized, SSZType):
 def _bits_to_bytes(bits: list, include_delimiter: bool) -> bytes:
     n = len(bits)
     total = n + 1 if include_delimiter else n
+    if n >= 256:
+        # vectorized packing for committee-scale bitfields: the per-bit
+        # Python loop below was the hot line of hashing a mainnet epoch's
+        # pending attestations (~2k aggregates × ~1k bits). bool()
+        # coercion through asarray matches the loop's truthiness test
+        # bit-for-bit; exotic elements fall back to the loop.
+        try:
+            import numpy as _np
+
+            arr = _np.asarray(bits, dtype=bool)
+            if arr.shape == (n,):
+                packed = _np.packbits(arr, bitorder="little").tobytes()
+                out = bytearray((total + 7) // 8)
+                out[: len(packed)] = packed
+                if include_delimiter:
+                    out[n // 8] |= 1 << (n % 8)
+                return bytes(out)
+        except Exception:  # noqa: BLE001 — exotic elements: bit loop
+            pass
     out = bytearray((total + 7) // 8) if total else bytearray(b"")
     for i, bit in enumerate(bits):
         if bit:
@@ -1776,9 +1868,35 @@ class Bitlist(_Parametrized, SSZType):
     def hash_tree_root(self, value: list) -> bytes:
         if len(value) > self.limit:
             raise ValueError(f"Bitlist[{self.limit}]: got {len(value)} bits")
-        packed = pack_bytes(_bits_to_bytes(value, include_delimiter=False))
-        root = merkleize_chunks(packed, limit=self.chunk_count())
-        return mix_in_length(root, len(value))
+        # bitfield roots cache exactly like immutable-scalar list roots:
+        # bools are immutable, every sanctioned mutation runs through the
+        # instrumented mutators (which clear _root_cache), and the cached
+        # dict TRAVELS across state copies — so a copied state's pending
+        # attestations stop re-serializing ~2k aggregation bitfields per
+        # walk. The ("bool",) uniformity verdict (established here by one
+        # C-speed scan, maintained by the mutators) additionally lets the
+        # nested-root purity scan skip its per-bit element check.
+        cached = isinstance(value, CachedRootList)
+        if cached:
+            hit = value._root_cache.get(self)
+            if hit is not None:
+                return hit
+        raw = _bits_to_bytes(value, include_delimiter=False)
+        root = merkleize_chunks(pack_bytes(raw), limit=self.chunk_count())
+        root = mix_in_length(root, len(value))
+        if cached:
+            if value._uniform_kind is None and set(map(type, value)) <= {
+                bool
+            }:
+                value._uniform_kind = ("bool",)
+            if value._uniform_kind == ("bool",):
+                value._root_cache[self] = root
+                # the packed little-endian bits ride the same cache (and
+                # the same invalidation): the committee-mask kernel
+                # (models/committees.py) reads its bitfield matrix rows
+                # from here instead of re-boxing ~2k × ~1k Python bools
+                value._root_cache["bitpack"] = raw
+        return root
 
     def chunk_count(self) -> int:
         return (self.limit + 255) // 256

@@ -223,6 +223,7 @@ def _strip_spec_caches(state) -> None:
         "_active_idx_cache",
         "_proposer_cache",
         "_total_active_balance_cache",
+        "_pending_masks_memo",
     ):
         state.__dict__.pop(key, None)
 

@@ -1,0 +1,331 @@
+"""Committee-mask kernel (models/committees.py): the vectorized phase0
+pending-attestation masks must be bit-identical to the spec-helper walk
+(get_attesting_indices + the component filters) under scrambled
+aggregation bits, duplicate/overlapping aggregates, multi-slot inclusion
+delays, crosslink-era committee shapes, and attestations straddling the
+epoch boundary — plus the one-shuffle-per-epoch memo contract and the
+decline discipline (every fallback counted, spec errors preserved)."""
+
+import os
+import random
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+import chain_utils  # noqa: E402
+
+from ethereum_consensus_tpu.error import InvalidIndexedAttestation  # noqa: E402
+from ethereum_consensus_tpu.models import committees, epoch_vector  # noqa: E402
+from ethereum_consensus_tpu.models.phase0 import (  # noqa: E402
+    epoch_processing as pep,
+)
+from ethereum_consensus_tpu.models.phase0 import helpers as h  # noqa: E402
+from ethereum_consensus_tpu.models.phase0.slot_processing import (  # noqa: E402
+    process_slots,
+)
+from ethereum_consensus_tpu.telemetry import metrics  # noqa: E402
+
+np = pytest.importorskip("numpy")
+
+
+@pytest.fixture
+def forced(monkeypatch):
+    """Engage the kernel (and the columnar engine) on toy registries."""
+    monkeypatch.setattr(committees, "MASKS_MIN_VALIDATORS", 0)
+    monkeypatch.setattr(epoch_vector, "EPOCH_VECTOR_MIN_VALIDATORS", 0)
+
+
+def _prepared_state(validators: int, rng, epoch_span: int = 3):
+    """A phase0 state one slot before the ``epoch_span`` boundary with
+    BOTH pending lists populated over every coverable (slot, committee) —
+    including the last slots of the previous epoch (the boundary
+    straddle) — then scrambled: random aggregation bits, multi-slot
+    inclusion delays, and duplicate/overlapping aggregates."""
+    state, ctx = chain_utils.fresh_genesis_fork(
+        "phase0", validators, "minimal"
+    )
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    process_slots(state, epoch_span * spe - 1, ctx)
+    chain_utils.inject_full_epoch_pendings(state, ctx, epoch=epoch_span - 2)
+    chain_utils.inject_full_epoch_pendings(state, ctx, epoch=epoch_span - 1)
+    for lst in (
+        state.previous_epoch_attestations,
+        state.current_epoch_attestations,
+    ):
+        for a in lst:
+            a.aggregation_bits = [
+                rng.random() < 0.6 for _ in a.aggregation_bits
+            ]
+            a.inclusion_delay = rng.randint(1, spe)
+        # duplicate/overlapping aggregates for the same committee
+        for first in list(lst)[: 2]:
+            lst.append(
+                type(first)(
+                    aggregation_bits=[
+                        rng.random() < 0.5 for _ in first.aggregation_bits
+                    ],
+                    data=first.data.copy(),
+                    inclusion_delay=rng.randint(1, spe),
+                    proposer_index=first.proposer_index,
+                )
+            )
+    # registry churn the masks must respect (slashed filtering happens in
+    # the consumers; the kernel's unions must still match the helpers)
+    n = len(state.validators)
+    for i in rng.sample(range(n), 4):
+        state.validators[i].slashed = True
+    chain_utils._strip_spec_caches(state)
+    return state, ctx
+
+
+def _spec_masks(state, epoch, ctx):
+    """The oracle: raw attesting-index unions + the min-inclusion-delay
+    selection straight off the spec helpers."""
+    n = len(state.validators)
+    source = pep.get_matching_source_attestations(state, epoch, ctx)
+    target = pep.get_matching_target_attestations(state, epoch, ctx)
+    head = pep.get_matching_head_attestations(state, epoch, ctx)
+
+    def union(atts):
+        m = np.zeros(n, dtype=bool)
+        for a in atts:
+            for i in h.get_attesting_indices(
+                state, a.data, a.aggregation_bits, ctx
+            ):
+                m[i] = True
+        return m
+
+    best: dict = {}
+    for a in sorted(source, key=lambda a: a.inclusion_delay):
+        for i in h.get_attesting_indices(
+            state, a.data, a.aggregation_bits, ctx
+        ):
+            if i not in best:
+                best[i] = a
+    return union(source), union(target), union(head), best
+
+
+@pytest.mark.parametrize("validators", [256, 640])
+def test_masks_bit_identical_across_scrambled_epochs(validators, forced):
+    """≥6 scrambled epochs: kernel masks == spec-helper walk (source,
+    target, head, covered set, min-delay + proposer columns), the
+    mask-fed vectorized deltas == the literal component walk, and the
+    full epoch transition stays bit-identical to the all-scalar path.
+    Two registry sizes give crosslink-era committee shapes (different
+    committee counts per slot)."""
+    rng = random.Random(validators)
+    for trial in range(6):
+        span = 3 + (trial % 2)  # vary which epoch pair is live
+        state, ctx = _prepared_state(validators, rng, epoch_span=span)
+        spe = int(ctx.SLOTS_PER_EPOCH)
+        prev = span - 2
+
+        # --- direct mask differential on the pre-boundary state
+        bundle = committees.pending_masks_for(state, prev, ctx)
+        assert bundle is not None, "kernel declined on a clean state"
+        src, tgt, head, best = _spec_masks(state, prev, ctx)
+        assert np.array_equal(bundle.source, src)
+        assert np.array_equal(bundle.target, tgt)
+        assert np.array_equal(bundle.head, head)
+        covered = np.zeros(len(state.validators), dtype=bool)
+        covered[list(best)] = True
+        assert np.array_equal(bundle.covered, covered)
+        for i, a in best.items():
+            assert int(bundle.inclusion_delay[i]) == int(a.inclusion_delay)
+            assert int(bundle.inclusion_proposer[i]) == int(
+                a.proposer_index
+            )
+
+        # --- mask-fed vectorized deltas == the literal component walk
+        monkey_min = pep._VECTORIZED_REWARDS_MIN_N
+        pep._VECTORIZED_REWARDS_MIN_N = 0
+        try:
+            vec_r, vec_p = pep._attestation_deltas_vectorized(state, ctx)
+            lit_r, lit_p = pep._get_attestation_deltas_literal(state, ctx)
+        finally:
+            pep._VECTORIZED_REWARDS_MIN_N = monkey_min
+        assert [int(x) for x in vec_r] == lit_r
+        assert [int(x) for x in vec_p] == lit_p
+
+        # --- whole-epoch differential: everything on vs everything off
+        s_kernel = state.copy()
+        s_scalar = state.copy()
+        process_slots(s_kernel, span * spe, ctx)
+        os.environ["ECT_EPOCH_VECTOR"] = "off"
+        os.environ["ECT_COMMITTEE_MASKS"] = "off"
+        os.environ["ECT_OPS_VECTOR"] = "off"
+        try:
+            process_slots(s_scalar, span * spe, ctx)
+        finally:
+            for key in (
+                "ECT_EPOCH_VECTOR",
+                "ECT_COMMITTEE_MASKS",
+                "ECT_OPS_VECTOR",
+            ):
+                os.environ.pop(key, None)
+        T = type(state)
+        assert T.hash_tree_root(s_kernel) == T.hash_tree_root(s_scalar)
+        assert T.serialize(s_kernel) == T.serialize(s_scalar)
+
+
+def test_one_shuffle_per_epoch_under_duties_and_epoch(forced):
+    """The dedupe memo contract (ISSUE 14 satellite): serving committee
+    duties for every (slot, committee) of an epoch AND running the epoch
+    transition's mask kernel must cost ONE shuffle for that epoch —
+    both sides read the same per-seed cache entry."""
+    rng = random.Random(99)
+    state, ctx = _prepared_state(320, rng)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    prev = 1
+    h._SHUFFLE_CACHE.clear()
+    shuffles = metrics.counter("committees.shuffles")
+    before = shuffles.value()
+    # duties first: every committee of the previous epoch
+    per_slot = h.get_committee_count_per_slot(state, prev, ctx)
+    for slot in range(prev * spe, (prev + 1) * spe):
+        for index in range(per_slot):
+            h.get_beacon_committee(state, slot, index, ctx)
+    assert shuffles.value() - before == 1, "duties recomputed the shuffle"
+    # the mask kernel rides the same entry: zero additional shuffles
+    bundle = committees.pending_masks_for(state, prev, ctx)
+    assert bundle is not None
+    assert shuffles.value() - before == 1, (
+        "mask kernel recomputed the duties shuffle"
+    )
+    # and the array the kernel used slices to the same committees
+    from ethereum_consensus_tpu.domains import DomainType
+
+    indices = h.get_active_validator_indices(state, prev)
+    seed = h.get_seed(state, prev, DomainType.BEACON_ATTESTER, ctx)
+    table = h.shuffled_active_array(indices, seed, ctx)
+    committee = h.get_beacon_committee(state, prev * spe, 0, ctx)
+    start = len(indices) * 0 // (per_slot * spe)
+    assert table[start : start + len(committee)].tolist() == committee
+    assert shuffles.value() - before == 1
+
+
+def test_masks_memoized_within_pass_and_dropped_at_rotation(forced):
+    """One bundle per (state, epoch) per transition: justification and
+    rewards share the memo; the rotation drops it."""
+    rng = random.Random(5)
+    state, ctx = _prepared_state(256, rng)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    builds = metrics.counter("committees.masks.builds")
+    b0 = builds.value()
+    s = state.copy()
+    process_slots(s, 3 * spe, ctx)
+    # one build for the previous epoch, one for the current — justification
+    # AND rewards consumed them through the memo, no rebuilds
+    assert builds.value() - b0 == 2
+    assert committees._MEMO_ATTR not in s.__dict__, (
+        "mask memo survived the participation rotation"
+    )
+
+
+def test_bits_shape_decline_preserves_spec_error(forced):
+    """A bits/committee length mismatch declines the kernel (counted),
+    and the spec walk raises its structured InvalidIndexedAttestation —
+    identically with the kernel enabled or disabled."""
+    rng = random.Random(11)
+    state, ctx = _prepared_state(256, rng)
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    state.previous_epoch_attestations[0].aggregation_bits = [True] * 3
+    chain_utils._strip_spec_caches(state)
+    decline = metrics.counter("committees.fallback.bits_shape")
+    d0 = decline.value()
+    s = state.copy()
+    with pytest.raises(InvalidIndexedAttestation):
+        process_slots(s, 3 * spe, ctx)
+    assert decline.value() > d0, "bits_shape decline not counted"
+    twin = state.copy()
+    os.environ["ECT_COMMITTEE_MASKS"] = "off"
+    try:
+        with pytest.raises(InvalidIndexedAttestation):
+            process_slots(twin, 3 * spe, ctx)
+    finally:
+        os.environ.pop("ECT_COMMITTEE_MASKS", None)
+
+
+def test_kill_switch_and_threshold_declines():
+    """ECT_COMMITTEE_MASKS=off and the registry-size threshold decline
+    cleanly (counted; callers run the spec walk)."""
+    rng = random.Random(3)
+    state, ctx = _prepared_state(256, rng)
+    # threshold (no fixture: 256 < MASKS_MIN_VALIDATORS)
+    below = metrics.counter("committees.fallback.below_threshold")
+    b0 = below.value()
+    assert committees.pending_masks_for(state, 1, ctx) is None
+    assert below.value() == b0 + 1
+    # kill switch
+    disabled = metrics.counter("committees.fallback.disabled")
+    d0 = disabled.value()
+    os.environ["ECT_COMMITTEE_MASKS"] = "off"
+    try:
+        committees.MASKS_MIN_VALIDATORS, saved = 0, (
+            committees.MASKS_MIN_VALIDATORS
+        )
+        try:
+            assert committees.pending_masks_for(state, 1, ctx) is None
+        finally:
+            committees.MASKS_MIN_VALIDATORS = saved
+    finally:
+        os.environ.pop("ECT_COMMITTEE_MASKS", None)
+    assert disabled.value() == d0 + 1
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: the phase0 mask-engagement check (make bench-smoke)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.bench_smoke
+@pytest.mark.slow
+def test_phase0_mask_engagement_2e18():
+    """One warm phase0 epoch at 2^18 with full pending coverage (mainnet
+    preset, disk-cached state): the committee-mask kernel must engage at
+    its NATURAL threshold with zero committees.fallback.* and zero
+    epoch_vector.fallback.*, exactly one shuffle, and a sub-second
+    epoch — the bench-smoke tripwire for the 2^21 flagship path."""
+    import time
+
+    from ethereum_consensus_tpu.models import phase0
+
+    ctx = chain_utils.Context.for_mainnet()
+    ns = phase0.build(ctx.preset)
+    slots = int(ctx.SLOTS_PER_EPOCH)
+    N = 1 << 18
+
+    def build():
+        state, _ = chain_utils.fast_registry_state(N)
+        process_slots(state, slots, ctx)
+        chain_utils.inject_full_epoch_pendings(state, ctx, epoch=0)
+        return state
+
+    loaded = chain_utils._disk_cached(
+        f"epochstate-{chain_utils._FASTREG_VERSION}-mainnet-{N}",
+        ns.BeaconState.serialize,
+        ns.BeaconState.deserialize,
+        build,
+    )
+    ns.BeaconState.hash_tree_root(loaded)
+    warm = loaded.copy()
+    process_slots(warm, 2 * slots, ctx)
+    del warm
+
+    base = metrics.snapshot()
+    s = loaded.copy()
+    t0 = time.perf_counter()
+    process_slots(s, 2 * slots, ctx)
+    warm_s = time.perf_counter() - t0
+    d = metrics.delta(base)
+    assert d.get("committees.masks.builds", 0) >= 1, "mask kernel idle"
+    assert not any(
+        k.startswith("committees.fallback.") and v for k, v in d.items()
+    ), {k: v for k, v in d.items() if k.startswith("committees.fallback.")}
+    assert not any(
+        k.startswith("epoch_vector.fallback.") and v for k, v in d.items()
+    ), {k: v for k, v in d.items() if k.startswith("epoch_vector.fallback.")}
+    assert d.get("committees.shuffles", 0) <= 1, "shuffle dedupe broken"
+    assert warm_s < 1.0, f"2^18 warm phase0 epoch took {warm_s:.2f}s"

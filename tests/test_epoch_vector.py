@@ -440,6 +440,128 @@ def test_kernels_jittable_bit_identical():
     assert np.array_equal(np.asarray(dev_bal), host_bal)
 
 
+def _fused_inputs(n=4097, seed=13):
+    rng = np.random.default_rng(seed)
+    return dict(
+        balances=rng.integers(0, 1 << 45, n, dtype=np.uint64),
+        eff=rng.integers(1 << 30, 1 << 35, n, dtype=np.uint64),
+        prev_part=rng.integers(0, 8, n, dtype=np.uint8),
+        slashed=rng.random(n) < 0.05,
+        active_prev=rng.random(n) < 0.95,
+        eligible=rng.random(n) < 0.96,
+        scores=rng.integers(0, 1 << 20, n, dtype=np.uint64),
+    )
+
+
+@pytest.mark.parametrize("leaking", [False, True])
+def test_fused_kernel_matches_staged_kernels_and_jit(leaking):
+    """The fused epoch kernel (ISSUE 14) must equal the staged kernels it
+    collapses — inactivity update, three flag-delta pairs off in-kernel
+    sums, inactivity penalties off post-update scores, in-order
+    application — on host numpy AND bit-identically under jax.jit with
+    x64 (the jitted_kernels() discipline)."""
+    k = _fused_inputs()
+    n = k["balances"].shape[0]
+    increment, brpi = 10**9, 907
+    weights, wd = (14, 26, 14), 64
+    bias, recovery = 4, 16
+    denominator = bias * (3 * 10**7)
+    active_increments = max(1, int(k["eff"].sum()) // increment)
+
+    # staged composition (the live host fallback path)
+    target_bit = ((k["prev_part"] >> np.uint8(1)) & np.uint8(1)).astype(bool)
+    participating = k["active_prev"] & ~k["slashed"] & target_bit
+    staged_scores = epoch_vector.inactivity_scores_kernel(
+        np, k["scores"], k["eligible"], participating, bias, recovery,
+        leaking,
+    )
+    base_reward = (k["eff"] // np.uint64(increment)) * np.uint64(brpi)
+    pairs = []
+    for flag_index, weight in enumerate(weights):
+        bit = ((k["prev_part"] >> np.uint8(flag_index)) & np.uint8(1)).astype(
+            bool
+        )
+        unslashed = k["active_prev"] & ~k["slashed"] & bit
+        u_incr = max(increment, int(k["eff"][unslashed].sum())) // increment
+        pairs.append(
+            epoch_vector.flag_deltas_kernel(
+                np, base_reward, k["eligible"], unslashed, weight, u_incr,
+                active_increments, wd, leaking, flag_index == 2,
+            )
+        )
+    missed = k["eligible"] & ~participating
+    pen = np.where(
+        missed,
+        k["eff"] * staged_scores // np.uint64(denominator),
+        np.uint64(0),
+    )
+    pairs.append((np.zeros(n, dtype=np.uint64), pen))
+    staged_balances = epoch_vector.apply_delta_pairs_kernel(
+        np, k["balances"], pairs
+    )
+
+    host_scores, host_balances, host_wrapped = (
+        epoch_vector.fused_epoch_kernel(
+            np, k["balances"], k["eff"], k["prev_part"], k["slashed"],
+            k["active_prev"], k["eligible"], k["scores"],
+            np.uint64(increment), np.uint64(brpi),
+            np.uint64(active_increments), np.uint64(denominator),
+            bias, recovery, weights, wd, leaking, 2, 1,
+        )
+    )
+    assert np.array_equal(host_scores, staged_scores)
+    assert np.array_equal(host_balances, staged_balances)
+    assert int(host_wrapped) == 0
+
+    jax = pytest.importorskip("jax")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    fused = epoch_vector.jitted_kernels()["fused_epoch"]
+    dev_scores, dev_balances, dev_wrapped = fused(
+        jnp.asarray(k["balances"]), jnp.asarray(k["eff"]),
+        jnp.asarray(k["prev_part"]), jnp.asarray(k["slashed"]),
+        jnp.asarray(k["active_prev"]), jnp.asarray(k["eligible"]),
+        jnp.asarray(k["scores"]),
+        jnp.uint64(increment), jnp.uint64(brpi),
+        jnp.uint64(active_increments), jnp.uint64(denominator),
+        bias, recovery, weights, wd, leaking, 2, 1,
+    )
+    assert np.array_equal(np.asarray(dev_scores), staged_scores)
+    assert np.array_equal(np.asarray(dev_balances), staged_balances)
+    assert int(dev_wrapped) == 0
+
+
+def test_fused_jit_route_bit_identical_through_the_pass(forced_engine,
+                                                       monkeypatch):
+    """ops.install's sweeps flag routes the columnar pass through the
+    jitted fused kernel — the full transition must stay bit-identical to
+    the host staged path, with the fused engagement counted."""
+    from ethereum_consensus_tpu import _device_flags
+
+    state, ctx = chain_utils.fresh_genesis_fork("deneb", 96, "minimal")
+    sp = _slot_processing("deneb")
+    spe = int(ctx.SLOTS_PER_EPOCH)
+    sp.process_slots(state, spe, ctx)
+    n = len(state.validators)
+    state.previous_epoch_participation = [0b111] * n
+    for i in range(0, n, 5):
+        state.previous_epoch_participation[i] = 0b001
+    chain_utils._strip_spec_caches(state)
+
+    host = state.copy()
+    sp.process_slots(host, 2 * spe, ctx)
+
+    monkeypatch.setattr(_device_flags, "SWEEPS_MIN_N", 1)
+    fused_ctr = metrics.counter("epoch_vector.fused.jit")
+    before = fused_ctr.value()
+    dev = state.copy()
+    sp.process_slots(dev, 2 * spe, ctx)
+    assert fused_ctr.value() == before + 1, "fused jit route did not engage"
+    assert type(host).hash_tree_root(host) == type(dev).hash_tree_root(dev)
+    assert type(host).serialize(host) == type(dev).serialize(dev)
+
+
 # ---------------------------------------------------------------------------
 # bench smoke: the 2^18 columnar-primary engagement check (make bench-smoke)
 # ---------------------------------------------------------------------------

@@ -247,6 +247,71 @@ def test_sharded_sweeps_match_host_kernels_non_pow2(mesh_env):
     assert wrapped is None
 
 
+def test_sharded_fused_kernel_matches_host(mesh_env):
+    """The FUSED epoch kernel (ISSUE 14) mesh-sharded on odd-length
+    columns: one dispatch must equal the host fused kernel (which the
+    jit-identity test pins to the staged kernels) — scores AND balances —
+    and surface the wrap census as None."""
+    from ethereum_consensus_tpu.models.epoch_vector import (
+        fused_epoch_kernel,
+    )
+    from ethereum_consensus_tpu.parallel import runtime
+
+    mesh_env.setenv("ECT_MESH", "1")
+    mesh_env.setenv("ECT_MESH_EPOCH_MIN_N", "1")
+    runner = runtime.epoch_sweeps(1003)
+    assert runner is not None
+
+    rng = np.random.default_rng(21)
+    n = 1003
+    eff = rng.integers(0, 33, n, dtype=np.uint64) * np.uint64(10**9)
+    balances = eff + rng.integers(0, 10**9, n, dtype=np.uint64)
+    prev_part = rng.integers(0, 8, n, dtype=np.uint8)
+    slashed = rng.random(n) < 0.05
+    active_prev = rng.random(n) < 0.9
+    eligible = active_prev | (rng.random(n) < 0.02)
+    scores = rng.integers(0, 50, n, dtype=np.uint64)
+    kwargs = dict(
+        increment=10**9,
+        brpi=31414,
+        active_increments=int(eff[active_prev].sum()) // 10**9 or 1,
+        denominator=4 * (1 << 24),
+        bias=4,
+        recovery_rate=16,
+        weights=(14, 26, 14),
+        weight_denominator=64,
+        leaking=False,
+        head_flag_index=2,
+        target_flag_index=1,
+    )
+    for leaking in (False, True):
+        kwargs["leaking"] = leaking
+        got = runner.fused(balances, eff, prev_part, slashed, active_prev,
+                           eligible, scores, **kwargs)
+        assert got is not None
+        want_scores, want_balances, want_wrapped = fused_epoch_kernel(
+            np, balances, eff, prev_part, slashed, active_prev, eligible,
+            scores, np.uint64(kwargs["increment"]), np.uint64(kwargs["brpi"]),
+            np.uint64(kwargs["active_increments"]),
+            np.uint64(kwargs["denominator"]), kwargs["bias"],
+            kwargs["recovery_rate"], kwargs["weights"],
+            kwargs["weight_denominator"], leaking,
+            kwargs["head_flag_index"], kwargs["target_flag_index"],
+        )
+        assert int(want_wrapped) == 0
+        assert np.array_equal(got[0], want_scores)
+        assert np.array_equal(got[1], want_balances)
+
+    # wrap census → None (staged host path owns the structured error)
+    hot = balances.copy()
+    hot[1] = np.uint64((1 << 64) - 1)
+    kwargs["leaking"] = False
+    assert runner.fused(
+        hot, eff, np.full(n, 0b111, dtype=np.uint8), np.zeros(n, bool),
+        np.ones(n, bool), np.ones(n, bool), scores, **kwargs
+    ) is None
+
+
 def test_mesh_merkle_hook_identity_and_reset(mesh_env):
     """The provisioned mesh installs the ssz merkleization hook; routed
     roots are bit-identical to the host merkleizer, and reset() clears

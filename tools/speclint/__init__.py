@@ -95,6 +95,10 @@ def _default_targets(root: str) -> dict:
             # arrays become shared column caches, and its fallback
             # one-shot set mirrors ops_vector's
             os.path.join(root, _PKG, "models", "epoch_vector.py"),
+            # the committee-mask kernel (ISSUE 14): a process-wide
+            # one-shot fallback set + per-state memos shared across
+            # copies — the same lock discipline as the engines above
+            os.path.join(root, _PKG, "models", "committees.py"),
             # the scenario harness drives the pipeline from test/driver
             # threads while the FaultInjector is read on the worker
             os.path.join(root, _PKG, "scenarios"),
