@@ -55,10 +55,15 @@ own telemetry spans — plus a ``device`` block (ISSUE 10): compiles /
 recompile-sentinel count / transfer bytes / routing-journal tallies /
 jit-cache hits, cross-checked against the observatory's own ledgers
 (``journal_consistent``, folded into ``ok`` for ``pipeline_blocks`` and
-the epoch configs). ``--trace-out PATH`` records the whole child run
-as Chrome trace JSON (device lane included); ``--metrics-out PATH``
-dumps the final registry snapshot; ``--device-out PATH`` the device
-observatory's ledgers.
+the epoch configs) — plus a ``mem`` block (ISSUE 15): peak/current RSS
+and bulk-copy bytes for EVERY config, and for the epoch configs the
+full attribution report (per-phase RSS deltas, worst-owner census,
+per-site bandwidth, profile ceiling + >=80% attribution floor folded
+into ``ok``). ``--trace-out PATH`` records the whole child run as
+Chrome trace JSON (device + memory lanes included); ``--metrics-out
+PATH`` dumps the final registry snapshot; ``--device-out PATH`` the
+device observatory's ledgers; ``--memory-out PATH`` the memory
+observatory's (census, phase ledger, bandwidth sites).
 
 Prints ONE COMPACT JSON line as the last stdout line (small enough for
 any log-tail window — round 4's full dump truncated mid-object and the
@@ -97,6 +102,8 @@ DEGRADED_ENV = "EC_BENCH_DEGRADED"
 TRACE_OUT_ENV = "EC_BENCH_TRACE_OUT"      # --trace-out (child records spans)
 METRICS_OUT_ENV = "EC_BENCH_METRICS_OUT"  # --metrics-out (registry snapshot)
 DEVICE_OUT_ENV = "EC_BENCH_DEVICE_OUT"    # --device-out (observatory ledgers)
+MEMORY_OUT_ENV = "EC_BENCH_MEMORY_OUT"    # --memory-out (memory ledgers)
+MEM_PROFILE_ENV = "EC_SOAK_PROFILE"       # deployment profile path override
 SERVE_PORT_ENV = "EC_BENCH_SERVE_PORT"    # --serve-port (introspection server)
 
 PROBE_TIMEOUT_S = 150       # TPU init is ~20-40s healthy; a hang never ends
@@ -523,23 +530,126 @@ def _epoch_validators(default: int = 1 << 21) -> int:
 
 
 def _rss_mb() -> "tuple[float, float]":
-    """(peak_rss_mb, current_rss_mb): the process high-water mark from
-    getrusage (monotonic across configs — the epoch configs are the
-    biggest states in the battery, so the peak is theirs in practice)
-    and the instantaneous VmRSS for per-config attribution."""
-    import resource
+    """(peak_rss_mb, current_rss_mb) — the memory observatory's readers
+    (telemetry/memory.py): the getrusage high-water mark (monotonic
+    across configs — the epoch configs are the biggest states in the
+    battery, so the peak is theirs in practice) and the instantaneous
+    statm RSS for per-config attribution."""
+    from ethereum_consensus_tpu.telemetry import memory as tel_memory
 
-    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
-    current = 0.0
+    return tel_memory.peak_rss_mb(), tel_memory.rss_mb()
+
+
+def _mem_ceiling_mb(validators: int) -> "float | None":
+    """The epoch configs' peak-RSS ceiling from the deployment profile
+    (soak/profiles/default.json ``memory_ceilings``; path overridable
+    via ``EC_SOAK_PROFILE``): the 2^21 flagship asserts its known
+    ~9 GB envelope, the ``EC_BENCH_XL`` 2^22 stretch its measured
+    18.4 GB one. None (no ceiling) when the profile omits the table."""
+    from ethereum_consensus_tpu.soak.runner import load_profile
+
     try:
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    current = float(line.split()[1]) / 1024.0
-                    break
-    except OSError:
-        pass
-    return peak, current
+        ceilings = load_profile(
+            os.environ.get(MEM_PROFILE_ENV) or None
+        ).get("memory_ceilings", {})
+    except (OSError, ValueError):
+        return None
+    key = "epoch_xl" if validators >= (1 << 22) else "epoch"
+    value = ceilings.get(key)
+    return float(value) if value is not None else None
+
+
+def _mem_phase_delta(before: dict, after: dict) -> dict:
+    """Per-phase ledger delta between two ``phase_ledger()`` snapshots:
+    counts/sums subtract, watermark fields report the after value —
+    only phases that actually ran in the window appear."""
+    out: dict = {}
+    for name, a in after.items():
+        b = before.get(name, {})
+        if a.get("count", 0) == b.get("count", 0):
+            continue
+        out[name] = {
+            "count": a["count"] - b.get("count", 0),
+            "rss_delta_mb": round(
+                a["rss_delta_mb"] - b.get("rss_delta_mb", 0.0), 1
+            ),
+            "seconds": round(a["seconds"] - b.get("seconds", 0.0), 3),
+            "peak_mb": a["peak_mb"],
+            "rss_end_mb": a["rss_end_mb"],
+            "transient_mb": a["transient_mb"],
+            "traced_delta_mb": round(
+                a["traced_delta_mb"] - b.get("traced_delta_mb", 0.0), 2
+            ),
+        }
+    return out
+
+
+def _mem_evidence(baseline_mb: float, phases_before: dict,
+                  copies_before: dict, validators: int) -> dict:
+    """The epoch configs' ``mem`` evidence block (ISSUE 15): decompose
+    the config's peak RSS into NAMED terms — the carried-in baseline
+    (everything earlier configs left resident), each ``mem.*`` bracket's
+    retained growth, and the peak bracket's transient working set —
+    plus the worst-owner census table and the per-site bulk-copy bytes.
+    ``ok`` folds the profile ceiling and (while the observatory was
+    active for the whole config) the >=80% attribution floor."""
+    from ethereum_consensus_tpu.telemetry import memory as tel_memory
+
+    obs = tel_memory.OBSERVATORY
+    peak_mb, now_mb = _rss_mb()
+    phases = _mem_phase_delta(phases_before, obs.phase_ledger())
+    copies_now = obs.copy_summary()
+    bandwidth = {}
+    for site, agg in copies_now["sites"].items():
+        prev = copies_before.get("sites", {}).get(site, {})
+        count = agg["count"] - prev.get("count", 0)
+        nbytes = agg["bytes"] - prev.get("bytes", 0)
+        if count:
+            bandwidth[site] = {"count": count, "bytes": nbytes,
+                               "mb": round(nbytes / (1 << 20), 1)}
+    # attribution: baseline + every explicit bench bracket's retained
+    # growth (the mem.* brackets partition the config's work and never
+    # nest, so their deltas are additive; the transition/epoch spans
+    # nest INSIDE them and stay report-only) + the transient headroom
+    # of whichever bracket raised the process high-water mark
+    bench_phases = {
+        name: rec for name, rec in phases.items() if name.startswith("mem.")
+    }
+    retained = sum(
+        max(0.0, rec["rss_delta_mb"]) for rec in bench_phases.values()
+    )
+    peak_phase = obs.peak_phase()
+    transient = 0.0
+    if peak_phase in bench_phases:
+        transient = bench_phases[peak_phase]["transient_mb"]
+    attributed = baseline_mb + retained + transient
+    fraction = min(1.0, attributed / peak_mb) if peak_mb else 0.0
+    owners = obs.worst(8)
+    # flat numeric twin of the worst table so bench_compare --trend can
+    # chart per-owner bytes (its leaf walk skips lists)
+    owner_mb = {row["owner"]: row["mb"] for row in owners}
+    ceiling = _mem_ceiling_mb(validators)
+    observed = bool(obs.active and bench_phases)
+    ok = True
+    if ceiling is not None:
+        ok = peak_mb <= ceiling
+    if observed:
+        ok = ok and fraction >= 0.8
+    return {
+        "peak_rss_mb": round(peak_mb, 1),
+        "rss_mb": round(now_mb, 1),
+        "baseline_mb": round(baseline_mb, 1),
+        "phases": phases,
+        "peak_phase": peak_phase,
+        "attributed_mb": round(attributed, 1),
+        "attribution_fraction": round(fraction, 3),
+        "owners": owners,
+        "owner_mb": owner_mb,
+        "bandwidth": bandwidth,
+        "ceiling_mb": ceiling,
+        "observed": observed,
+        "ok": bool(ok),
+    }
 
 
 _EPOCH_SWEEP_SPANS = (
@@ -584,10 +694,19 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
     zero ``epoch_vector.fallback.*``, zero column builds, and no named
     registry-sweep span inside the warm pass (the
     ``hot_sweeps_per_block_absent`` discipline, epoch edition)."""
+    from ethereum_consensus_tpu.telemetry import memory as tel_memory
     from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
     from ethereum_consensus_tpu.telemetry import spans as tel_spans
 
     import gc
+
+    # memory evidence (ISSUE 15): the config's RSS story decomposes into
+    # the mem.* brackets below — baseline is everything earlier configs
+    # left resident at entry
+    mem_obs = tel_memory.OBSERVATORY
+    mem_baseline_mb = tel_memory.rss_mb()
+    mem_phases_before = mem_obs.phase_ledger()
+    mem_copies_before = mem_obs.copy_summary()
 
     def timed_epoch(state) -> float:
         """One epoch with the collector parked (the pyperf discipline):
@@ -606,15 +725,18 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
         finally:
             gc.enable()
 
-    cold_state = state_type.deserialize(state_type.serialize(loaded))
-    cold_s = timed_epoch(cold_state)
+    with tel_memory.phase("mem.cold_state_build"):
+        cold_state = state_type.deserialize(state_type.serialize(loaded))
+    with tel_memory.phase("mem.cold_epoch"):
+        cold_s = timed_epoch(cold_state)
     del cold_state
-    state_type.hash_tree_root(loaded)  # warm the root memo
-    if fork is not None:
-        _prime_warm_state(fork, loaded, ctx)  # columns live on the original
-    scratch = loaded.copy()
-    process_slots(scratch, 2 * slots, ctx)  # warm imports/caches once
-    del scratch
+    with tel_memory.phase("mem.warm_prime"):
+        state_type.hash_tree_root(loaded)  # warm the root memo
+        if fork is not None:
+            _prime_warm_state(fork, loaded, ctx)  # columns live on original
+        scratch = loaded.copy()
+        process_slots(scratch, 2 * slots, ctx)  # warm imports/caches once
+        del scratch
 
     # headline: best-of-3 uninstrumented warm epochs, timed straight
     # after the warm-up (the resident-client regime; later copies churn
@@ -622,24 +744,26 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
     # filters out)
     times = []
     final = None
-    for _ in range(3):
-        state = loaded.copy()
-        times.append(timed_epoch(state))
-        final = state
+    with tel_memory.phase("mem.warm_epochs"):
+        for _ in range(3):
+            state = loaded.copy()
+            times.append(timed_epoch(state))
+            final = state
     warm_s = min(times)
 
     # instrumented warm run: engagement counters + per-stage spans
     metrics_base = tel_metrics.snapshot()
     rec = tel_spans.RECORDER
     state = loaded.copy()
-    if rec.enabled:
-        before_id = max((r.span_id for r in rec.records()), default=0)
-        process_slots(state, 2 * slots, ctx)
-        records = [r for r in rec.records() if r.span_id > before_id]
-    else:
-        with tel_spans.recording(capacity=1 << 16):
+    with tel_memory.phase("mem.instrumented_epoch"):
+        if rec.enabled:
+            before_id = max((r.span_id for r in rec.records()), default=0)
             process_slots(state, 2 * slots, ctx)
-            records = rec.records()
+            records = [r for r in rec.records() if r.span_id > before_id]
+        else:
+            with tel_spans.recording(capacity=1 << 16):
+                process_slots(state, 2 * slots, ctx)
+                records = rec.records()
     d = tel_metrics.delta(metrics_base)
     fallbacks = {
         key.split("epoch_vector.fallback.", 1)[1]: value
@@ -684,18 +808,25 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
     old = os.environ.get("ECT_EPOCH_VECTOR")
     os.environ["ECT_EPOCH_VECTOR"] = "off"
     try:
-        oracle = loaded.copy()
-        oracle_s = timed_epoch(oracle)
+        with tel_memory.phase("mem.oracle_epoch"):
+            oracle = loaded.copy()
+            oracle_s = timed_epoch(oracle)
     finally:
         if old is None:
             os.environ.pop("ECT_EPOCH_VECTOR", None)
         else:
             os.environ["ECT_EPOCH_VECTOR"] = old
-    identical = state_type.hash_tree_root(final) == state_type.hash_tree_root(
-        oracle
-    ) and state_type.serialize(final) == state_type.serialize(oracle)
+    with tel_memory.phase("mem.identity_check"):
+        identical = state_type.hash_tree_root(
+            final
+        ) == state_type.hash_tree_root(oracle) and state_type.serialize(
+            final
+        ) == state_type.serialize(oracle)
     evidence["bit_identical_vs_oracle"] = bool(identical)
-    peak_mb, now_mb = _rss_mb()
+    mem = _mem_evidence(
+        mem_baseline_mb, mem_phases_before, mem_copies_before,
+        len(loaded.validators),
+    )
     return {
         "cold_epoch_s": cold_s,
         "epoch_s": warm_s,
@@ -704,8 +835,9 @@ def _epoch_cold_warm(state_type, loaded, process_slots, slots, ctx,
             round(oracle_s / warm_s, 2) if warm_s else None
         ),
         "phases": phases,
-        "peak_rss_mb": round(peak_mb, 1),
-        "rss_mb": round(now_mb, 1),
+        "peak_rss_mb": mem["peak_rss_mb"],
+        "rss_mb": mem["rss_mb"],
+        "mem": mem,
         "columnar": evidence,
     }
 
@@ -871,6 +1003,7 @@ def bench_epoch_mainnet(validators: "int | None" = None):
         out["columnar"]["bit_identical_vs_oracle"]
         and out["columnar"]["elem_materialization_absent"]
         and masks_engaged
+        and out["mem"]["ok"]  # ceiling + attribution (ISSUE 15)
     )
     if flagship:
         ok = ok and out["epoch_s"] <= 0.5
@@ -979,6 +1112,7 @@ def bench_epoch_deneb(validators: "int | None" = None):
         out["columnar"]["bit_identical_vs_oracle"]
         and out["columnar"]["elem_materialization_absent"]
         and out["fused"]["ok"]
+        and out["mem"]["ok"]  # ceiling + attribution (ISSUE 15)
     )
     if flagship:
         ok = ok and out["epoch_s"] <= 1.0
@@ -1052,6 +1186,7 @@ def bench_epoch_electra(validators: "int | None" = None):
             out["columnar"]["bit_identical_vs_oracle"]
             and out["columnar"]["elem_materialization_absent"]
             and out["fused"]["ok"]
+            and out["mem"]["ok"]  # ceiling + attribution (ISSUE 15)
         ),
     )
     return out
@@ -2749,7 +2884,11 @@ def bench_soak(cycles: int = 150, deadline_s: float = 210.0,
         cycles, deadline_s, min_windows = 3, 60.0, 20
     elif _degraded():
         cycles = min(cycles, 120)
-    config = SoakConfig(
+    # the deployment profile is the base (shipped catastrophe-catcher
+    # defaults, docs/SOAK.md; EC_SOAK_PROFILE overrides the path) and
+    # the bench's sustained shape rides on top as overrides
+    config = SoakConfig.from_file(
+        os.environ.get(MEM_PROFILE_ENV) or None,
         cycles=cycles,
         deadline_s=deadline_s,
         min_windows=min_windows,
@@ -3085,6 +3224,7 @@ def _metrics_block(before: dict) -> dict:
 def child_main() -> None:
     global _CHILD_T0
     from ethereum_consensus_tpu.telemetry import device as tel_device
+    from ethereum_consensus_tpu.telemetry import memory as tel_memory
     from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
     from ethereum_consensus_tpu.telemetry import spans as tel_spans
     from ethereum_consensus_tpu.utils import trace
@@ -3100,6 +3240,11 @@ def child_main() -> None:
     # ``device`` evidence blocks + the BENCH_FULL device ledger; its
     # per-event cost is microseconds against kernel-scale work
     tel_device.start()
+    # the memory observatory too (ISSUE 15): per-config ``mem`` blocks
+    # (peak RSS for every config, the full attribution report for the
+    # epoch configs), the bandwidth ledger, and the BENCH_FULL memory
+    # ledger — every ok-gated config must stay ok with it active
+    tel_memory.start()
     server = None
     serve_port = os.environ.get(SERVE_PORT_ENV)
     if serve_port:
@@ -3135,6 +3280,8 @@ def child_main() -> None:
         _note(f"config {name} starting ({elapsed:.0f}s elapsed)")
         metrics_base = tel_metrics.snapshot()
         obs_base = _obs_tallies()
+        mem_copies_base = tel_memory.OBSERVATORY.copy_summary()["totals"]
+        mem_rss_base = tel_memory.rss_mb()
         t0 = time.monotonic()
         try:
             with trace.span("bench." + name):
@@ -3144,6 +3291,25 @@ def child_main() -> None:
         out["wall_s"] = round(time.monotonic() - t0, 2)
         out["metrics"] = _metrics_block(metrics_base)
         out["device"] = _device_block(metrics_base, obs_base)
+        # uniform memory evidence (ISSUE 15 satellite): EVERY config
+        # records its peak/current RSS and bulk-copy traffic through
+        # the observatory sampler, so bench_compare --trend can chart
+        # the whole battery's memory story; the epoch configs' richer
+        # attribution block (set inside _epoch_cold_warm) is preserved
+        mem_totals = tel_memory.OBSERVATORY.copy_summary()["totals"]
+        mem_block = out.setdefault("mem", {})
+        mem_block.setdefault(
+            "peak_rss_mb", round(tel_memory.peak_rss_mb(), 1)
+        )
+        mem_block.setdefault("rss_mb", round(tel_memory.rss_mb(), 1))
+        mem_block.setdefault("baseline_mb", round(mem_rss_base, 1))
+        mem_block.setdefault(
+            "copy_bytes", mem_totals["bytes"] - mem_copies_base["bytes"]
+        )
+        mem_block.setdefault(
+            "copies", mem_totals["count"] - mem_copies_base["count"]
+        )
+        out.setdefault("peak_rss_mb", mem_block["peak_rss_mb"])
         if name in DEVICE_OK_CONFIGS and "ok" in out:
             # the device evidence is part of these configs' acceptance:
             # route tallies / transfer bytes / recompile counts must
@@ -3169,6 +3335,9 @@ def child_main() -> None:
     # the whole run's device ledgers ride along the same way (compile
     # census, per-site transfer bytes, routing-journal tallies)
     results["device_ledger"] = tel_device.snapshot(journal_n=64)
+    # ... and the memory ledgers (census/worst table, phase RSS ledger,
+    # per-site bulk-copy bytes) — the battery-wide memory story
+    results["memory_ledger"] = tel_memory.snapshot(worst_n=12)
     checkpoint()
     if trace_out:
         tel_spans.stop_recording()
@@ -3184,6 +3353,11 @@ def child_main() -> None:
         with open(device_out, "w") as f:
             json.dump(tel_device.snapshot(), f, indent=1, sort_keys=True)
         _note(f"device ledger written: {device_out}")
+    memory_out = os.environ.get(MEMORY_OUT_ENV)
+    if memory_out:
+        with open(memory_out, "w") as f:
+            json.dump(tel_memory.snapshot(), f, indent=1, sort_keys=True)
+        _note(f"memory ledger written: {memory_out}")
     if server is not None:
         server.stop()
 
@@ -3271,6 +3445,7 @@ def main() -> None:
         ("--trace-out", TRACE_OUT_ENV),
         ("--metrics-out", METRICS_OUT_ENV),
         ("--device-out", DEVICE_OUT_ENV),
+        ("--memory-out", MEMORY_OUT_ENV),
     ):
         if flag in argv:
             at = argv.index(flag)
@@ -3300,7 +3475,8 @@ def main() -> None:
         env = cpu_mesh_env(1, repo_root=REPO)
         env[DEGRADED_ENV] = note
         for env_key in (
-            TRACE_OUT_ENV, METRICS_OUT_ENV, DEVICE_OUT_ENV, SERVE_PORT_ENV,
+            TRACE_OUT_ENV, METRICS_OUT_ENV, DEVICE_OUT_ENV, MEMORY_OUT_ENV,
+            MEM_PROFILE_ENV, SERVE_PORT_ENV,
             "EC_BENCH_ONLY",
         ):
             if os.environ.get(env_key):  # survive the hermetic scrub

@@ -81,6 +81,22 @@ _TREND_HEADLINE = (
     "device.d2h_bytes",
     "device.route_device",
     "device.route_host",
+    # the memory observatory's axes (ISSUE 15): every config's peak RSS
+    # and bulk-copy volume, the epoch configs' attribution fraction and
+    # the phase terms that decompose a fat epoch (retained cold-state
+    # growth, the warm working set's transient headroom)
+    "mem.peak_rss_mb",
+    "mem.rss_mb",
+    "mem.copy_bytes",
+    "mem.attribution_fraction",
+    "mem.attributed_mb",
+    "mem.phases.mem.cold_state_build.rss_delta_mb",
+    "mem.phases.mem.warm_epochs.transient_mb",
+    "mem.phases.mem.warm_epochs.rss_delta_mb",
+    "mem.owner_mb.ssz.columns",
+    "mem.owner_mb.ssz.pack_tree",
+    "mem.owner_mb.ssz.tree_memo",
+    "mem.owner_mb.ssz.bitpack",
     # the operation pool's write-plane axes (ISSUE 11): admission rates
     # for both engines, the RLC speedup, and the flush discipline
     "admissions_per_s_rlc",

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 
 from ..domains import DomainType
 from ..telemetry import device as _device_obs
@@ -58,8 +59,19 @@ __all__ = [
     "PendingMasks",
     "pending_masks_for",
     "drop_masks_memo",
+    "registered_bundles",
     "MASKS_MIN_VALIDATORS",
 ]
+
+# every live mask bundle, for the memory observatory's
+# ``committees.mask_bundles`` owner census (telemetry/memory.py) —
+# bundles die with their memo dicts, the census must not pin them
+_BUNDLES: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def registered_bundles() -> list:
+    """Live PendingMasks bundles (census snapshot, GC-safe)."""
+    return [b for b in (r() for r in _BUNDLES.valuerefs()) if b is not None]
 
 # Below this registry size the spec walks win (table + bitfield setup
 # costs more than a handful of tiny committees); the differential tests
@@ -132,6 +144,7 @@ class PendingMasks:
         "covered",
         "inclusion_delay",
         "inclusion_proposer",
+        "__weakref__",  # memory-observatory census membership
     )
 
 
@@ -357,6 +370,7 @@ def pending_masks_for(state, epoch: int, context) -> "PendingMasks | None":
         bundle = _build(state, epoch, atts, context, np)
     if bundle is None:
         return None
+    _BUNDLES[id(bundle)] = bundle  # census membership (weak)
     metrics.counter("committees.masks.builds").inc()
     if _device_obs.OBSERVATORY.active:
         _device_obs.route(

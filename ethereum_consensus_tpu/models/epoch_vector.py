@@ -202,6 +202,26 @@ def jitted_kernels() -> dict:
     return _JITTED_KERNELS
 
 
+def kernel_cache_census() -> "tuple[int, int]":
+    """(bytes, entries) for the memory observatory's
+    ``epoch_vector.jit_kernels`` owner (telemetry/memory.py): one entry
+    per wrapped kernel plus its executable-cache population where the
+    jax version exposes it (``_cache_size``). Bytes stay 0 — XLA does
+    not expose executable sizes, and an honest unknown beats a guess."""
+    entries = 0
+    for kernel in _JITTED_KERNELS.values():
+        entries += 1
+        probe = getattr(
+            getattr(kernel, "__wrapped__", kernel), "_cache_size", None
+        )
+        if probe is not None:
+            try:
+                entries += max(0, int(probe()) - 1)
+            except Exception:  # noqa: BLE001 — jax version drift
+                pass
+    return 0, entries
+
+
 def _disabled() -> bool:
     if os.environ.get(_DISABLE_ENV, "").lower() in ("off", "0", "false"):
         return True

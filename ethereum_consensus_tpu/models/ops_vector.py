@@ -56,6 +56,7 @@ import threading
 
 from ..ssz.core import CachedRootList, bulk_store
 from ..telemetry import device as _device_obs
+from ..telemetry import memory as _memory
 from ..telemetry import metrics
 from ..utils import trace
 
@@ -584,6 +585,12 @@ def process_attestations_batch(state, attestations, context,
     # working copies: reads and writes stay here until the single commit
     cur = cur_col.copy()
     prev = prev_col.copy()
+    if _memory.OBSERVATORY.active:
+        # bandwidth: the per-block participation working set (two full
+        # column materializations per batched block)
+        _memory.OBSERVATORY.record_copy(
+            "ops_vector.working_copies", int(cur.nbytes) + int(prev.nbytes)
+        )
 
     def commit() -> None:
         for arr, orig, lst in (

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..telemetry import device as _obs
+from ..telemetry import memory as _mem
 from ._compat import shard_map
 from .mesh import SHARD_AXIS
 
@@ -246,6 +247,12 @@ class MeshEpochSweeps:
             return np.ascontiguousarray(arr)
         out = np.full(padded, fill, dtype=arr.dtype)
         out[:n] = arr
+        # bandwidth: the mesh staging copy (the upload itself is the
+        # device observatory's h2d ledger; this is the host-side
+        # re-materialization the padding costs)
+        mem = _mem.OBSERVATORY
+        if mem.active:
+            mem.record_copy("parallel.pad_to_mesh", int(out.nbytes))
         return out
 
     def inactivity_scores(self, scores, eligible, participating, bias: int,

@@ -24,6 +24,9 @@ Telemetry exports (docs/OBSERVABILITY.md):
   flush dispatch/verify/settle windows and rollbacks visible.
 * ``--metrics-out PATH`` — dump the process-wide metrics registry
   snapshot (digests, pubkey-cache hit rates, flush shapes, ...) as JSON.
+* ``--memory-out PATH`` — run the memory & bandwidth observatory for
+  the selfcheck and write its ledgers (census/worst table, phase RSS
+  ledger, bulk-copy sites) as JSON
 * ``--device-out PATH``  — run the device execution observatory
   (``telemetry/device.py``) for the selfcheck's duration and dump its
   ledgers (compile ledger + recompile sentinel, per-site host<->device
@@ -183,6 +186,7 @@ def main(argv: "list[str]") -> int:
     trace_out = _flag_value(argv, "--trace-out")
     metrics_out = _flag_value(argv, "--metrics-out")
     device_out = _flag_value(argv, "--device-out")
+    memory_out = _flag_value(argv, "--memory-out")
     serve_port = _flag_value(argv, "--serve")
     hold_s = _flag_value(argv, "--hold")
     lanes = int(_flag_value(argv, "--lanes") or "1")
@@ -190,6 +194,7 @@ def main(argv: "list[str]") -> int:
         print(__doc__)
         return 2
     from ..telemetry import device as device_obs
+    from ..telemetry import memory as memory_obs
     from ..telemetry import metrics, spans
 
     server = None
@@ -218,6 +223,8 @@ def main(argv: "list[str]") -> int:
         spans.start_recording()
     if device_out:
         device_obs.start()
+    if memory_out:
+        memory_obs.start()
     try:
         if _find_chain_utils():
             _selfcheck_chain(lanes=lanes)
@@ -249,6 +256,15 @@ def main(argv: "list[str]") -> int:
                     device_obs.snapshot(), f, indent=1, sort_keys=True
                 )
             print(f"device ledger written: {device_out}")
+        if memory_out:
+            import json
+
+            memory_obs.stop()
+            with open(memory_out, "w", encoding="utf-8") as f:
+                json.dump(
+                    memory_obs.snapshot(), f, indent=1, sort_keys=True
+                )
+            print(f"memory ledger written: {memory_out}")
     print("selfcheck OK")
     if server is not None:
         if hold_s is not None and float(hold_s) > 0:

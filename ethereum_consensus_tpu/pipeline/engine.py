@@ -61,6 +61,7 @@ from ..error import Error
 from ..models.signature_batch import SignatureBatch, defer_flushes
 from ..models.transition import Validation
 from ..telemetry import flight as _flight
+from ..telemetry import memory as _memory
 from ..telemetry import metrics as _metrics
 from ..telemetry import phases as _phases
 from ..telemetry import spans as _spans
@@ -70,6 +71,30 @@ from .scheduler import FlushPolicy, VerifyScheduler, Window
 from .stats import PipelineStats
 
 __all__ = ["ChainPipeline", "PipelineBrokenError"]
+
+
+def _snapshot_copy(state):
+    """The serving layer's publication copy, with the memory
+    observatory's ``pipeline.snapshot_copy`` bandwidth accounting: the
+    copy's structural list traffic is attributed per list at the
+    ``ssz.state_copy`` site; this site counts the publication EVENTS
+    and their wall window so a profile shows what snapshot publication
+    costs beside what it moves. One bool read while off."""
+    obs = _memory.OBSERVATORY
+    if not obs.active:
+        return state.copy()
+    before = obs.copy_summary()["sites"].get("ssz.state_copy", {})
+    t0 = time.perf_counter()
+    snap = state.copy()
+    t1 = time.perf_counter()
+    after = obs.copy_summary()["sites"].get("ssz.state_copy", {})
+    obs.record_copy(
+        "pipeline.snapshot_copy",
+        after.get("bytes", 0) - before.get("bytes", 0),
+        t0,
+        t1,
+    )
+    return snap
 
 
 def _state_root_hex(signed_block) -> str:
@@ -415,7 +440,7 @@ class ChainPipeline:
             # later speculative applies. Deliberately NOT the checkpoint
             # object: the engine copy-shares checkpoints on failure
             # paths, which would race reader-side column syncs.
-            window.snap_state = self._executor.state.copy()
+            window.snap_state = _snapshot_copy(self._executor.state)
         self._seq += 1
         # backpressure: the bounded queue admits a new window only after
         # the oldest one settles — this wait is where an over-eager
@@ -467,7 +492,9 @@ class ChainPipeline:
             if window is None:
                 # the empty-flush path commits synchronously inside
                 # dispatch: the live state IS the committed position
-                self._publish_state(entries, self._executor.state.copy())
+                self._publish_state(
+                    entries, _snapshot_copy(self._executor.state)
+                )
             elif window.snap_state is not None:
                 self._publish_state(
                     entries, window.snap_state, seq=window.seq

@@ -32,10 +32,22 @@ cycle with ``Snapshot._lock`` or the metric locks.
 from __future__ import annotations
 
 import threading
+import weakref
 
 from ..telemetry import metrics as _metrics
 
-__all__ = ["AggregateGroup", "OperationPool", "pack_bits", "bits_to_int"]
+__all__ = ["AggregateGroup", "OperationPool", "pack_bits", "bits_to_int",
+           "registered_pools"]
+
+# every live OperationPool, for the memory observatory's ``pool.store``
+# owner census (telemetry/memory.py): bitfield-matrix bytes + held rows
+# across the process.
+_POOLS: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def registered_pools() -> list:
+    """Live OperationPool instances (census snapshot, GC-safe)."""
+    return [p for p in (r() for r in _POOLS.valuerefs()) if p is not None]
 
 # one uint64 lane holds 64 committee members; mainnet committees are
 # ~64-2048 members → 1-32 words per row
@@ -200,6 +212,7 @@ class OperationPool:
         self._max_groups = int(max_groups)
         self._max_votes = int(max_votes)
         self._seq = 0
+        _POOLS[id(self)] = self  # memory-observatory census membership
 
     # -- attestations --------------------------------------------------------
     def classify_attestation(self, key, committee_size: int, bit_list,
@@ -490,6 +503,26 @@ class OperationPool:
                     len(v) for v in self._votes.values()
                 ),
             }
+
+    def memory_census(self) -> "tuple[int, int]":
+        """(resident bytes, held aggregate rows) for the memory
+        observatory's ``pool.store`` owner: the packed bitfield
+        matrices (full allocated capacity, not just the ``[:n]`` live
+        slice — doubling growth retains the whole buffer), the
+        signature bytes, and the vote ledger's fixed-size records
+        (pointer-width estimate per record)."""
+        with self._lock:
+            nbytes = 0
+            rows = 0
+            for group in self._groups.values():
+                rows += group.n
+                bits = group.bits
+                if bits is not None:
+                    nbytes += int(bits.nbytes)
+                nbytes += sum(len(s) for s in group.signatures)
+                nbytes += len(group.masks) * 8
+            nbytes += sum(len(v) for v in self._votes.values()) * 128
+            return nbytes, rows
 
     def clear(self) -> None:
         with self._lock:

@@ -30,12 +30,25 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from ..models import ops_vector
 from ..telemetry import flight as _flight
 from ..telemetry import metrics as _metrics
 
-__all__ = ["Snapshot", "HeadStore", "DEFAULT_CAPACITY"]
+__all__ = ["Snapshot", "HeadStore", "DEFAULT_CAPACITY",
+           "registered_stores"]
+
+# every live HeadStore, for the memory observatory's
+# ``serving.snapshots`` owner census (telemetry/memory.py): snapshot
+# counts + frozen-bundle bytes across the process. WeakValueDictionary
+# keyed by id (stores die, the census must not pin them).
+_STORES: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
+
+def registered_stores() -> list:
+    """Live HeadStore instances (census snapshot, GC-safe)."""
+    return [s for s in (r() for r in _STORES.valuerefs()) if s is not None]
 
 DEFAULT_CAPACITY = 64
 
@@ -161,6 +174,7 @@ class HeadStore:
         self._by_root: dict = {}
         self._by_block_root: dict = {}  # PR 8 residue: the block-root index
         self._attached = False
+        _STORES[id(self)] = self  # memory-observatory census membership
 
     # -- lifecycle -----------------------------------------------------------
     def attach(self) -> "HeadStore":
@@ -238,6 +252,24 @@ class HeadStore:
             self._history = []
             self._by_root = {}
             self._by_block_root = {}
+
+    def memory_census(self) -> "tuple[int, int]":
+        """(resident bytes, retained snapshots) for the memory
+        observatory: the frozen column bundles' array bytes (deduped —
+        copy-on-write travel can share buffers across snapshots). The
+        state handles themselves are attributed through the SSZ list
+        census (their lists are tracked), not double-counted here."""
+        nbytes = 0
+        seen: set = set()
+        snaps = self.snapshots()
+        for snap in snaps:
+            bundle = snap._bundle
+            if isinstance(bundle, dict):
+                for arr in bundle.values():
+                    if id(arr) not in seen:
+                        seen.add(id(arr))
+                        nbytes += int(getattr(arr, "nbytes", 0))
+        return nbytes, len(snaps)
 
     # -- resolution ----------------------------------------------------------
     @property

@@ -47,6 +47,7 @@ Three hard gates fold into ``report["ok"]`` (docs/SOAK.md):
 from __future__ import annotations
 
 import json
+import os
 import random
 import time
 import urllib.request
@@ -64,11 +65,67 @@ from ..scenarios.harness import (
 )
 from ..scenarios.mutators import MUTATORS, MutationEnv, by_name, plan_storm
 from ..telemetry import flight as _flight
+from ..telemetry import memory as _memory
 from ..telemetry import metrics as _metrics
 from ..utils import trace
 from .sentinel import LeakSentinel
 
-__all__ = ["SoakConfig", "SoakRunner", "run_soak"]
+__all__ = ["SoakConfig", "SoakRunner", "run_soak", "load_profile",
+           "DEFAULT_PROFILE_PATH"]
+
+# the shipped per-deployment profile (ROADMAP soak residue → ISSUE 15):
+# the catastrophe-catcher defaults, as a FILE a deployment can copy and
+# tighten — p99 SLO bounds, RSS budget/ceiling, and the bench epoch
+# configs' memory ceilings all live here (docs/SOAK.md)
+DEFAULT_PROFILE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "profiles", "default.json"
+)
+
+
+def _parse_flat_toml(text: str) -> dict:
+    """A minimal TOML subset parser (``[section]`` + ``key = value``
+    with ints/floats/bools/quoted strings) for py3.10 boxes without
+    ``tomllib`` — exactly the shape a soak profile needs, nothing
+    more. Full TOML goes through ``tomllib`` when available."""
+    out: dict = {}
+    section = out
+    for raw_line in text.splitlines():
+        line = raw_line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = out.setdefault(line[1:-1].strip(), {})
+            continue
+        if "=" not in line:
+            raise ValueError(f"unparsable profile line: {raw_line!r}")
+        key, value = (part.strip() for part in line.split("=", 1))
+        if value.lower() in ("true", "false"):
+            section[key] = value.lower() == "true"
+        elif value.startswith(('"', "'")) and value.endswith(value[0]):
+            section[key] = value[1:-1]
+        else:
+            try:
+                section[key] = int(value)
+            except ValueError:
+                section[key] = float(value)
+    return out
+
+
+def load_profile(path: "str | None" = None) -> dict:
+    """The deployment profile document: JSON or TOML by extension
+    (``tomllib`` when the interpreter has it, the flat-subset parser
+    otherwise). ``None`` loads the shipped default profile."""
+    path = path or DEFAULT_PROFILE_PATH
+    with open(path, "rb") as f:
+        raw = f.read()
+    if path.endswith(".toml"):
+        try:
+            import tomllib  # py3.11+
+
+            return tomllib.loads(raw.decode("utf-8"))
+        except ModuleNotFoundError:
+            return _parse_flat_toml(raw.decode("utf-8"))
+    return json.loads(raw)
 
 
 class SoakConfig:
@@ -80,9 +137,10 @@ class SoakConfig:
         "validator_count", "atts_per_block", "cycles", "deadline_s",
         "min_windows", "storm_fraction", "policy", "readers",
         "sse_subscribers", "pool_spam_rounds", "equivocate_every",
-        "rss_budget_mb", "rss_warmup_cycles", "retainers", "seed",
+        "rss_budget_mb", "rss_warmup_cycles", "rss_ceiling_mb",
+        "retainers", "seed",
         "slo_verify_p99_s", "slo_settle_p99_s", "slo_gather_p99_s",
-        "mesh_faults", "check_columns_every",
+        "mesh_faults", "check_columns_every", "memory_ceilings",
     )
 
     def __init__(self, validator_count: int = 64, atts_per_block: int = 2,
@@ -96,7 +154,9 @@ class SoakConfig:
                  slo_settle_p99_s: float = 10.0,
                  slo_gather_p99_s: float = 0.25,
                  mesh_faults: "bool | None" = None,
-                 check_columns_every: int = 4):
+                 check_columns_every: int = 4,
+                 rss_ceiling_mb: "float | None" = None,
+                 memory_ceilings: "dict | None" = None):
         self.validator_count = int(validator_count)
         self.atts_per_block = int(atts_per_block)
         self.cycles = int(cycles)
@@ -124,6 +184,62 @@ class SoakConfig:
         # ECT_MESH is switched on); True/False force it for tests
         self.mesh_faults = mesh_faults
         self.check_columns_every = max(1, int(check_columns_every))
+        # per-deployment memory envelope (ISSUE 15): an ABSOLUTE peak
+        # ceiling the flat-RSS gate additionally asserts (None = growth
+        # budget only — the shipped catastrophe-catcher default), plus
+        # the bench epoch configs' ceiling table the profile carries
+        # through (bench.py reads it via load_profile)
+        self.rss_ceiling_mb = (
+            None if rss_ceiling_mb is None else float(rss_ceiling_mb)
+        )
+        self.memory_ceilings = dict(memory_ceilings or {})
+
+    @classmethod
+    def from_file(cls, path: "str | None" = None,
+                  **overrides) -> "SoakConfig":
+        """Build a config from a deployment profile (TOML or JSON —
+        ``load_profile``): ``[slo]`` p99 bounds, ``[rss]``
+        budget/warmup/ceiling, ``[load]`` traffic shape, and the
+        ``[memory_ceilings]`` table. Unknown keys raise (a typo'd bound
+        must not silently keep the catastrophe-catcher default);
+        keyword ``overrides`` win over the file."""
+        doc = load_profile(path)
+        kwargs: dict = {}
+        slo = doc.get("slo", {})
+        for key, kw in (("verify_p99_s", "slo_verify_p99_s"),
+                        ("settle_p99_s", "slo_settle_p99_s"),
+                        ("gather_p99_s", "slo_gather_p99_s")):
+            if key in slo:
+                kwargs[kw] = float(slo[key])
+        rss = doc.get("rss", {})
+        for key, kw in (("budget_mb", "rss_budget_mb"),
+                        ("warmup_cycles", "rss_warmup_cycles"),
+                        ("ceiling_mb", "rss_ceiling_mb")):
+            if key in rss and rss[key] is not None:
+                kwargs[kw] = rss[key]
+        load = doc.get("load", {})
+        allowed_load = {
+            "validator_count", "atts_per_block", "cycles", "deadline_s",
+            "min_windows", "storm_fraction", "readers", "sse_subscribers",
+            "pool_spam_rounds", "equivocate_every", "seed",
+            "check_columns_every",
+        }
+        unknown = set(load) - allowed_load
+        if unknown:
+            raise ValueError(
+                f"unknown [load] profile keys: {sorted(unknown)}"
+            )
+        kwargs.update(load)
+        if "memory_ceilings" in doc:
+            kwargs["memory_ceilings"] = dict(doc["memory_ceilings"])
+        unknown_sections = set(doc) - {"slo", "rss", "load",
+                                       "memory_ceilings", "name", "notes"}
+        if unknown_sections:
+            raise ValueError(
+                f"unknown profile sections: {sorted(unknown_sections)}"
+            )
+        kwargs.update(overrides)
+        return cls(**kwargs)
 
 
 class _SSESubscriber:
@@ -409,14 +525,24 @@ class SoakRunner:
         eq_engine = AdmissionEngine(eq_pool, store, ctx, window_size=8)
         eq_schedule: list = []
 
-        sentinel.watch("flight_ring", lambda: len(_flight.RECORDER),
-                       bound=_flight.RECORDER.capacity)
-        sentinel.watch("serving_snapshots", lambda: len(store), bound=64)
-        sentinel.watch(
-            "pool_rows",
-            lambda: eq_pool.counts()["attestation_rows"],
-            bound=4096,
+        # the census reads come from the memory observatory's registry —
+        # ONE census implementation (ISSUE 15): the process-wide owners
+        # for the ring and the serving history, plus a run-local owner
+        # for this soak's equivocation pool (registered here, dropped in
+        # the finally — the process-wide "pool.store" owner would also
+        # count the spammer's hostile-gossip pool)
+        _memory.register_owner(
+            "soak.eq_pool", lambda: eq_pool.memory_census()
         )
+        _memory.register_owner(
+            "soak.headstore", lambda: store.memory_census()
+        )
+        sentinel.watch_owner("flight_ring",
+                             bound=_flight.RECORDER.capacity,
+                             owner="flight.ring")
+        sentinel.watch_owner("serving_snapshots", bound=64,
+                             owner="soak.headstore")
+        sentinel.watch_owner("pool_rows", bound=4096, owner="soak.eq_pool")
 
         metrics_base = _metrics.snapshot()
         report: dict = {"config": {
@@ -496,6 +622,10 @@ class SoakRunner:
             # process-wide commit hook subscribed or the server running
             store.detach()
             server.stop()
+            # the run-local census owners die with the run (samples
+            # already recorded their values; the gate reads samples)
+            _memory.OBSERVATORY.unregister_owner("soak.eq_pool")
+            _memory.OBSERVATORY.unregister_owner("soak.headstore")
 
         wall_s = time.perf_counter() - t0
         delta = _metrics.delta(metrics_base)
@@ -520,7 +650,8 @@ class SoakRunner:
 
         # -- gate 2: flat RSS -------------------------------------------------
         rss = sentinel.gate(config.rss_budget_mb,
-                            warmup=config.rss_warmup_cycles)
+                            warmup=config.rss_warmup_cycles,
+                            ceiling_mb=config.rss_ceiling_mb)
 
         windows = delta.get("pipeline.flushes", 0)
         blocks_committed = delta.get("pipeline.blocks_committed", 0)

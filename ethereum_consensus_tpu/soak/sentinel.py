@@ -11,6 +11,16 @@ every leak eventually moves — process RSS — across the soak's cycles,
 plus an explicit census of the bounded structures so a tripped gate
 names its suspect instead of just "memory grew".
 
+Since ISSUE 15 the census itself lives in the memory observatory
+(``telemetry/memory.py``) — ONE census implementation for the soak
+gate, the ``/memory`` endpoint, and the bench ``mem`` evidence blocks.
+``watch_owner(name, bound)`` reads a registered owner's entry count
+from the observatory registry; the plain ``watch(name, fn, bound)``
+seam stays for run-local structures (and the trip tests), and the
+sentinel keeps its trip/fail-closed verdict semantics unchanged: a
+failing or unknown owner probe reports -1, which the bound check
+rejects — a broken census can never pass silently.
+
 Gate semantics (``LeakSentinel.gate``):
 
 * samples during the ``warmup`` cycles are recorded but EXCLUDED from
@@ -32,23 +42,17 @@ from __future__ import annotations
 
 import threading
 
+from ..telemetry import memory as _memory
+
 __all__ = ["LeakSentinel", "rss_mb"]
 
 
 def rss_mb() -> float:
-    """Current process resident set in MiB (/proc on Linux, ru_maxrss
-    peak as the degraded fallback elsewhere — the gate still bounds
-    growth, just of the high-watermark)."""
-    try:
-        with open("/proc/self/status") as f:
-            for line in f:
-                if line.startswith("VmRSS:"):
-                    return int(line.split()[1]) / 1024.0
-    except OSError:
-        pass
-    import resource
-
-    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    """Current process resident set in MiB — the memory observatory's
+    reader (one implementation; /proc statm on Linux, ru_maxrss peak as
+    the degraded fallback elsewhere — the gate still bounds growth,
+    just of the high-watermark)."""
+    return _memory.rss_mb()
 
 
 class LeakSentinel:
@@ -73,6 +77,19 @@ class LeakSentinel:
             self._watches.append((name, fn, bound))
         return self
 
+    def watch_owner(self, name: str, bound: "int | None" = None,
+                    owner: "str | None" = None) -> "LeakSentinel":
+        """Watch a memory-observatory owner's ENTRY count (the one
+        census implementation — telemetry/memory.py): ``owner`` is the
+        registry name (defaults to ``name``). An unknown owner or a
+        failing probe reads -1, which a bound check rejects — the
+        fail-closed contract."""
+        owner_name = owner or name
+        return self.watch(
+            name, lambda: _memory.OBSERVATORY.owner_entries(owner_name),
+            bound,
+        )
+
     def sample(self, label) -> float:
         """Take one sample; returns the RSS read (MiB)."""
         census = {}
@@ -92,10 +109,14 @@ class LeakSentinel:
         with self._lock:
             return list(self._samples)
 
-    def gate(self, budget_mb: float, warmup: int = 2) -> dict:
+    def gate(self, budget_mb: float, warmup: int = 2,
+             ceiling_mb: "float | None" = None) -> dict:
         """The flat-RSS verdict over the recorded samples (see module
         docstring for semantics). Returns a JSON-ready dict with ``ok``
-        plus the evidence a tripped gate needs to be debugged."""
+        plus the evidence a tripped gate needs to be debugged.
+        ``ceiling_mb`` (per-deployment profile, docs/SOAK.md) bounds
+        the ABSOLUTE process high-water mark on top of the growth
+        budget — a deployment that knows its envelope can assert it."""
         with self._lock:
             samples = list(self._samples)
             watches = list(self._watches)
@@ -127,8 +148,16 @@ class LeakSentinel:
                 "ok": bounded,
             }
             census_ok = census_ok and bounded
+        ceiling_ok = True
+        peak_mb = _memory.peak_rss_mb()
+        if ceiling_mb is not None:
+            ceiling_ok = peak_mb <= float(ceiling_mb)
+            verdict.update(
+                ceiling_mb=float(ceiling_mb), peak_mb=round(peak_mb, 1),
+                ceiling_ok=ceiling_ok,
+            )
         verdict.update(
-            ok=bool(growth <= budget_mb and census_ok),
+            ok=bool(growth <= budget_mb and census_ok and ceiling_ok),
             baseline_mb=round(baseline, 1),
             final_mb=round(final, 1),
             growth_mb=round(growth, 1),

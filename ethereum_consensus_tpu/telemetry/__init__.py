@@ -20,6 +20,10 @@ pure-host ``ssz``/``crypto`` paths — nothing here touches jax):
   recompile sentinel, host<->device transfer ledger, and the
   device-vs-host routing journal, recorded at the repo's JAX/XLA seams
   (stdlib-only here; jax stays at the instrumented call sites).
+* ``memory``  — the memory & bandwidth observatory: resident-set
+  census of the repo's byte owners, phase RSS/allocation ledger
+  bracketing the transition/epoch spans, and per-site bulk-copy byte
+  counters at the SSZ/pipeline/mesh chokepoints.
 * ``server``  — the live introspection server (``/metrics`` Prometheus
   exposition, ``/healthz``, ``/blocks``, ``/events`` SSE). NOT imported
   here: it pulls in ``http.server``, which no pure-compute layer needs
@@ -32,5 +36,8 @@ from __future__ import annotations
 
 from . import flight, metrics, phases, spans
 from . import device  # noqa: E402 — after spans/metrics (its imports)
+from . import memory  # noqa: E402 — after spans/metrics (its imports)
 
-__all__ = ["device", "flight", "metrics", "phases", "spans", "server"]
+__all__ = [
+    "device", "flight", "memory", "metrics", "phases", "spans", "server",
+]

@@ -28,6 +28,11 @@ on instrumented ground:
   per-site host<->device transfer aggregates, the device-vs-host
   routing journal, and the persistent XLA cache state. ``?n=`` caps the
   journal tails.
+* ``/memory``   — the memory & bandwidth observatory's ledgers
+  (telemetry/memory.py): the resident-set census with its ``worst``
+  attribution table, the phase RSS ledger, and the per-site bulk-copy
+  byte counters. ``?n=`` caps the worst table. The census probes run
+  at request time — a scrape IS a census.
 
 ``/metrics`` additionally carries a standard ``build_info`` gauge (git
 sha, jax/numpy versions, x64 flag, backend platform as labels, value 1)
@@ -59,6 +64,7 @@ from urllib.parse import parse_qs, urlparse
 
 from . import device as _device
 from . import flight as _flight
+from . import memory as _memory
 from . import metrics as _metrics
 
 __all__ = [
@@ -374,6 +380,14 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": "?n= must be an int"}, 400)
                     return
                 self._send_json(_device.OBSERVATORY.snapshot(journal_n=n))
+            elif route == "/memory":
+                params = self._query()
+                try:
+                    n = int(self._param(params, "n", "12"))
+                except ValueError:
+                    self._send_json({"error": "?n= must be an int"}, 400)
+                    return
+                self._send_json(_memory.OBSERVATORY.snapshot(worst_n=n))
             elif route == "/events":
                 self._serve_events()
             elif route == "/":
@@ -387,6 +401,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "/blocks",
                             "/events",
                             "/device",
+                            "/memory",
                         ]
                         + [app.prefix + "..." for app in apps],
                         "apps": [type(app).__name__ for app in apps],

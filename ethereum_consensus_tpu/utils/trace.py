@@ -32,6 +32,7 @@ import threading
 import time
 from contextlib import contextmanager
 
+from ..telemetry import memory as _memory
 from ..telemetry import spans as _spans
 
 __all__ = ["logger", "span", "event", "basic_setup"]
@@ -40,6 +41,7 @@ logger = logging.getLogger("ethereum_consensus_tpu")
 logger.addHandler(logging.NullHandler())
 
 _RECORDER = _spans.RECORDER
+_MEMORY = _memory.OBSERVATORY
 
 
 def _fmt_fields(fields: dict) -> str:
@@ -52,7 +54,11 @@ def span(name: str, **fields):
     (DEBUG on enter, INFO with elapsed ms on exit, ERROR with the
     exception if the body raises) and, while recording, the telemetry
     span recorder (thread lane, parent span, wall window, fields)."""
-    if not (_RECORDER.enabled or logger.isEnabledFor(logging.INFO)):
+    if not (
+        _RECORDER.enabled
+        or _MEMORY.active
+        or logger.isEnabledFor(logging.INFO)
+    ):
         # disabled fast path: no sink wants enter/exit; keep only the
         # error log the always-on path would emit
         start = time.perf_counter()
@@ -67,6 +73,10 @@ def span(name: str, **fields):
             raise
         return
     rec = _RECORDER.begin(name, fields) if _RECORDER.enabled else None
+    # the memory observatory brackets the transition/epoch phase spans
+    # into its RSS ledger (telemetry/memory.py PHASE_PREFIXES); every
+    # other span costs it one prefix check
+    mem = _MEMORY.phase_begin(name) if _MEMORY.active else None
     if logger.isEnabledFor(logging.DEBUG):
         logger.debug("enter %s %s", name, _fmt_fields(fields))
     start = time.perf_counter()
@@ -80,6 +90,8 @@ def span(name: str, **fields):
         )
         if rec is not None:
             _RECORDER.end(rec, error=repr(exc))
+        if mem is not None:
+            _MEMORY.phase_end(name, mem)
         raise
     else:
         if logger.isEnabledFor(logging.INFO):
@@ -89,6 +101,8 @@ def span(name: str, **fields):
             )
         if rec is not None:
             _RECORDER.end(rec)
+        if mem is not None:
+            _MEMORY.phase_end(name, mem)
 
 
 def event(name: str, **fields) -> None:
