@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
+.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke proofs-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
 
 PROFILE_DIR ?= profile_artifacts
 
@@ -30,14 +30,17 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke + the memory-observatory smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py tests/test_memory_observatory.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke or mem_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke + the memory-observatory smoke + the proof-plane smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py tests/test_memory_observatory.py tests/test_proofs.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke or mem_smoke or proofs_smoke'
 
 mesh-smoke:  ## 2-device virtual mesh: one sharded epoch pass + one sharded RLC flush window, bit-identical to host
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_runtime.py -q -m mesh_smoke
 
 mem-smoke:  ## memory observatory: one 2^14 epoch under the observatory — phase ledger bracketing, >=3 census owners, bandwidth at bulk_store, profile ceiling asserted
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_memory_observatory.py -q -m mem_smoke
+
+proofs-smoke:  ## proof plane: one warm walk — branches + a multiproof byte-identical to the cold prove walk, zero declines/fallbacks
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_proofs.py -q -m proofs_smoke
 
 chaos:  ## fast scenario smoke: one short invalid-block storm + one fork-boundary chain (minutes)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_scenarios.py -q -m chaos_smoke

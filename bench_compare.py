@@ -72,6 +72,13 @@ _TREND_HEADLINE = (
     "single_validator_qps",
     "batch_1k_qps",
     "committee_slot_qps",
+    # the proof plane's trend axes (ISSUE 17): warm single / batched
+    # multiproof / cold-walk proofs/s and the warm advantage — the
+    # stateless-serving throughput story
+    "proofs_per_s_warm",
+    "proofs_per_s_batched",
+    "proofs_per_s_cold",
+    "warm_vs_cold_speedup",
     # the device observatory's evidence axes (ISSUE 10): compile seconds
     # and counts, the recompile sentinel, transfer volume, route split
     "device.compile_s",

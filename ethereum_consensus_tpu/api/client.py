@@ -201,6 +201,16 @@ class Client:
             32,
         )
 
+    def get_state_proof(self, state_id: StateId | str, gindices) -> dict:
+        """Merkle proof(s) against the state's hash tree root: one
+        ``gindex`` yields a single branch document (``gindex``/``leaf``/
+        ``proof``), several yield the spec multiproof layout
+        (``gindices``/``leaves``/``proof``) — docs/PROOFS.md."""
+        params = {"gindex": ",".join(str(int(g)) for g in gindices)}
+        return self.get(
+            f"eth/v1/beacon/states/{StateId(state_id)}/proof", params
+        )
+
     def get_beacon_header_at_head(self) -> BeaconHeaderSummary:
         """(api_client.rs:279)"""
         return self.get_beacon_header(BlockId.HEAD)

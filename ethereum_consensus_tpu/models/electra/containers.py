@@ -29,11 +29,28 @@ from ...primitives import (
     WithdrawalIndex,
 )
 from ...ssz import Bitlist, Bitvector, ByteList, ByteVector, Container, List, Vector, uint8, uint64
-from ..capella.containers import SignedBlsToExecutionChange, Withdrawal
+from ..capella.containers import (
+    EXECUTION_PAYLOAD_INDEX_FLOOR_LOG_2,
+    SignedBlsToExecutionChange,
+    Withdrawal,
+)
 from ..deneb import containers as deneb_containers
 from ..phase0 import containers as phase0_containers
 
+# EIP-7251 grows BeaconState to 37 fields, so the state tree deepens from
+# 5 to 6 levels and every light-client branch grows by one node:
+# finalized_checkpoint.root moves to gindex 169, the sync committees to
+# 86/87 (spec: *_GINDEX_ELECTRA).  The altair constants deneb inherits
+# (6/5/5) are one short here — electra redeclares its LightClient
+# containers below with these widths.
+FINALIZED_ROOT_INDEX_FLOOR_LOG_2 = 7
+CURRENT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2 = 6
+NEXT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2 = 6
+
 __all__ = [
+    "FINALIZED_ROOT_INDEX_FLOOR_LOG_2",
+    "CURRENT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2",
+    "NEXT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2",
     "DepositReceipt",
     "PendingBalanceDeposit",
     "PendingPartialWithdrawal",
@@ -289,6 +306,41 @@ def build(preset: Preset) -> SimpleNamespace:
             PendingConsolidation, pe.PENDING_CONSOLIDATIONS_LIMIT
         ]
 
+    class LightClientHeader(Container):
+        beacon: phase0_containers.BeaconBlockHeader
+        execution: ExecutionPayloadHeader
+        execution_branch: Vector[Bytes32, EXECUTION_PAYLOAD_INDEX_FLOOR_LOG_2]
+
+    class LightClientBootstrap(Container):
+        header: LightClientHeader
+        current_sync_committee: base.SyncCommittee
+        current_sync_committee_branch: Vector[
+            Bytes32, CURRENT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2
+        ]
+
+    class LightClientUpdate(Container):
+        attested_header: LightClientHeader
+        next_sync_committee: base.SyncCommittee
+        next_sync_committee_branch: Vector[
+            Bytes32, NEXT_SYNC_COMMITTEE_INDEX_FLOOR_LOG_2
+        ]
+        finalized_header: LightClientHeader
+        finality_branch: Vector[Bytes32, FINALIZED_ROOT_INDEX_FLOOR_LOG_2]
+        sync_aggregate: base.SyncAggregate
+        signature_slot: Slot
+
+    class LightClientFinalityUpdate(Container):
+        attested_header: LightClientHeader
+        finalized_header: LightClientHeader
+        finality_branch: Vector[Bytes32, FINALIZED_ROOT_INDEX_FLOOR_LOG_2]
+        sync_aggregate: base.SyncAggregate
+        signature_slot: Slot
+
+    class LightClientOptimisticUpdate(Container):
+        attested_header: LightClientHeader
+        sync_aggregate: base.SyncAggregate
+        signature_slot: Slot
+
     ns = SimpleNamespace(**vars(base))
     ns.preset = preset
     ns.DepositReceipt = DepositReceipt
@@ -310,4 +362,9 @@ def build(preset: Preset) -> SimpleNamespace:
     ns.BlindedBeaconBlock = BlindedBeaconBlock
     ns.SignedBlindedBeaconBlock = SignedBlindedBeaconBlock
     ns.BeaconState = BeaconState
+    ns.LightClientHeader = LightClientHeader
+    ns.LightClientBootstrap = LightClientBootstrap
+    ns.LightClientUpdate = LightClientUpdate
+    ns.LightClientFinalityUpdate = LightClientFinalityUpdate
+    ns.LightClientOptimisticUpdate = LightClientOptimisticUpdate
     return ns

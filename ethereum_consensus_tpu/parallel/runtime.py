@@ -2,14 +2,17 @@
 
 The virtual 8-device dryrun (``__graft_entry__.dryrun_multichip``)
 proved every layer shards; this module is the PRODUCTION switch that
-routes the three hot paths through the 1-D ``shard`` mesh:
+routes the hot paths through the 1-D ``shard`` mesh:
 
 * the columnar epoch sweeps (models/epoch_vector.py → parallel/epoch.py
   ``MeshEpochSweeps``: row-sharded kernels + psum reductions),
 * the RLC flush windows of the pipeline and the operation pool
   (crypto/bls.py → parallel/pairing.py ``batch_verify_sharded``),
 * large ``hash_tree_root`` rebuilds (ssz/merkle.py's mesh hook →
-  parallel/merkle.py ``sharded_merkleize_chunks``).
+  parallel/merkle.py ``sharded_merkleize_chunks``),
+* batched multiproof extraction (proofs/multiproof.py's columnar
+  dirty-group rebuild behind the ``proof_gather`` gate — the hash
+  passes themselves ride the installed merkle hook).
 
 ``ECT_MESH=N`` provisions a mesh over the first N devices (N=1 is legal
 — it exercises the sharded code paths on one device), ``ECT_MESH=auto``
@@ -21,7 +24,7 @@ virtual_mesh.py seam): a multi-core box is a mesh, no chip required.
 
 Observability contract (the PR 10 observatory, telemetry/device.py):
 every routing decision is journal-visible — engages bump ``mesh.engage``
-and journal ``mesh.{epoch,pairing,merkle}``/``device`` entries with the
+and journal ``mesh.{epoch,pairing,merkle,proofs}``/``device`` entries with the
 device count and per-device work split; EVERY decline bumps
 ``mesh.decline.{reason}`` and fires a one-shot ``mesh.decline`` trace
 event carrying the device-count/threshold inputs (the
@@ -47,8 +50,10 @@ __all__ = [
     "MESH_ENV",
     "EPOCH_MIN_ENV",
     "MERKLE_MIN_ENV",
+    "PROOF_MIN_ENV",
     "DEFAULT_EPOCH_MIN_N",
     "DEFAULT_MERKLE_MIN_CHUNKS",
+    "DEFAULT_PROOF_MIN_CHUNKS",
     "MeshFaultInjected",
     "requested",
     "mesh",
@@ -56,6 +61,7 @@ __all__ = [
     "status",
     "epoch_sweeps",
     "pairing_mesh",
+    "proof_gather",
     "install_fault_hook",
     "fault_point",
     "reset",
@@ -64,11 +70,15 @@ __all__ = [
 MESH_ENV = "ECT_MESH"
 EPOCH_MIN_ENV = "ECT_MESH_EPOCH_MIN_N"
 MERKLE_MIN_ENV = "ECT_MESH_MERKLE_MIN_CHUNKS"
+PROOF_MIN_ENV = "ECT_MESH_PROOF_MIN_CHUNKS"
 
 # crossover defaults, matching the ops.install sweep thresholds: below
 # these sizes the dispatch + padding overhead loses to the host path
 DEFAULT_EPOCH_MIN_N = 1 << 17
 DEFAULT_MERKLE_MIN_CHUNKS = 1 << 15
+# batched proof extraction: total chunks across the dirty-group rebuild
+# jobs a multiproof plans; below this the per-group lazy Trees win
+DEFAULT_PROOF_MIN_CHUNKS = 1 << 14
 
 _LOCK = threading.Lock()
 # provisioning outcome, written once under _LOCK then read lock-free:
@@ -330,6 +340,35 @@ def pairing_mesh(n_sets: int):
         sets=n_sets,
         devices=n_dev,
         sets_per_device=-(-n_sets // n_dev),
+    )
+    return m
+
+
+def proof_gather(n_chunks: int):
+    """The mesh for one multiproof's columnar group rebuild, or None
+    (caller builds lazy per-group Trees on the host). ``n_chunks`` is
+    the TOTAL chunk count across the planned group jobs — the columnar
+    build concatenates them into one buffer whose ``hash_level`` passes
+    ride the installed device hasher, so the threshold gates on the
+    aggregate, not per group."""
+    if not requested():
+        return None
+    m, reason = _provision()
+    if m is None:
+        _decline("proofs", reason, chunks=n_chunks)
+        return None
+    threshold = _threshold(PROOF_MIN_ENV, DEFAULT_PROOF_MIN_CHUNKS)
+    if n_chunks < threshold:
+        _decline(
+            "proofs", "below_threshold",
+            chunks=n_chunks, threshold=threshold,
+            devices=int(m.devices.size),
+        )
+        return None
+    _engage(
+        "proofs",
+        chunks=n_chunks,
+        devices=int(m.devices.size),
     )
     return m
 

@@ -388,6 +388,12 @@ class ChainPipeline:
                 "slot": last.slot,
                 "root": _state_root_hex(last.signed_block),
                 "block_root": _block_root_hex(last.signed_block),
+                # the committed signed block itself: the light-client
+                # plane (proofs/light_client.py) reads sync_aggregate/
+                # signature_slot from it and proves execution_branch
+                # over its body — a reference, already immutable after
+                # commit, so the channel stays copy-free
+                "block": last.signed_block,
                 "seq": seq,
             }
         )

@@ -55,6 +55,11 @@ class BeaconDataPlane:
         "GET  /eth/v1/beacon/states/{state_id}/committees?epoch=&index=&slot=",
         "GET  /eth/v1/beacon/states/{state_id}/sync_committees?epoch=",
         "GET  /eth/v1/beacon/states/{state_id}/epoch_rewards",
+        "GET  /eth/v1/beacon/states/{state_id}/proof?gindex=",
+        "GET  /eth/v1/beacon/light_client/bootstrap/{block_root}",
+        "GET  /eth/v1/beacon/light_client/updates?start_period=&count=",
+        "GET  /eth/v1/beacon/light_client/finality_update",
+        "GET  /eth/v1/beacon/light_client/optimistic_update",
         "POST /eth/v1/validator/duties/attester/{epoch}",
         "GET  /eth/v1/validator/duties/proposer/{epoch}",
     )
@@ -123,6 +128,8 @@ class BeaconDataPlane:
                 return "genesis", self._genesis()
             if len(parts) >= 6 and parts[3] == "states":
                 return self._dispatch_state(method, parts[4], parts[5:], params)
+            if parts[3] == "light_client" and method == "GET":
+                return self._dispatch_light_client(parts[4:], params)
         if parts[1:4] == ["v1", "validator", "duties"] and len(parts) == 6:
             if parts[4] == "attester" and method == "POST":
                 return "duties_attester", self._attester_duties(
@@ -155,7 +162,22 @@ class BeaconDataPlane:
             return "sync_committees", self._sync_committees(state_id, params)
         if rest == ["epoch_rewards"]:
             return "rewards", self._epoch_rewards(state_id)
+        if rest == ["proof"]:
+            return "proof", self._state_proof(state_id, params)
         raise _NotFound(f"no data-plane route GET states/{'/'.join(rest)}")
+
+    def _dispatch_light_client(self, rest, params):
+        if len(rest) == 2 and rest[0] == "bootstrap":
+            return "lc_bootstrap", self._lc_bootstrap(rest[1])
+        if rest == ["updates"]:
+            return "lc_updates", self._lc_updates(params)
+        if rest == ["finality_update"]:
+            return "lc_finality", self._lc_finality_update()
+        if rest == ["optimistic_update"]:
+            return "lc_optimistic", self._lc_optimistic_update()
+        raise _NotFound(
+            f"no data-plane route GET light_client/{'/'.join(rest)}"
+        )
 
     # -- scalar-metadata endpoints -------------------------------------------
     def _genesis(self):
@@ -378,6 +400,112 @@ class BeaconDataPlane:
             return doc
 
         return 200, self._envelope(snap, snap.memo(("rewards",), build))
+
+    # -- proof & light-client plane (docs/PROOFS.md) -------------------------
+    def _proof_ctx(self, snap):
+        """One warm walker per snapshot: the settle inside ProofContext is
+        a no-op once the snapshot's root has been computed, and the memo
+        makes every proof request off this snapshot share the lazily
+        built layer providers."""
+        from ..proofs import ProofContext
+
+        return snap.memo(
+            ("proof_ctx",), lambda: ProofContext(type(snap.raw), snap.raw)
+        )
+
+    def _state_proof(self, state_id, params):
+        snap = self._resolve(state_id)
+        raw = self._list_param(params, "gindex")
+        if not raw:
+            raise oracle.BadRequest("proof requires at least one gindex=")
+        try:
+            gindices = sorted({int(g) for g in raw})
+        except ValueError:
+            raise oracle.BadRequest(f"gindex must be integers, got {raw!r}")
+        if any(g < 1 for g in gindices):
+            raise oracle.BadRequest("gindex must be >= 1")
+
+        # fetched OUTSIDE the proof-document memo below: snap.memo's
+        # lock is not reentrant, so the nested ("proof_ctx",) memo must
+        # resolve first, not from inside build()
+        ctx = self._proof_ctx(snap)
+
+        def build():
+            if len(gindices) == 1:
+                gi = gindices[0]
+                return {
+                    "gindex": str(gi),
+                    "leaf": "0x" + ctx.node_at(gi).hex(),
+                    "proof": ["0x" + node.hex() for node in ctx.proof(gi)],
+                }
+            from ..proofs import extract_multiproof
+
+            mp = extract_multiproof(ctx, gindices=gindices)
+            return {
+                "gindices": [str(g) for g in mp.gindices],
+                "leaves": ["0x" + leaf.hex() for leaf in mp.leaves],
+                "proof": ["0x" + node.hex() for node in mp.proof],
+            }
+
+        doc = snap.memo(("proof", tuple(gindices)), build)
+        return 200, self._envelope(snap, doc)
+
+    def _lc_bootstrap(self, block_root):
+        from ..proofs import light_client as lc
+
+        snap = self._resolve(block_root)
+        doc, fork = snap.memo(
+            ("lc_bootstrap",), lambda: lc.light_client_bootstrap(snap)
+        )
+        return 200, self._envelope(
+            snap, type(doc).to_json(doc), extra={"version": fork}
+        )
+
+    def _lc_updates(self, params):
+        from ..proofs import light_client as lc
+
+        start = self._param(params, "start_period")
+        count = self._param(params, "count")
+        if start is None or count is None:
+            raise oracle.BadRequest(
+                "updates requires start_period= and count="
+            )
+        pairs = lc.light_client_updates(self.store, int(start), int(count))
+        # spec wire shape: a bare list of {version, data} — no envelope
+        return 200, [
+            {"version": fork, "data": type(doc).to_json(doc)}
+            for doc, fork in pairs
+        ]
+
+    def _lc_finality_update(self):
+        from ..proofs import light_client as lc
+
+        snap = self._resolve("head")
+        try:
+            doc, fork = snap.memo(
+                ("lc_finality",),
+                lambda: lc.light_client_finality_update(self.store, snap),
+            )
+        except LookupError as exc:
+            raise _NotFound(str(exc))
+        return 200, self._envelope(
+            snap, type(doc).to_json(doc), extra={"version": fork}
+        )
+
+    def _lc_optimistic_update(self):
+        from ..proofs import light_client as lc
+
+        snap = self._resolve("head")
+        try:
+            doc, fork = snap.memo(
+                ("lc_optimistic",),
+                lambda: lc.light_client_optimistic_update(self.store, snap),
+            )
+        except LookupError as exc:
+            raise _NotFound(str(exc))
+        return 200, self._envelope(
+            snap, type(doc).to_json(doc), extra={"version": fork}
+        )
 
 
 class _NotFound(Exception):

@@ -78,6 +78,7 @@ class Snapshot:
         "slot",
         "root",
         "block_root",
+        "block",
         "fork",
         "seq",
         "published_at",
@@ -88,7 +89,7 @@ class Snapshot:
     )
 
     def __init__(self, state, context, slot: int, root: bytes, seq=None,
-                 block_root: "bytes | None" = None):
+                 block_root: "bytes | None" = None, block=None):
         self.state = state
         self.raw = getattr(state, "data", state)
         self.context = context
@@ -99,6 +100,11 @@ class Snapshot:
 
             block_root = _oracle.head_block_root(self.raw)
         self.block_root = bytes(block_root)
+        # the committed SignedBeaconBlock (pipeline publishes carry it
+        # since the proof plane landed; None for pipeline-less publishes
+        # that don't pass one): the light-client endpoints read its
+        # sync_aggregate and prove execution_branch over its body
+        self.block = block
         version = getattr(state, "version", None)
         self.fork = version().name.lower() if version is not None else None
         self.seq = seq
@@ -214,21 +220,24 @@ class HeadStore:
                 bytes.fromhex(root[2:] if root.startswith("0x") else root),
                 seq=payload.get("seq"),
                 block_root=block_root,
+                block=payload.get("block"),
             )
         )
 
     def publish(self, state, context, slot=None, root=None, seq=None,
-                block_root=None):
+                block_root=None, block=None):
         """Directly publish ``state`` (NOT copied — hand the store a
         state nothing else will mutate). Root/slot/block root computed
-        from the state when omitted."""
+        from the state when omitted; pass ``block`` (the committed
+        SignedBeaconBlock) to enable the light-client endpoints that
+        need a sync aggregate or an execution branch."""
         raw = getattr(state, "data", state)
         if root is None:
             root = type(raw).hash_tree_root(raw)
         if slot is None:
             slot = int(raw.slot)
         snap = Snapshot(state, context, slot, root, seq=seq,
-                        block_root=block_root)
+                        block_root=block_root, block=block)
         self._install(snap)
         return snap
 

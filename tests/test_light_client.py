@@ -1,0 +1,382 @@
+"""Light-client plane (proofs/light_client.py + the serving endpoints):
+container golden vectors altair→electra (the pins that caught electra's
+inherited-depth drift), per-fork production off the five-boundary
+upgrade chain with every branch verified against the proper root, and
+client↔server round-trips asserting byte-equality with the in-process
+oracle (docs/PROOFS.md, docs/SERVING.md).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import chain_utils  # noqa: E402
+
+from ethereum_consensus_tpu.api.client import Client  # noqa: E402
+from ethereum_consensus_tpu.api.errors import ApiError  # noqa: E402
+from ethereum_consensus_tpu.config.presets import MINIMAL  # noqa: E402
+from ethereum_consensus_tpu.executor import Executor  # noqa: E402
+from ethereum_consensus_tpu.fork import Fork  # noqa: E402
+from ethereum_consensus_tpu.proofs import light_client as lc  # noqa: E402
+from ethereum_consensus_tpu.serving import (  # noqa: E402
+    BeaconDataPlane,
+    HeadStore,
+)
+from ethereum_consensus_tpu.ssz import core as ssz_core  # noqa: E402
+from ethereum_consensus_tpu.ssz.merkle import (  # noqa: E402
+    is_valid_merkle_branch_for_generalized_index,
+)
+from ethereum_consensus_tpu.telemetry.server import (  # noqa: E402
+    IntrospectionServer,
+)
+from ethereum_consensus_tpu.types import fork_module  # noqa: E402
+
+LC_FORKS = ("altair", "bellatrix", "capella", "deneb", "electra")
+_NAMES = (
+    "LightClientHeader",
+    "LightClientBootstrap",
+    "LightClientUpdate",
+    "LightClientFinalityUpdate",
+    "LightClientOptimisticUpdate",
+)
+
+# (hash_tree_root hex, serialized length) of each DEFAULT container on
+# the minimal preset. The length is the discriminating pin: zero-filled
+# branch vectors of depth 5 and 6 both pad to the same 8-wide zero tree
+# (identical roots), but each extra branch step is +32 serialized bytes
+# — these lengths are what the electra depth fix changes (finality 7,
+# sync committees 6, vs the deneb values 6/5 electra first inherited).
+_GOLDEN = {
+    "altair": {
+        "LightClientHeader": ("c78009fdf07fc56a11f122370658a353aaa542ed63e44c4bc15ff4cd105ab33c", 112),
+        "LightClientBootstrap": ("7b7ed090646bbb9dd5521b5559ec077348ea0ed635ee3e71a6c9189a18b6f157", 1856),
+        "LightClientUpdate": ("cdb91a2f8b9eecb741347e46702cc624389b0b66a8e461207fc6dee1bdde5cc7", 2268),
+        "LightClientFinalityUpdate": ("c3f97850953a806c68fce4a49dfd1a4a8838fe72b5ace9e33e9f7c5ac14e6acb", 524),
+        "LightClientOptimisticUpdate": ("e968d1623d0a3faece78aa975b914549c0926225d462f2dccf452ea7cafc70ce", 220),
+    },
+    "bellatrix": {
+        "LightClientHeader": ("c78009fdf07fc56a11f122370658a353aaa542ed63e44c4bc15ff4cd105ab33c", 112),
+        "LightClientBootstrap": ("7b7ed090646bbb9dd5521b5559ec077348ea0ed635ee3e71a6c9189a18b6f157", 1856),
+        "LightClientUpdate": ("cdb91a2f8b9eecb741347e46702cc624389b0b66a8e461207fc6dee1bdde5cc7", 2268),
+        "LightClientFinalityUpdate": ("c3f97850953a806c68fce4a49dfd1a4a8838fe72b5ace9e33e9f7c5ac14e6acb", 524),
+        "LightClientOptimisticUpdate": ("e968d1623d0a3faece78aa975b914549c0926225d462f2dccf452ea7cafc70ce", 220),
+    },
+    "capella": {
+        "LightClientHeader": ("a702b18201ed77345c36793f0c97e4fe529183806af63610745cb335064e65ec", 812),
+        "LightClientBootstrap": ("85a309d826c1f749a364745b5132fb3e3ebae295a100a0a7a7bdb03ae204a533", 2560),
+        "LightClientUpdate": ("034675b54931320ad0a6890072b8cb88bb187ff398e773f129ff5d6332bdf2a1", 3676),
+        "LightClientFinalityUpdate": ("507f17d66560d5e4314c921d91c89ae05f71b1965b2720aa5ccace8261017428", 1932),
+        "LightClientOptimisticUpdate": ("f5ee51651ccdf3cdebaaad912eecc0f689f5ef620afcf7a49c38561e7963e1fd", 924),
+    },
+    "deneb": {
+        "LightClientHeader": ("0b43925ceebf39fb4327a08cd793ca5506033895a93f4407289cbdf9d3e6bcc4", 828),
+        "LightClientBootstrap": ("780bbe2c1f66bc9ccb4cb8682bda0295c36d78cc790562c12c6164f9af65b0fc", 2576),
+        "LightClientUpdate": ("bd1b3b73262876b010933790e10fb62e0cd4918adea4e8f29cbb8514c76a511a", 3708),
+        "LightClientFinalityUpdate": ("5a303a81db453519e56a9a9cea80a9be995210b26be0f8b69d997d07364e183a", 1964),
+        "LightClientOptimisticUpdate": ("1aef17ad49c3f45e81d8cd5931a92bea467544b5e890e848bc967187b9372d51", 940),
+    },
+    "electra": {
+        "LightClientHeader": ("0b43925ceebf39fb4327a08cd793ca5506033895a93f4407289cbdf9d3e6bcc4", 892),
+        "LightClientBootstrap": ("780bbe2c1f66bc9ccb4cb8682bda0295c36d78cc790562c12c6164f9af65b0fc", 2672),
+        "LightClientUpdate": ("bd1b3b73262876b010933790e10fb62e0cd4918adea4e8f29cbb8514c76a511a", 3900),
+        "LightClientFinalityUpdate": ("5a303a81db453519e56a9a9cea80a9be995210b26be0f8b69d997d07364e183a", 2124),
+        "LightClientOptimisticUpdate": ("1aef17ad49c3f45e81d8cd5931a92bea467544b5e890e848bc967187b9372d51", 1004),
+    },
+}
+
+
+def _ns(fork: str):
+    return fork_module(Fork[fork.upper()]).build(MINIMAL)
+
+
+def _floor_log2(g: int) -> int:
+    return int(g).bit_length() - 1
+
+
+# ---------------------------------------------------------------------------
+# containers: golden vectors + depth consistency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fork", LC_FORKS)
+def test_container_golden_vectors(fork):
+    """Default HTR + serialized length pinned per fork, and the SSZ
+    round-trip is exact (serialize → deserialize → same root)."""
+    ns = _ns(fork)
+    for name in _NAMES:
+        typ = getattr(ns, name)
+        d = typ.default()
+        want_root, want_len = _GOLDEN[fork][name]
+        buf = typ.serialize(d)
+        assert typ.hash_tree_root(d).hex() == want_root, (fork, name)
+        assert len(buf) == want_len, (fork, name)
+        back = typ.deserialize(buf)
+        assert typ.hash_tree_root(back).hex() == want_root, (fork, name)
+        assert typ.serialize(back) == buf, (fork, name)
+
+
+@pytest.mark.parametrize("fork", LC_FORKS)
+def test_branch_depths_match_state_gindices(fork):
+    """Each branch vector's length equals floor_log2 of the gindex it
+    proves on the ACTUAL fork state/body type — the invariant electra's
+    inherited deneb containers violated (finality 7≠6, committees 6≠5
+    under the 37-field EIP-7251 state)."""
+    ns = _ns(fork)
+    state_t = ns.BeaconState
+    g_cur = ssz_core.get_generalized_index(state_t, "current_sync_committee")
+    g_next = ssz_core.get_generalized_index(state_t, "next_sync_committee")
+    g_fin = ssz_core.get_generalized_index(
+        state_t, "finalized_checkpoint", "root"
+    )
+    boot = ns.LightClientBootstrap.fields()
+    upd = ns.LightClientUpdate.fields()
+    fin = ns.LightClientFinalityUpdate.fields()
+    assert boot["current_sync_committee_branch"].length == _floor_log2(g_cur)
+    assert upd["next_sync_committee_branch"].length == _floor_log2(g_next)
+    assert upd["finality_branch"].length == _floor_log2(g_fin)
+    assert fin["finality_branch"].length == _floor_log2(g_fin)
+    hdr = ns.LightClientHeader.fields()
+    if fork in ("capella", "deneb", "electra"):
+        g_exec = ssz_core.get_generalized_index(
+            ns.BeaconBlockBody, "execution_payload"
+        )
+        assert hdr["execution_branch"].length == _floor_log2(g_exec)
+    else:
+        assert "execution_branch" not in hdr
+
+
+# ---------------------------------------------------------------------------
+# production off the upgrade chain, every branch verified
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lc_chain():
+    """(store, {fork: head snapshot}) — the five-boundary upgrade chain
+    replayed through the Executor with EVERY committed (state, block)
+    pair published, so parent/finalized block roots resolve."""
+    state, ctx, blocks = chain_utils.produce_full_upgrade_chain(64)
+    store = HeadStore(capacity=len(blocks) + 1)
+    ex = Executor(state.copy(), ctx)
+    heads = {}
+    for block in blocks:
+        ex.apply_block(block)
+        snap = store.publish(ex.state.copy(), ctx, block=block)
+        heads[ex.state.version().name.lower()] = snap
+    return store, heads
+
+
+def _verify_header(snap, header, fork):
+    """The head-identity assertions: the light-client header IS the
+    snapshot's block header with its state root filled, and on capella+
+    the execution branch proves the payload header into the body root."""
+    beacon = header.beacon
+    assert bytes(beacon.state_root) == snap.root
+    bh_t = type(beacon)
+    assert bh_t.hash_tree_root(beacon) == snap.block_root
+    if fork in ("capella", "deneb", "electra"):
+        body = snap.block.message.body
+        body = getattr(body, "data", body)
+        body_t = type(body)
+        g = int(ssz_core.get_generalized_index(body_t, "execution_payload"))
+        exec_t = type(header.execution)
+        assert is_valid_merkle_branch_for_generalized_index(
+            exec_t.hash_tree_root(header.execution),
+            list(header.execution_branch),
+            g,
+            bytes(beacon.body_root),
+        ), fork
+
+
+@pytest.mark.parametrize("fork", ("altair", "capella", "deneb", "electra"))
+def test_production_branches_verify(lc_chain, fork):
+    store, heads = lc_chain
+    snap = heads[fork]
+    state_t = type(snap.raw)
+
+    boot, got_fork = lc.light_client_bootstrap(snap)
+    assert got_fork == fork
+    _verify_header(snap, boot.header, fork)
+    sc_t = type(boot.current_sync_committee)
+    g = int(ssz_core.get_generalized_index(state_t, "current_sync_committee"))
+    assert is_valid_merkle_branch_for_generalized_index(
+        sc_t.hash_tree_root(boot.current_sync_committee),
+        list(boot.current_sync_committee_branch),
+        g,
+        snap.root,
+    )
+
+    upd, upd_fork = lc.light_client_update(store, snap)
+    attested = store.resolve(bytes(snap.block.message.parent_root))
+    assert attested is not None
+    _verify_header(attested, upd.attested_header, upd_fork)
+    att_t = type(attested.raw)
+    g = int(ssz_core.get_generalized_index(att_t, "next_sync_committee"))
+    assert is_valid_merkle_branch_for_generalized_index(
+        sc_t.hash_tree_root(upd.next_sync_committee),
+        list(upd.next_sync_committee_branch),
+        g,
+        attested.root,
+    )
+    g = int(
+        ssz_core.get_generalized_index(att_t, "finalized_checkpoint", "root")
+    )
+    assert is_valid_merkle_branch_for_generalized_index(
+        bytes(attested.raw.finalized_checkpoint.root),
+        list(upd.finality_branch),
+        g,
+        attested.root,
+    )
+    assert int(upd.signature_slot) == int(snap.block.message.slot)
+
+    opt, _ = lc.light_client_optimistic_update(store, snap)
+    assert bytes(opt.attested_header.beacon.state_root) == attested.root
+    agg_t = type(opt.sync_aggregate)
+    assert agg_t.hash_tree_root(opt.sync_aggregate) == agg_t.hash_tree_root(
+        snap.block.message.body.sync_aggregate
+    )
+
+
+def test_updates_by_period(lc_chain):
+    store, heads = lc_chain
+    head = store.head
+    period = lc.sync_committee_period(head)
+    got = lc.light_client_updates(store, 0, period + 1)
+    assert got, "at least one period must be servable"
+    periods = [
+        lc.sync_committee_period(
+            store.resolve(bytes(u.attested_header.beacon.state_root))
+            or head  # attested is retained by construction
+        )
+        for u, _fork in got
+    ]
+    assert periods == sorted(set(periods))
+    assert lc.light_client_updates(store, period + 100, 2) == []
+    assert lc.light_client_updates(store, 0, 0) == []
+
+
+def test_phase0_snapshot_declines(lc_chain):
+    from ethereum_consensus_tpu.serving.oracle import BadRequest
+
+    state, ctx = chain_utils.fresh_genesis(64)
+    store = HeadStore()
+    snap = store.publish(state, ctx)
+    with pytest.raises(BadRequest):
+        lc.light_client_bootstrap(snap)
+    with pytest.raises(BadRequest):
+        lc.light_client_update(store, snap)
+
+
+# ---------------------------------------------------------------------------
+# endpoint round-trips vs the in-process oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def lc_served(lc_chain):
+    store, heads = lc_chain
+    server = IntrospectionServer(port=0).start(start_flight=False)
+    server.mount(BeaconDataPlane(store))
+    try:
+        yield store, heads, Client(server.url().rstrip("/"))
+    finally:
+        server.stop()
+
+
+def test_endpoint_round_trips(lc_served):
+    store, heads, client = lc_served
+    head = store.head
+    fork = lc.fork_of(head)
+
+    boot, bfork = lc.light_client_bootstrap(head)
+    got = client.get_light_client_bootstrap(head.block_root)
+    assert got.version == bfork == fork
+    assert got.data == type(boot).to_json(boot)
+
+    fin, ffork = lc.light_client_finality_update(store)
+    got = client.get_light_client_finality_update()
+    assert got.version == ffork
+    assert got.data == type(fin).to_json(fin)
+
+    opt, ofork = lc.light_client_optimistic_update(store)
+    got = client.get_light_client_optimistic_update()
+    assert got.version == ofork
+    assert got.data == type(opt).to_json(opt)
+
+    period = lc.sync_committee_period(head)
+    wire = client.get_light_client_updates(0, period + 1)
+    oracle_updates = lc.light_client_updates(store, 0, period + 1)
+    assert isinstance(wire, list) and len(wire) == len(oracle_updates)
+    for row, (upd, ufork) in zip(wire, oracle_updates):
+        assert row["version"] == ufork
+        assert row["data"] == type(upd).to_json(upd)
+
+
+def test_proof_endpoint_round_trip(lc_served):
+    from ethereum_consensus_tpu.proofs import (
+        ProofContext,
+        extract_multiproof,
+    )
+
+    store, heads, client = lc_served
+    head = store.head
+    state_t = type(head.raw)
+    g_fin = int(
+        ssz_core.get_generalized_index(state_t, "finalized_checkpoint", "root")
+    )
+    g_slot = int(ssz_core.get_generalized_index(state_t, "slot"))
+    pc = ProofContext(state_t, head.raw)
+
+    doc = client.get_state_proof("head", [g_fin])
+    assert int(doc["gindex"]) == g_fin
+    assert bytes.fromhex(doc["leaf"][2:]) == pc.node_at(g_fin)
+    branch = [bytes.fromhex(h[2:]) for h in doc["proof"]]
+    assert branch == pc.proof(g_fin)
+    assert is_valid_merkle_branch_for_generalized_index(
+        pc.node_at(g_fin), branch, g_fin, head.root
+    )
+
+    gis = sorted({g_fin, g_slot})
+    doc = client.get_state_proof("head", gis)
+    mp = extract_multiproof(pc, gindices=gis)
+    assert [int(g) for g in doc["gindices"]] == gis
+    assert [bytes.fromhex(h[2:]) for h in doc["leaves"]] == mp.leaves
+    assert [bytes.fromhex(h[2:]) for h in doc["proof"]] == mp.proof
+    assert mp.verify(head.root)
+
+
+def test_endpoint_errors(lc_served):
+    store, heads, client = lc_served
+    with pytest.raises(ApiError) as err:
+        client.get_state_proof("head", [])
+    assert err.value.code == 400
+    with pytest.raises(ApiError) as err:
+        client.get("eth/v1/beacon/states/head/proof", {"gindex": "zebra"})
+    assert err.value.code == 400
+    with pytest.raises(ApiError) as err:
+        client.get_light_client_bootstrap(b"\xee" * 32)
+    assert err.value.code == 404
+    with pytest.raises(ApiError) as err:
+        client.http_get("eth/v1/beacon/light_client/updates")
+    assert err.value.code == 400
+
+
+def test_phase0_endpoint_is_400():
+    state, ctx = chain_utils.fresh_genesis(64)
+    store = HeadStore()
+    snap = store.publish(state, ctx)
+    server = IntrospectionServer(port=0).start(start_flight=False)
+    server.mount(BeaconDataPlane(store))
+    try:
+        client = Client(server.url().rstrip("/"))
+        with pytest.raises(ApiError) as err:
+            client.get_light_client_bootstrap(snap.block_root)
+        assert err.value.code == 400
+        with pytest.raises(ApiError) as err:
+            client.get_light_client_finality_update()
+        assert err.value.code == 400
+    finally:
+        server.stop()

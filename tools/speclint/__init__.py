@@ -82,6 +82,11 @@ def _default_targets(root: str) -> dict:
             # write through any of them breaks the bit-identity gate it
             # itself asserts
             os.path.join(root, _PKG, "soak"),
+            # the proof plane reads the SAME memo trees a served
+            # snapshot's hash_tree_root settled — a stray write through
+            # its providers would corrupt every later branch AND the
+            # snapshot root it must verify against
+            os.path.join(root, _PKG, "proofs"),
         ),
         "concurrency_paths": iter_py_files(
             os.path.join(root, _PKG, "pipeline"),
@@ -115,6 +120,10 @@ def _default_targets(root: str) -> dict:
             # and merkle rebuilds consult it concurrently; its decline
             # one-shot set mirrors epoch_vector's fallback discipline
             os.path.join(root, _PKG, "parallel"),
+            # proof extraction runs on handler threads against shared
+            # snapshots (the ProofContext memo + the fallback one-shot
+            # set are cross-thread state in the serving path)
+            os.path.join(root, _PKG, "proofs"),
             # the soak drives reader/SSE/spam threads against the
             # pipeline driver concurrently; its sentinel and subscriber
             # state must stay lock-disciplined
