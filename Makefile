@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke proofs-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
+.PHONY: help test test-all speclint speclint-json speclint-sarif speclint-changed speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke proofs-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
 
 PROFILE_DIR ?= profile_artifacts
 
@@ -15,11 +15,19 @@ test:  ## tier-1 suite (hermetic CPU, slow tests deselected)
 test-all:  ## full suite including slow bench-shaped tests
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q
 
-speclint:  ## static analysis: fork drift, SSZ mutation purity, concurrency
-	$(PY) -m tools.speclint
+SPECLINT_REPORT ?= speclint_report.json
+
+speclint:  ## whole-package static analysis: fork drift, SSZ purity, concurrency, device discipline, silent fallbacks, observability contract, env flags (JSON artifact left behind on failure)
+	@$(PY) -m tools.speclint --report $(SPECLINT_REPORT) && rm -f $(SPECLINT_REPORT) || { echo "findings report: $(SPECLINT_REPORT)"; exit 1; }
 
 speclint-json:  ## same, JSON report on stdout
 	$(PY) -m tools.speclint --format json
+
+speclint-sarif:  ## same, SARIF 2.1.0 on stdout (code-scanning UIs)
+	$(PY) -m tools.speclint --format sarif
+
+speclint-changed:  ## lint only the git working set (tracked diffs + untracked)
+	$(PY) -m tools.speclint --changed
 
 speclint-all:  ## include allowlisted findings in the listing
 	$(PY) -m tools.speclint --all
@@ -32,6 +40,7 @@ bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 
 bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke + the memory-observatory smoke + the proof-plane smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py tests/test_memory_observatory.py tests/test_proofs.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke or mem_smoke or proofs_smoke'
+	$(PY) -m tools.speclint --changed
 
 mesh-smoke:  ## 2-device virtual mesh: one sharded epoch pass + one sharded RLC flush window, bit-identical to host
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_mesh_runtime.py -q -m mesh_smoke

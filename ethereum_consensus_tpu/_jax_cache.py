@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import os
 
+from . import _env
+
 _DEFAULT_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
 )
@@ -25,7 +27,7 @@ def enable(cache_dir: str | None = None) -> None:
 
     jax.config.update(
         "jax_compilation_cache_dir",
-        cache_dir or os.environ.get("EC_JAX_CACHE_DIR", _DEFAULT_DIR),
+        cache_dir or _env.raw("EC_JAX_CACHE_DIR", _DEFAULT_DIR),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -37,7 +39,7 @@ def status() -> dict:
     ``/device`` document (telemetry/device.py): whether the on-disk XLA
     cache is wired up, where it lives, and how many compiled entries it
     holds right now. Never imports jax."""
-    cache_dir = os.environ.get("EC_JAX_CACHE_DIR", _DEFAULT_DIR)
+    cache_dir = _env.raw("EC_JAX_CACHE_DIR", _DEFAULT_DIR)
     entries = None
     try:
         entries = sum(

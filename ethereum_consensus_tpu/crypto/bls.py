@@ -24,11 +24,10 @@ to per-set verification only to attribute failures.
 from __future__ import annotations
 
 import hashlib
-import os
 import secrets
 import threading
 
-from .. import _device_flags
+from .. import _device_flags, _env
 from ..error import (
     InvalidPublicKeyError,
     InvalidSecretKeyError,
@@ -91,7 +90,7 @@ def backend_name() -> str:
     if _BACKEND is None:
         with _BACKEND_LOCK:
             if _BACKEND is None:
-                mode = os.environ.get("EC_BLS_BACKEND", "auto")
+                mode = _env.raw("EC_BLS_BACKEND", "auto")
                 if mode == "python":
                     _BACKEND = "python"
                 else:
@@ -215,6 +214,30 @@ def last_batch_route() -> "str | None":
     on the calling thread, or None if none ran (short batches and the
     per-set fallback verify host-side without the RLC batch)."""
     return getattr(_ROUTE_TL, "route", None)
+
+
+# one-shot state for _device_decline: last exception type per decline
+# kind, so a CHANGED failure cause re-arms the trace event (the mesh
+# runtime's decline idiom) instead of the first cause masking the rest
+_DECLINE_LOCK = threading.Lock()
+_DECLINE_LAST: "dict[str, str]" = {}
+
+
+def _device_decline(kind: str, exc: BaseException) -> None:
+    """Journal one device-route decline: counter + routing journal +
+    one-shot trace event (re-armed when the exception type changes).
+    The device path swallowing an exception MUST NOT change verdicts —
+    but it must not go dark either: a soak where every batch quietly
+    falls back to the host pairing would otherwise read as healthy."""
+    _metrics.counter(f"bls.device_decline.{kind}").inc()
+    cause = type(exc).__name__
+    if _device_obs.OBSERVATORY.active:
+        _device_obs.route("bls_device", "host", kind, cause=cause)
+    with _DECLINE_LOCK:
+        armed = _DECLINE_LAST.get(kind) != cause
+        _DECLINE_LAST[kind] = cause
+    if armed:
+        trace.event("bls.device_decline", kind=kind, cause=cause)
 
 
 def _pk_cache_put(data: bytes, raw: bytes) -> None:
@@ -610,8 +633,9 @@ def fast_aggregate_verify(
         if _device_flags.bls_agg_enabled(len(public_keys)):
             try:
                 agg = _aggregate_on_device(public_keys)
-            except Exception:  # noqa: BLE001 — device trouble must not change verdicts
-                pass  # fall through to the native path
+            except Exception as exc:  # noqa: BLE001 — device trouble must not change verdicts
+                _device_decline("fast_aggregate", exc)
+                # fall through to the native path
             else:
                 if agg is None:
                     return False  # identity aggregate never verifies
@@ -849,7 +873,8 @@ def _batch_device_pairing(
         return device_pairing.batch_verify_device(
             pk_raws, h_raws, sig_raws, blinders
         )
-    except Exception:  # noqa: BLE001 — device trouble must not change verdicts
+    except Exception as exc:  # noqa: BLE001 — device trouble must not change verdicts
+        _device_decline("pairing", exc)
         return None
 
 

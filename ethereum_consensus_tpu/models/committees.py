@@ -46,10 +46,10 @@ contract (no spec path does — the same horizon
 
 from __future__ import annotations
 
-import os
 import threading
 import weakref
 
+from .. import _env
 from ..domains import DomainType
 from ..telemetry import device as _device_obs
 from ..telemetry import metrics
@@ -109,15 +109,11 @@ def fallback(reason: str, **inputs) -> None:
 
 
 def _disabled() -> bool:
-    if os.environ.get(_DISABLE_ENV, "").lower() in ("off", "0", "false"):
+    if _env.flag_off(_DISABLE_ENV):
         return True
     from . import ops_vector
 
-    return os.environ.get(ops_vector._DISABLE_ENV, "").lower() in (
-        "off",
-        "0",
-        "false",
-    )
+    return _env.flag_off(ops_vector._DISABLE_ENV)
 
 
 class PendingMasks:

@@ -66,10 +66,9 @@ per reason), and per-stage spans (``epoch_vector.justification`` …
 
 from __future__ import annotations
 
-import os
 import threading
 
-from .. import _device_flags
+from .. import _device_flags, _env
 from ..primitives import FAR_FUTURE_EPOCH, GENESIS_EPOCH
 from ..telemetry import device as _device_obs
 from ..telemetry import metrics
@@ -134,9 +133,7 @@ def fallback(reason: str, **inputs) -> None:
 def _mesh_requested() -> bool:
     """Plain env read — the parallel.runtime import (and with it jax)
     only happens when the mesh is actually switched on (ECT_MESH)."""
-    return os.environ.get("ECT_MESH", "").strip().lower() not in (
-        "", "off", "0", "none", "host",
-    )
+    return _env.mesh_requested()
 
 
 _JITTED_KERNELS = {}
@@ -217,19 +214,16 @@ def kernel_cache_census() -> "tuple[int, int]":
         if probe is not None:
             try:
                 entries += max(0, int(probe()) - 1)
-            except Exception:  # noqa: BLE001 — jax version drift
+            except (TypeError, ValueError, RuntimeError):
+                # jax version drift: _cache_size is a private probe and
+                # may change arity/return shape; the census stays honest
+                # at one entry per kernel
                 pass
     return 0, entries
 
 
 def _disabled() -> bool:
-    if os.environ.get(_DISABLE_ENV, "").lower() in ("off", "0", "false"):
-        return True
-    return os.environ.get(ops_vector._DISABLE_ENV, "").lower() in (
-        "off",
-        "0",
-        "false",
-    )
+    return _env.flag_off(_DISABLE_ENV) or _env.flag_off(ops_vector._DISABLE_ENV)
 
 
 # ---------------------------------------------------------------------------

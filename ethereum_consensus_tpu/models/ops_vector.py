@@ -51,9 +51,9 @@ a degraded host is visible, not just slow.
 
 from __future__ import annotations
 
-import os
 import threading
 
+from .. import _env
 from ..ssz.core import CachedRootList, bulk_store
 from ..telemetry import device as _device_obs
 from ..telemetry import memory as _memory
@@ -115,7 +115,7 @@ def fallback(reason: str) -> None:
 
 
 def _disabled() -> bool:
-    return os.environ.get(_DISABLE_ENV, "").lower() in ("off", "0", "false")
+    return _env.flag_off(_DISABLE_ENV)
 
 
 # ---------------------------------------------------------------------------
@@ -537,6 +537,10 @@ def process_attestations_batch(state, attestations, context,
     propagates — the exact partial state the sequential loop leaves."""
     n_atts = len(attestations)
     if n_atts < BATCH_MIN_ATTESTATIONS:
+        # an EMPTY list is no work at all, not a decline of work — only
+        # journal when real attestations were routed to the scalar loop
+        if n_atts:
+            fallback("below_threshold")
         return False
     if _disabled():
         fallback("disabled")
@@ -546,7 +550,11 @@ def process_attestations_batch(state, attestations, context,
         fallback("unregistered_attestation_fn")
         return False
     if len(state.validators) < BATCH_MIN_VALIDATORS:
-        return False  # deliberate cost threshold, not a degradation
+        # deliberate cost threshold, not a degradation — but journaled
+        # all the same: a soak that never crosses it should show WHY
+        # the columnar path never engaged
+        fallback("below_threshold")
+        return False
     np = _np()
     if np is None:
         fallback("no_numpy")

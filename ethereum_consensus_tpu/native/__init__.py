@@ -18,6 +18,8 @@ import os
 import subprocess
 import tempfile
 
+from .. import _env
+
 __all__ = [
     "load",
     "available",
@@ -40,7 +42,7 @@ def _build_dir() -> str:
 def _source_tag() -> str:
     with open(_SOURCE, "rb") as f:
         digest = hashlib.sha256(f.read())
-    digest.update(os.environ.get("EC_NATIVE_SHA_NI", "").encode())
+    digest.update(_env.raw("EC_NATIVE_SHA_NI").encode())
     return digest.hexdigest()[:16]
 
 
@@ -60,7 +62,7 @@ def load():
             # SHA-NI is opt-in: virtualized hosts may trap the sha
             # instructions (measured ~20x slower than scalar under
             # emulation in this image)
-            if os.environ.get("EC_NATIVE_SHA_NI"):
+            if _env.raw("EC_NATIVE_SHA_NI"):
                 flags.append("-DEC_USE_SHA_NI")
             subprocess.run(
                 ["g++", *flags, _SOURCE, "-o", tmp],

@@ -6,9 +6,7 @@ never import it directly — device acceleration is installed explicitly via
 ``install()``.
 """
 
-import os
-
-from .. import _device_flags
+from .. import _device_flags, _env
 from .._jax_cache import enable as _enable_jax_cache
 
 _enable_jax_cache()
@@ -41,7 +39,7 @@ _AUTO_PAIRING_MIN_SETS = 512
 
 
 def _pairing_min_sets_default() -> "int | None":
-    env = os.environ.get("ECT_PAIRING_MIN_SETS")
+    env = _env.raw_or_none("ECT_PAIRING_MIN_SETS")
     if env is None:
         return _AUTO_PAIRING_MIN_SETS
     env = env.strip().lower()

@@ -31,12 +31,11 @@ invisible outside this package.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import _env
 from . import fq as _strict
 
 __all__ = [
@@ -112,7 +111,7 @@ def sub(a, b):
 # either way; the switch exists because which one wins is a per-chip
 # hardware question (v5e emulates u64 lane products; see
 # docs/DEVICE_PAIRING.md and bench.py bench_pairing_device).
-_MULTIPLIER = os.environ.get("EC_PAIRING_MULT", "u64")
+_MULTIPLIER = _env.raw("EC_PAIRING_MULT", "u64")
 
 
 def set_multiplier(kind: str) -> None:

@@ -644,7 +644,7 @@ def g1_sum_sets(
         len(raw_sets), width, 3, 24
     )
     if sharding is not None:
-        batch = jax.device_put(batch, sharding)
+        (batch,) = _obs.h2d_put("ops.pairing.g1_sum_sets", (batch,), sharding)
     sums = _g1_tree_reduce_segmented(batch, (width - 1).bit_length())
     # host export: R'-Montgomery columns → canonical ints → affine bytes
     ints = fql.from_mont_ints(np.asarray(sums).reshape(len(raw_sets) * 3, 24))

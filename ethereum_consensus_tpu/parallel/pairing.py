@@ -114,34 +114,32 @@ def miller_partials_sharded(mesh, pk_raws, h_raws, sig_raws, scalars):
     xq, yq = dp.g2_affine_from_raw(h_padded)
     sx, sy = dp.g2_affine_from_raw(sig_padded)
     one2 = jnp.broadcast_to(
-        jnp.asarray(np.stack([
-            np.asarray(dp.fql.to_mont_cols(1)), np.zeros(24, np.uint64),
-        ])),
+        _obs.h2d(
+            "parallel.pairing.const_one2",
+            np.stack([
+                np.asarray(dp.fql.to_mont_cols(1)), np.zeros(24, np.uint64),
+            ]),
+        ),
         sy.arr.shape,
     )
     sig_jac = jnp.stack([sx.arr, sy.arr, one2], axis=-3)
-    pk_bits = jnp.asarray(dp._scalars_to_bits(pk_scalars, 128))
-    sig_bits = jnp.asarray(dp._scalars_to_bits(sig_scalars, 128))
+    pk_bits, sig_bits = _obs.h2d(
+        "parallel.pairing.scalar_bits",
+        dp._scalars_to_bits(pk_scalars, 128),
+        dp._scalars_to_bits(sig_scalars, 128),
+    )
 
     shard = NamedSharding(mesh, P(SHARD_AXIS))
-    staged = (pk_jac, pk_bits, xq.arr, yq.arr, sig_jac, sig_bits,
-              jnp.asarray(valid))
-    if _obs.OBSERVATORY.active:
-        import time as _time
-
-        nbytes = sum(int(getattr(a, "nbytes", 0)) for a in staged)
-        t0 = _time.perf_counter()
-        args = tuple(jax.device_put(a, shard) for a in staged)
-        _obs.OBSERVATORY.record_transfer(
-            "parallel.pairing.shard_put", "h2d", len(staged), nbytes,
-            t0, _time.perf_counter(),
-        )
-    else:
-        args = tuple(jax.device_put(a, shard) for a in staged)
+    # ``valid`` rides as the host np array — the seam's device_put IS
+    # its one transfer
+    staged = (pk_jac, pk_bits, xq.arr, yq.arr, sig_jac, sig_bits, valid)
+    args = _obs.h2d_put("parallel.pairing.shard_put", staged, shard)
     partial_fs, partial_sigs = _sharded_parts(mesh)(*args)
 
-    f_total = dp.fp12_product(jnp.asarray(partial_fs))
-    sig_sum = dp.g2_sum_points(dp._env(jnp.asarray(partial_sigs)))
+    # per-shard partials come back as device arrays already — reduce in
+    # place, no re-wrap
+    f_total = dp.fp12_product(partial_fs)
+    sig_sum = dp.g2_sum_points(dp._env(partial_sigs))
     s_raw, s_inf = dp._g2_point_to_raw(sig_sum)
     return f_total, s_raw, s_inf
 

@@ -39,9 +39,9 @@ jax unusable — do not heal mid-process).
 
 from __future__ import annotations
 
-import os
 import threading
 
+from .. import _env
 from ..telemetry import device as _device_obs
 from ..telemetry import metrics as _metrics
 from ..utils import trace
@@ -108,8 +108,7 @@ def requested() -> bool:
     """Is the mesh runtime switched on at all? A plain env read — the
     off path imports no jax and journals nothing (off is a
     configuration, not a decline)."""
-    value = os.environ.get(MESH_ENV, "").strip().lower()
-    return value not in ("", "off", "0", "none", "host")
+    return _env.mesh_requested(MESH_ENV)
 
 
 def _decline(kind: str, reason: str, **inputs) -> None:
@@ -150,7 +149,7 @@ def _provision() -> "tuple":
     with _LOCK:
         if _PROVISIONED is not None:
             return _PROVISIONED
-        value = os.environ.get(MESH_ENV, "").strip().lower()
+        value = _env.mode(MESH_ENV)
         outcome = _provision_locked(value)
         if outcome[0] is not None:
             # the merkle hook rides provisioning: one install, and the
@@ -216,7 +215,7 @@ def device_count() -> int:
 
 def status() -> dict:
     """Runtime state for /device and the bench evidence blocks."""
-    value = os.environ.get(MESH_ENV, "").strip() or "off"
+    value = _env.raw(MESH_ENV).strip() or "off"
     if not requested():
         return {"requested": False, "env": value, "devices": 0}
     m, reason = _provision()
@@ -229,7 +228,7 @@ def status() -> dict:
 
 
 def _threshold(env_key: str, default: int) -> int:
-    raw = os.environ.get(env_key, "").strip()
+    raw = _env.raw(env_key).strip()
     if not raw:
         return default
     try:

@@ -19,6 +19,8 @@ tests). Sweep math is exact integer arithmetic — no floats anywhere.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops.merkle import reduce_levels
 from ..ops.sha256 import sha256_64b
 from ..ssz.merkle import next_pow_of_two
+from ..telemetry import device as _obs
 from ._compat import shard_map
 from .mesh import SHARD_AXIS
 
@@ -64,6 +67,11 @@ def _length_words(length: int) -> np.ndarray:
     return np.frombuffer(chunk, dtype=">u4").astype(np.uint32)
 
 
+# lru_cache IS the staging discipline here (speclint device/jit-outside-
+# staging): every distinct (mesh, constants) tuple compiles exactly once
+# per process, so a driver looping over epochs re-enters the SAME jitted
+# step instead of re-tracing a fresh one each call.
+@functools.lru_cache(maxsize=8)
 def make_chain_step(
     mesh: Mesh,
     axis_name: str = SHARD_AXIS,
@@ -179,11 +187,15 @@ def run_chain_step(step, mesh, balances, effective, active, zero_words,
     eff[:n] = effective
     act = np.zeros(padded, np.bool_)
     act[:n] = active
-    new_eff, total, root_words = step(
-        jnp.asarray(bal), jnp.asarray(eff), jnp.asarray(act),
-        zero_words, jnp.asarray(_length_words(n)),
+    bal_d, eff_d, act_d, len_d = _obs.h2d(
+        "parallel.step.registry", bal, eff, act, _length_words(n)
     )
-    return np.asarray(new_eff)[:n], int(total), np.asarray(root_words)
+    new_eff, total, root_words = step(bal_d, eff_d, act_d, zero_words, len_d)
+    return (
+        _obs.d2h("parallel.step.new_effective", new_eff)[:n],
+        int(total),
+        _obs.d2h("parallel.step.balances_root", root_words),
+    )
 
 
 def make_epoch_sweep_step(
@@ -219,7 +231,37 @@ def make_epoch_sweep_step(
     that epoch must then run through the host spec path (the
     single-device twin, ops.sweeps.inactivity_penalties_device, reroutes
     itself). Pass ``check_score_bound=False`` to get the raw jitted step
-    for composition inside a larger jit."""
+    for composition inside a larger jit.
+
+    The context object is unhashable, so this wrapper extracts the five
+    scalars the sweep actually closes over and defers to the lru-cached
+    factory — two epochs under the same constants share ONE compiled
+    step (speclint device/jit-outside-staging)."""
+    return _epoch_sweep_step(
+        mesh,
+        int(context.EFFECTIVE_BALANCE_INCREMENT),
+        int(context.BASE_REWARD_FACTOR),
+        int(context.inactivity_score_bias),
+        int(context.inactivity_score_recovery_rate),
+        int(context.INACTIVITY_PENALTY_QUOTIENT_ALTAIR),
+        axis_name,
+        is_leaking,
+        check_score_bound,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _epoch_sweep_step(
+    mesh: Mesh,
+    effective_balance_increment: int,
+    base_reward_factor_int: int,
+    inactivity_score_bias: int,
+    inactivity_score_recovery_rate: int,
+    inactivity_penalty_quotient: int,
+    axis_name: str,
+    is_leaking: bool,
+    check_score_bound: bool,
+):
     from ..models.altair.constants import (
         PARTICIPATION_FLAG_WEIGHTS,
         TIMELY_HEAD_FLAG_INDEX,
@@ -232,11 +274,11 @@ def make_epoch_sweep_step(
             "make_epoch_sweep_step needs exact u64 semantics: enable jax_enable_x64"
         )
 
-    increment = np.uint64(context.EFFECTIVE_BALANCE_INCREMENT)
-    base_reward_factor = np.uint64(context.BASE_REWARD_FACTOR)
-    score_bias = np.uint64(context.inactivity_score_bias)
-    recovery_rate = np.uint64(context.inactivity_score_recovery_rate)
-    inactivity_quotient = np.uint64(context.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+    increment = np.uint64(effective_balance_increment)
+    base_reward_factor = np.uint64(base_reward_factor_int)
+    score_bias = np.uint64(inactivity_score_bias)
+    recovery_rate = np.uint64(inactivity_score_recovery_rate)
+    inactivity_quotient = np.uint64(inactivity_penalty_quotient)
 
     def _isqrt(x):
         guess = jnp.sqrt(x.astype(jnp.float64)).astype(jnp.uint64) + jnp.uint64(1)

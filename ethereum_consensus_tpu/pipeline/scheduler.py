@@ -38,6 +38,7 @@ import os
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
 
+from .. import _env
 from ..crypto import bls
 from ..telemetry import metrics as _metrics
 from ..utils import trace
@@ -65,7 +66,7 @@ def auto_verify_lanes() -> int:
     # the env read duplicates runtime.requested() on purpose: importing
     # ethereum_consensus_tpu.parallel pays the jax import, so the
     # mesh-off path must decide without it (the epoch_vector idiom)
-    value = os.environ.get("ECT_MESH", "").strip().lower()
+    value = _env.mode("ECT_MESH")
     if value not in ("", "off", "0", "none", "host"):
         from ..parallel import runtime as _mesh_runtime
 

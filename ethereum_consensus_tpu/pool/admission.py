@@ -58,11 +58,11 @@ builds, native calls, or pool-lock acquisition's own critical sections
 from __future__ import annotations
 
 import hashlib
-import os
 import secrets
 import threading
 import time
 
+from .. import _env
 from ..crypto import bls
 from ..error import Error
 from ..models.signature_batch import collect_signatures
@@ -112,7 +112,7 @@ def _native() -> bool:
 
 
 def _rlc_disabled() -> bool:
-    return os.environ.get(_RLC_ENV, "").lower() in ("off", "0", "false")
+    return _env.flag_off(_RLC_ENV)
 
 
 class Admission:

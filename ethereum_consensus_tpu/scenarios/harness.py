@@ -21,12 +21,12 @@ The contract every scenario family asserts, after every recovery:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 
+from .. import _env
 from ..error import Error
 from ..executor import Executor
 from ..models import ops_vector
@@ -58,15 +58,8 @@ __all__ = [
 def scalar_mode():
     """Force every columnar path off for the scope — the sequential
     SCALAR oracle the families diff against."""
-    old = os.environ.get(ops_vector._DISABLE_ENV)
-    os.environ[ops_vector._DISABLE_ENV] = "off"
-    try:
+    with _env.override(ops_vector._DISABLE_ENV, "off"):
         yield
-    finally:
-        if old is None:
-            os.environ.pop(ops_vector._DISABLE_ENV, None)
-        else:
-            os.environ[ops_vector._DISABLE_ENV] = old
 
 
 @contextmanager

@@ -48,12 +48,12 @@ metrics-registry increments (locked per metric) stay outside it.
 from __future__ import annotations
 
 import functools
-import os
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 
+from .. import _env
 from . import metrics as _metrics
 from . import spans as _spans
 
@@ -322,7 +322,7 @@ class DeviceObservatory:
 
         # mesh runtime state (parallel/runtime.py): imported ONLY when
         # ECT_MESH is switched on — this module stays jax-free otherwise
-        mesh_env = os.environ.get("ECT_MESH", "").strip()
+        mesh_env = _env.raw("ECT_MESH").strip()
         mesh_state = {
             "requested": False,
             "env": mesh_env or "off",
@@ -444,6 +444,26 @@ def h2d(site: str, *arrays):
     t1 = time.perf_counter()
     obs.record_transfer(site, "h2d", len(out), nbytes, t0, t1)
     return out[0] if len(out) == 1 else out
+
+
+def h2d_put(site: str, arrays, sharding=None):
+    """``jax.device_put`` with an explicit sharding — the sharded-mesh
+    twin of ``h2d``, and the ONLY sanctioned way to place host buffers
+    onto a mesh (speclint's transfer-seam rule points every raw
+    ``device_put`` here). Takes an iterable so one ledger entry covers
+    the whole staged argument tuple; returns the placed tuple."""
+    import jax
+
+    arrays = tuple(arrays)
+    obs = OBSERVATORY
+    if not obs.active:
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+    nbytes = sum(_nbytes(a) for a in arrays)
+    t0 = time.perf_counter()
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    t1 = time.perf_counter()
+    obs.record_transfer(site, "h2d", len(out), nbytes, t0, t1)
+    return out
 
 
 def d2h(site: str, array):

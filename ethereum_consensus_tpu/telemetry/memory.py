@@ -72,6 +72,7 @@ import time
 import weakref
 from contextlib import contextmanager
 
+from .. import _env
 from . import metrics as _metrics
 from . import spans as _spans
 
@@ -181,7 +182,7 @@ class MemoryObservatory:
             self._phases.clear()
             self._copies.clear()
             self._peak_phase = None
-            if os.environ.get(_TRACEMALLOC_ENV, "").strip() in ("1", "on"):
+            if _env.flag_on(_TRACEMALLOC_ENV):
                 import tracemalloc
 
                 if not tracemalloc.is_tracing():

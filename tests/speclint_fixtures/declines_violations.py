@@ -1,0 +1,65 @@
+"""Seeded silent-fallback violations (tools/speclint/declines.py).
+
+The module is "routed" (it owns a decline counter helper), so the
+silent-except and silent-threshold-return rules apply; the sanctioned
+twins record their declines through the helper. Never imported at
+runtime — the analyzer reads the AST only.
+"""
+
+from ethereum_consensus_tpu.telemetry import metrics as _metrics
+
+MIN_BATCH = 32
+
+
+def fallback(reason):
+    _metrics.counter(f"fixture.fallback.{reason}").inc()
+
+
+def _native_sum(values):
+    raise RuntimeError("no native backend in the fixture")
+
+
+# --- declines/silent-except -----------------------------------------------
+
+def swallow(values):
+    try:
+        return _native_sum(values)
+    except Exception:  # VIOLATION: nothing recorded anywhere in scope
+        return None
+
+
+def counted(values):
+    try:
+        return _native_sum(values)
+    except Exception:  # sanctioned: the decline reaches a counter
+        fallback("native_error")
+        return None
+
+
+def probed():
+    try:  # sanctioned: the import-probe idiom leads with the import
+        import _fixture_native  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+# --- declines/silent-threshold-return -------------------------------------
+
+def route_silently(values):
+    if len(values) < MIN_BATCH:  # VIOLATION: decline never journaled
+        return False
+    return _native_sum(values)
+
+
+def route_loudly(values):
+    if len(values) < MIN_BATCH:  # sanctioned: below_threshold recorded
+        fallback("below_threshold")
+        return False
+    return _native_sum(values)
+
+
+# --- declines/undocumented-reason -----------------------------------------
+
+def undocumented_decline():
+    fallback("unheard_of_reason")  # VIOLATION: not in the doc taxonomy

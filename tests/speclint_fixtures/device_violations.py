@@ -1,0 +1,101 @@
+"""Seeded device-discipline violations (tools/speclint/device.py).
+
+One violation per rule plus the sanctioned twin right next to it, so
+the self-tests prove both directions: the rule fires on the bad shape
+and stays quiet on the blessed idiom. Never imported at runtime — the
+analyzer reads the AST only.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ethereum_consensus_tpu.telemetry import device as _obs
+
+
+# --- device/jit-outside-staging -------------------------------------------
+
+def per_call_jit(x):
+    fn = jax.jit(lambda v: v + 1)  # VIOLATION: fresh jit every call
+    return fn(x)
+
+
+def jit_in_loop(kernels):
+    out = []
+    for k in kernels:
+        out.append(jax.jit(k))  # VIOLATION: fresh jit per iteration
+    return out
+
+
+_staged = jax.jit(lambda v: v * 2)  # sanctioned: module-level staging
+
+
+@functools.lru_cache(maxsize=4)
+def staged_factory(n):
+    return jax.jit(lambda v: v + n)  # sanctioned: lru_cache factory
+
+
+def jitted_kernels():
+    return {"sum": jax.jit(lambda v: v.sum())}  # sanctioned: blessed lazy
+
+
+# --- device/varying-static-jit-arg ----------------------------------------
+
+_bucketed = jax.jit(lambda v, n: v[:n], static_argnames=("n",))
+
+
+def call_with_raw_size(batch):
+    return _bucketed(batch, n=len(batch))  # VIOLATION: raw size static
+
+
+def call_with_log_size(batch):
+    depth = len(batch).bit_length()  # sanctioned: log-bounded static
+    return _bucketed(batch, n=depth)
+
+
+# --- device/shape-branch-in-kernel ----------------------------------------
+
+def branchy_kernel(x):
+    if x.shape[0] > 8:  # VIOLATION: per-shape specialization
+        return x[:8].sum()
+    return x.sum()
+
+
+def guarded_kernel(x):
+    if x.ndim != 2:  # sanctioned: guard whose body only raises
+        raise ValueError("rank")
+    return x.sum(axis=1)
+
+
+def host_shape_branch(x):
+    if x.shape[0] > 8:  # sanctioned: not a kernel body
+        return True
+    return False
+
+
+# --- device/unledgered-transfer -------------------------------------------
+
+def raw_put(host_array, sharding):
+    return jax.device_put(host_array, sharding)  # VIOLATION
+
+
+def raw_upload(values):
+    return jnp.asarray(values)  # VIOLATION: host-path h2d
+
+
+def raw_download():
+    out = _staged(jnp.zeros((4,)))
+    return np.asarray(out)  # VIOLATION: unledgered d2h sync
+
+
+def padded_kernel(x):
+    ones = jnp.asarray([1, 2])  # sanctioned: tracer-to-tracer, free
+    return x + ones
+
+
+def ledgered(values, sharding):
+    (dev,) = _obs.h2d_put("fixture.site", (values,), sharding)  # sanctioned
+    host = _obs.d2h("fixture.site", dev)  # sanctioned
+    return np.asarray(host)  # sanctioned: host value, not device-produced

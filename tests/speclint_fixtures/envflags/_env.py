@@ -1,0 +1,14 @@
+"""Fixture twin of the package's central env readers — the envflags
+self-tests point the analyzer at THIS registry instead of the real one.
+Never imported at runtime."""
+
+import os
+
+KNOWN_KEYS = {
+    "ECT_FX_DOCUMENTED": "a registered, documented fixture flag",
+    "ECT_FX_UNDOCUMENTED": "registered but missing from the doc table",
+}
+
+
+def mode(key, default=""):
+    return os.environ.get(key, default).strip().lower()

@@ -1,0 +1,26 @@
+"""Seeded env-flag discipline violations (tools/speclint/envflags.py).
+
+Paired with ``_env.py`` (the fixture key registry) and
+``envflags_doc.md`` (the fixture flag table). Never imported at
+runtime — the analyzer reads the AST only.
+"""
+
+import os
+
+import jax  # VIOLATION: eager-jax-import (not a blessed ops/parallel dir)
+
+from . import _env
+
+_MODE = _env.mode("ECT_FX_DOCUMENTED")  # VIOLATION: read after jax import
+
+
+def scattered():
+    return os.environ.get("ECT_FX_DOCUMENTED", "")  # VIOLATION: bypasses _env
+
+
+def unknown():
+    return _env.mode("ECT_FX_MYSTERY")  # VIOLATION: not in KNOWN_KEYS
+
+
+def sanctioned():
+    return _env.mode("ECT_FX_DOCUMENTED")  # fine: central reader, known key

@@ -1,0 +1,22 @@
+"""Seeded observability-contract violations (tools/speclint/obscontract.py).
+
+Paired with ``obscontract_doc.md``: the module registers one documented
+and one undocumented name of each class (metric, routing-journal kind,
+trace event), and the doc carries one orphan row with no call site.
+Never imported at runtime — the analyzer reads the AST only.
+"""
+
+from ethereum_consensus_tpu.telemetry import device as _obs
+from ethereum_consensus_tpu.telemetry import metrics as _metrics
+from ethereum_consensus_tpu.utils import trace
+
+
+def observe(flag):
+    _metrics.counter("fixture.documented.total").inc()  # documented
+    _metrics.counter("fixture.mystery.total").inc()  # VIOLATION
+    _metrics.gauge("fixture.depth").set(3)  # documented
+    if flag:
+        _obs.route("fixture.documented_kind", "device", "ok")  # documented
+        _obs.route("fixture.mystery_kind", "host", "why")  # VIOLATION
+    trace.event("fixture.documented_event", n=1)  # documented
+    trace.event("fixture.mystery_event", n=2)  # VIOLATION

@@ -28,7 +28,17 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tools import speclint
-from tools.speclint import aliasflow, concurrency, forkdiff, lockorder, mutation
+from tools.speclint import (
+    aliasflow,
+    concurrency,
+    declines,
+    device,
+    envflags,
+    forkdiff,
+    lockorder,
+    mutation,
+    obscontract,
+)
 from tools.speclint.allowlist import Allowlist, AllowlistError
 
 REPO_ROOT = speclint.REPO_ROOT
@@ -379,7 +389,24 @@ def test_aliasflow_scope_covers_the_columnar_engine():
 def test_allowlist_requires_justification():
     with pytest.raises(AllowlistError, match="justification"):
         Allowlist(
-            [{"rule": "r", "path": "p", "symbol": "s", "justification": "  "}]
+            [{"rule": "r", "path": "p", "symbol": "s", "justification": "  ",
+              "citation": "spec.md"}]
+        )
+
+
+def test_allowlist_requires_citation():
+    """A citation-less entry is a hard failure (exit 2), not a warning —
+    an exception nobody can check against the spec is not an exception."""
+    with pytest.raises(AllowlistError, match="citation"):
+        Allowlist(
+            [{"rule": "r", "path": "p", "symbol": "s",
+              "justification": "a perfectly reasonable justification"}]
+        )
+    with pytest.raises(AllowlistError, match="citation"):
+        Allowlist(
+            [{"rule": "r", "path": "p", "symbol": "s",
+              "justification": "a perfectly reasonable justification",
+              "citation": "   "}]
         )
 
 
@@ -390,12 +417,14 @@ def test_allowlist_marks_and_reports_stale():
             "path": "x.py",
             "symbol": "f",
             "justification": "because",
+            "citation": "specs/phase0/beacon-chain.md",
         },
         {
             "rule": "mutation/deepcopy",
             "path": "gone.py",
             "symbol": "g",
             "justification": "stale",
+            "citation": "specs/phase0/beacon-chain.md",
         },
     ]
     allow = Allowlist(entries)
@@ -416,3 +445,237 @@ def test_checked_in_allowlist_is_wellformed():
             "justifications must actually explain the exception: "
             f"{entry['symbol']}"
         )
+        assert len(entry["citation"].strip()) >= 10, (
+            "citations must point at a spec/doc section: "
+            f"{entry['symbol']}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# device self-tests (fixture seeds one violation per rule)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def device_findings():
+    return device.analyze(
+        [os.path.join(FIXTURES, "device_violations.py")], REPO_ROOT
+    )
+
+
+@pytest.mark.parametrize(
+    "rule, symbol",
+    [
+        ("device/jit-outside-staging", "per_call_jit"),
+        ("device/jit-outside-staging", "jit_in_loop"),
+        ("device/varying-static-jit-arg", "call_with_raw_size/_bucketed"),
+        ("device/shape-branch-in-kernel", "branchy_kernel"),
+        ("device/unledgered-transfer", "raw_put"),
+        ("device/unledgered-transfer", "raw_upload"),
+        ("device/unledgered-transfer", "raw_download"),
+    ],
+)
+def test_device_catches_seeded_violation(device_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(device_findings)
+
+
+def test_device_sanctioned_twins_not_flagged(device_findings):
+    flagged = {f.symbol for f in device_findings}
+    for blessed in (
+        "staged_factory",
+        "jitted_kernels",
+        "call_with_log_size",
+        "guarded_kernel",
+        "host_shape_branch",
+        "padded_kernel",
+        "ledgered",
+    ):
+        assert blessed not in flagged, f"{blessed} is a sanctioned idiom"
+
+
+# ---------------------------------------------------------------------------
+# declines self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def declines_findings():
+    return declines.analyze(
+        [os.path.join(FIXTURES, "declines_violations.py")],
+        REPO_ROOT,
+        doc_path=os.path.join(FIXTURES, "declines_doc.md"),
+    )
+
+
+@pytest.mark.parametrize(
+    "rule, symbol",
+    [
+        ("declines/silent-except", "swallow"),
+        ("declines/silent-threshold-return", "route_silently/MIN_BATCH"),
+        ("declines/undocumented-reason", "unheard_of_reason"),
+    ],
+)
+def test_declines_catches_seeded_violation(declines_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(declines_findings)
+
+
+def test_declines_sanctioned_twins_not_flagged(declines_findings):
+    flagged = {f.symbol for f in declines_findings}
+    for blessed in ("counted", "probed", "route_loudly/MIN_BATCH"):
+        assert blessed not in flagged, f"{blessed} records its decline"
+    reasons = {
+        f.symbol
+        for f in declines_findings
+        if f.rule == "declines/undocumented-reason"
+    }
+    assert "below_threshold" not in reasons
+    assert "native_error" not in reasons
+
+
+# ---------------------------------------------------------------------------
+# obscontract self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obscontract_findings():
+    return obscontract.analyze(
+        [os.path.join(FIXTURES, "obscontract_violations.py")],
+        REPO_ROOT,
+        doc_paths=[os.path.join(FIXTURES, "obscontract_doc.md")],
+    )
+
+
+@pytest.mark.parametrize(
+    "rule, symbol",
+    [
+        ("obscontract/undocumented-metric", "fixture.mystery.total"),
+        ("obscontract/orphaned-doc-row", "fixture.orphan.total"),
+        ("obscontract/undocumented-journal-kind", "fixture.mystery_kind"),
+        ("obscontract/undocumented-trace-event", "fixture.mystery_event"),
+    ],
+)
+def test_obscontract_catches_seeded_violation(obscontract_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(obscontract_findings)
+
+
+def test_obscontract_documented_names_not_flagged(obscontract_findings):
+    flagged = {f.symbol for f in obscontract_findings}
+    for blessed in (
+        "fixture.documented.total",
+        "fixture.depth",
+        "fixture.documented_kind",
+        "fixture.documented_event",
+    ):
+        assert blessed not in flagged, f"{blessed} is documented"
+
+
+def test_obscontract_live_diff_is_empty():
+    """The real package ↔ docs diff must be EMPTY both ways: every
+    registered metric/journal-kind/trace-event documented, every doc row
+    backed by a call site. This is the PR's acceptance bar, pinned."""
+    pkg = os.path.join(REPO_ROOT, "ethereum_consensus_tpu")
+    findings = obscontract.analyze(speclint.iter_py_files(pkg), REPO_ROOT)
+    assert not findings, "\n".join(f.format_text() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# envflags self-tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def envflags_findings():
+    fx = os.path.join(FIXTURES, "envflags")
+    return envflags.analyze(
+        [os.path.join(fx, "_env.py"), os.path.join(fx, "violations.py")],
+        REPO_ROOT,
+        doc_path=os.path.join(FIXTURES, "envflags_doc.md"),
+    )
+
+
+@pytest.mark.parametrize(
+    "rule, symbol",
+    [
+        ("envflags/eager-jax-import", "<module>"),
+        ("envflags/env-read-after-jax-import", "<module>"),
+        ("envflags/scattered-env-read", "scattered"),
+        ("envflags/unknown-key", "ECT_FX_MYSTERY"),
+        ("envflags/undocumented-key", "ECT_FX_UNDOCUMENTED"),
+    ],
+)
+def test_envflags_catches_seeded_violation(envflags_findings, rule, symbol):
+    assert (rule, symbol) in _rules_by_symbol(envflags_findings)
+
+
+def test_envflags_sanctioned_reader_not_flagged(envflags_findings):
+    flagged = {f.symbol for f in envflags_findings}
+    assert "sanctioned" not in flagged
+    documented = {
+        f.symbol
+        for f in envflags_findings
+        if f.rule == "envflags/undocumented-key"
+    }
+    assert "ECT_FX_DOCUMENTED" not in documented
+
+
+def test_envflags_live_registry_fully_documented():
+    """Every key in the real ``_env.KNOWN_KEYS`` has a row in the
+    OBSERVABILITY.md environment-flags table, and no package module
+    reads the environ around the central readers."""
+    pkg = os.path.join(REPO_ROOT, "ethereum_consensus_tpu")
+    findings = envflags.analyze(speclint.iter_py_files(pkg), REPO_ROOT)
+    assert not findings, "\n".join(f.format_text() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: SARIF and --changed
+# ---------------------------------------------------------------------------
+
+
+def test_cli_sarif_output():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.speclint", "--format", "sarif"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "speclint"
+    # every allowlisted finding is present, demoted to "note"
+    assert all(r["level"] in ("error", "note") for r in run["results"])
+
+
+def test_cli_changed_mode_runs():
+    """--changed must never fail outright: with a clean tree it lints
+    nothing (or just the working-set files) and exits 0 on this repo."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.speclint", "--changed"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_report_artifact(tmp_path):
+    report = tmp_path / "speclint_report.json"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.speclint",
+            "--report", str(report),
+        ],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(report.read_text())
+    assert payload["open"] == 0
+    assert isinstance(payload["findings"], list)

@@ -24,18 +24,48 @@ Four analyzers (see ``docs/SPECLINT.md`` for the rule catalog):
                    paths deadlocks the pipeline's two threads (the
                    ROADMAP-noted gap closed when the scenario
                    FaultInjector added a second lock to pipeline/).
+* ``device``     — compile-once + transfer-seam discipline over the
+                   WHOLE package: jit staging outside the blessed
+                   factories, per-call-varying values reaching static
+                   jit args, shape-dependent Python branching inside
+                   kernel bodies, and host↔device transfers that dodge
+                   the instrumented ``telemetry.device`` chokepoints.
+* ``declines``   — no silent fallbacks: broad except handlers and
+                   threshold early-returns on routed paths must reach a
+                   counter/journal, and every decline-reason literal
+                   must be documented in docs/OBSERVABILITY.md.
+* ``obscontract``— the observability contract, both directions: every
+                   emittable counter/gauge/histogram has a doc-table
+                   row, every doc row has an emitting site, and journal
+                   kinds + one-shot trace events appear in the docs.
+* ``envflags``   — EC_*/ECT_* environment flags flow through the
+                   central ``_env`` readers, are registered in
+                   ``_env.KNOWN_KEYS``, are documented, and never land
+                   after (or outside the blessed dirs, before) a
+                   module-level jax import.
 
-Run: ``python -m tools.speclint [--format text|json] [paths...]`` — or
-through the tier-1 gate ``tests/test_speclint.py`` (zero non-allowlisted
-findings over the repo). Exceptions live in ``allowlist.toml`` with a
-required justification each; stale entries are themselves findings.
+Run: ``python -m tools.speclint [--format text|json|sarif] [--changed]
+[paths...]`` — or through the tier-1 gate ``tests/test_speclint.py``
+(zero non-allowlisted findings over the repo). Exceptions live in
+``allowlist.toml`` with a required justification AND a required spec/doc
+citation each; stale or citation-less entries hard-fail.
 """
 
 from __future__ import annotations
 
 import os
 
-from . import aliasflow, concurrency, forkdiff, lockorder, mutation
+from . import (
+    aliasflow,
+    concurrency,
+    declines,
+    device,
+    envflags,
+    forkdiff,
+    lockorder,
+    mutation,
+    obscontract,
+)
 from .allowlist import ALLOWLIST_PATH, Allowlist, AllowlistError
 from .base import Finding, iter_py_files
 
@@ -130,6 +160,11 @@ def _default_targets(root: str) -> dict:
             os.path.join(root, _PKG, "soak"),
         ),
         "core_path": os.path.join(root, _PKG, "ssz", "core.py"),
+        # the v2 analyzer families (device / declines / obscontract /
+        # envflags) run over the ENTIRE package: recompile hazards,
+        # silent declines, metric drift, and stray env reads are not
+        # confined to any subsystem list that would stay current
+        "package_paths": iter_py_files(os.path.join(root, _PKG)),
     }
 
 
@@ -155,6 +190,10 @@ def run(
     # lock order aggregates over the SAME scope the concurrency rules
     # police — both halves of a deadlock rarely sit in one file
     findings.extend(lockorder.analyze(targets["concurrency_paths"], root))
+    findings.extend(device.analyze(targets["package_paths"], root))
+    findings.extend(declines.analyze(targets["package_paths"], root))
+    findings.extend(obscontract.analyze(targets["package_paths"], root))
+    findings.extend(envflags.analyze(targets["package_paths"], root))
 
     if paths:
         wanted = [

@@ -1,10 +1,15 @@
-"""CLI: ``python -m tools.speclint [--format text|json] [paths...]``.
+"""CLI: ``python -m tools.speclint [--format text|json|sarif]
+[--changed] [--report PATH] [paths...]``.
 
 Exit status: 0 when every finding is allowlisted (or there are none),
 1 when non-allowlisted findings remain, 2 on a malformed allowlist.
 
-``--write-forkdiff [PATH]`` renders docs/FORKDIFF.md from the fork-diff
-machinery and exits (0) without linting.
+``--changed`` scopes the run to files touched relative to git HEAD
+(staged, unstaged, and untracked) — the fast pre-push pass wired into
+``make bench-smoke``.  ``--report PATH`` additionally writes the full
+JSON report to PATH regardless of ``--format`` (the gate's failure
+artifact).  ``--write-forkdiff [PATH]`` renders docs/FORKDIFF.md from
+the fork-diff machinery and exits (0) without linting.
 """
 
 from __future__ import annotations
@@ -12,10 +17,100 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from . import REPO_ROOT, AllowlistError, run
 from .forkdiff import render_forkdiff
+
+
+def changed_paths(root: str) -> "list[str] | None":
+    """Repo files touched vs HEAD (staged + unstaged + untracked), or
+    None when git is unusable (fall back to a full run — a broken
+    scoping probe must widen the net, never narrow it)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    names = set(diff.stdout.split()) | set(untracked.stdout.split())
+    out = []
+    for name in sorted(names):
+        abspath = os.path.join(root, name)
+        if os.path.exists(abspath):
+            out.append(abspath)
+    return out
+
+
+_SARIF_LEVELS = {False: "error", True: "note"}
+
+
+def to_sarif(findings: list) -> dict:
+    """Minimal SARIF 2.1.0 document — one run, one result per finding,
+    allowlisted findings demoted to ``note`` with the justification
+    attached so review UIs show WHY the exception stands."""
+    rules: dict = {}
+    results = []
+    for f in findings:
+        rules.setdefault(
+            f.rule,
+            {
+                "id": f.rule,
+                "shortDescription": {"text": f.rule},
+                **({"help": {"text": f.hint}} if f.hint else {}),
+            },
+        )
+        message = f.message
+        if f.allowlisted and f.justification:
+            message += f" [allowlisted: {f.justification}]"
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": _SARIF_LEVELS[f.allowlisted],
+                "message": {"text": message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {"startLine": max(f.line, 1)},
+                        }
+                    }
+                ],
+                "partialFingerprints": {
+                    "speclintSymbol": f"{f.rule}:{f.path}:{f.symbol}"
+                },
+            }
+        )
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "speclint",
+                        "informationUri": "docs/SPECLINT.md",
+                        "rules": sorted(rules.values(), key=lambda r: r["id"]),
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def main(argv: "list | None" = None) -> int:
@@ -31,9 +126,19 @@ def main(argv: "list | None" = None) -> int:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
         help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help="scope to files changed vs git HEAD (staged+unstaged+untracked)",
+    )
+    parser.add_argument(
+        "--report",
+        metavar="PATH",
+        help="also write the full JSON report to PATH (gate failure artifact)",
     )
     parser.add_argument(
         "--all",
@@ -58,25 +163,37 @@ def main(argv: "list | None" = None) -> int:
         print(f"wrote {args.write_forkdiff}")
         return 0
 
+    paths = list(args.paths)
+    if args.changed:
+        scoped = changed_paths(REPO_ROOT)
+        if scoped is not None:
+            if not scoped:
+                print("speclint: no files changed vs HEAD — nothing to lint")
+                return 0
+            paths.extend(scoped)
+
     try:
-        findings = run(paths=args.paths or None)
+        findings = run(paths=paths or None)
     except AllowlistError as exc:
         print(f"speclint: allowlist error: {exc}", file=sys.stderr)
         return 2
 
     open_findings = [f for f in findings if not f.allowlisted]
 
+    report = {
+        "findings": [f.to_dict() for f in findings],
+        "open": len(open_findings),
+        "allowlisted": len(findings) - len(open_findings),
+    }
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+
     if args.format == "json":
-        print(
-            json.dumps(
-                {
-                    "findings": [f.to_dict() for f in findings],
-                    "open": len(open_findings),
-                    "allowlisted": len(findings) - len(open_findings),
-                },
-                indent=2,
-            )
-        )
+        print(json.dumps(report, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(findings), indent=2))
     else:
         shown = findings if args.all else open_findings
         for finding in shown:

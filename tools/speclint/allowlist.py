@@ -1,9 +1,12 @@
 """Checked-in exceptions: ``tools/speclint/allowlist.toml``.
 
 Each ``[[allow]]`` entry names a (rule, path, symbol) triple plus a
-REQUIRED human justification. Matching is by symbol, not line number, so
-ordinary edits never stale an entry; an entry that matches nothing is
-itself reported (``speclint/stale-allowlist``) so the file cannot rot.
+REQUIRED human justification AND a REQUIRED citation — a pointer into
+the spec or the repo docs that backs the justification up (an exception
+nobody can check is an exception nobody will ever remove). Matching is
+by symbol, not line number, so ordinary edits never stale an entry; an
+entry that matches nothing is itself reported
+(``speclint/stale-allowlist``) so the file cannot rot.
 
 The interpreter here is 3.10 (no ``tomllib``) and the repo vendors no
 third-party TOML reader, so ``_parse_toml_tables`` implements the tiny
@@ -65,7 +68,7 @@ def _parse_toml_tables(text: str, table_name: str, where: str) -> list[dict]:
 class Allowlist:
     """Entries loaded from disk plus per-entry use tracking."""
 
-    REQUIRED_KEYS = ("rule", "path", "symbol", "justification")
+    REQUIRED_KEYS = ("rule", "path", "symbol", "justification", "citation")
 
     def __init__(self, entries: list[dict], where: str = "<allowlist>"):
         for i, entry in enumerate(entries):
@@ -75,7 +78,7 @@ class Allowlist:
                         f"{where}: entry {i + 1} "
                         f"({entry.get('rule', '?')} @ {entry.get('path', '?')}) "
                         f"is missing required key {key!r} — every exception "
-                        "needs a justification"
+                        "needs a justification and a spec/doc citation"
                     )
         self.entries = entries
         self.where = where

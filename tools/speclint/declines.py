@@ -1,0 +1,381 @@
+"""Declines analyzer: no routed path may degrade silently.
+
+The repo's contract for every routed fast path (columnar engines, mesh
+offloads, device pairing, warm proofs, pool admission) is that a decline
+is a *routing decision*, not an incident: it increments a per-reason
+counter, lands in the routing journal while the observatory is on, and
+fires a one-shot trace event (``ops_vector.fallback`` set the idiom;
+``mesh.decline`` added re-arm-on-change). A decline that only ``pass``es
+an exception or quietly ``return``s under a threshold is invisible in
+bench evidence — the exact failure mode the observatory exists to kill.
+
+Scope: modules that participate in routing — any module whose AST
+increments a ``*.fallback.*`` / ``*.decline.*`` / ``*.rejected.*``
+counter or writes the routing journal (``.route(...)`` on the device
+observatory). The seam module itself (``telemetry/device.py``) is
+excluded.
+
+Rules:
+
+* ``declines/silent-except`` — a *broad* ``except`` (bare /
+  ``Exception`` / ``BaseException``) on a routed module whose body
+  neither calls anything nor re-raises (only ``pass`` / ``return`` /
+  ``continue`` / plain assignments), in a function that records nothing
+  anywhere. Three idioms are deliberately exempt: handlers that reach a
+  counter/journal/trace call or raise; *typed* catches (a named
+  exception tuple is a contract — the caller records the decline, the
+  ``ops_vector`` column-probe pattern); and import probes (``try:
+  import numpy`` — no-dependency is configuration, not a decline); plus
+  any handler whose enclosing function records observability elsewhere
+  (the sentinel-then-count pattern, ``pool.membership_batch_failures``).
+* ``declines/silent-threshold-return`` — an ``if`` comparing against a
+  threshold-named value (a ``min``/``max``/``threshold``/``limit``
+  identifier *segment*, so ``BATCH_MIN_ATTESTATIONS`` and ``min_n``
+  match but ``vmax`` value-range checks don't) whose body returns
+  without making a single call. The deliberate below-threshold declines
+  are part of the documented taxonomy precisely because they used to be
+  silent — the guard body itself must record before returning.
+* ``declines/undocumented-reason`` — a literal decline reason passed to
+  a known fallback/decline helper (or baked into a literal
+  ``*.fallback.*`` counter name) that does not appear in
+  ``docs/OBSERVABILITY.md``. The per-reason taxonomy in the metric
+  tables is the contract bench evidence is read against; an
+  undocumented reason is an unreadable verdict.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .base import Finding, SourceModule
+
+_DECLINE_MARKERS = (".fallback.", ".decline.", ".rejected.")
+_THRESHOLD_SEGMENTS = {"min", "max", "threshold", "limit"}
+_OBS_CALL_RE = re.compile(r"fallback|decline|reject|route", re.IGNORECASE)
+_OBS_CALL_NAMES = {"counter", "gauge", "histogram", "event", "route"}
+_SEAM_PATH = "ethereum_consensus_tpu/telemetry/device.py"
+_DOC_RELPATH = os.path.join("docs", "OBSERVABILITY.md")
+
+
+def _counter_name_node(call: ast.Call) -> "ast.AST | None":
+    """The name expression of ``[<mod>.]counter(<name>)...``, else None."""
+    f = call.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if fname == "counter" and call.args:
+        return call.args[0]
+    return None
+
+
+def _joined_str_parts(node: ast.JoinedStr) -> "tuple[str, list]":
+    """Literal text of an f-string plus the Name ids it interpolates."""
+    text = ""
+    names = []
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            text += part.value
+        elif isinstance(part, ast.FormattedValue):
+            if isinstance(part.value, ast.Name):
+                names.append(part.value.id)
+            text += "{}"
+    return text, names
+
+
+def _is_decline_counter(name_node: ast.AST) -> "tuple[bool, str, list]":
+    """(is decline counter, literal text, interpolated names)."""
+    if isinstance(name_node, ast.Constant) and isinstance(name_node.value, str):
+        text = name_node.value
+        return any(m in text for m in _DECLINE_MARKERS), text, []
+    if isinstance(name_node, ast.JoinedStr):
+        text, names = _joined_str_parts(name_node)
+        return any(m in text for m in _DECLINE_MARKERS), text, names
+    return False, "", []
+
+
+def _module_is_routed(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name_node = _counter_name_node(node)
+        if name_node is not None and _is_decline_counter(name_node)[0]:
+            return True
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "route":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# helper discovery (pass 1, package-wide)
+# ---------------------------------------------------------------------------
+
+
+def collect_reason_helpers(modules: list) -> dict:
+    """Map helper name -> index of its reason parameter, discovered from
+    every function whose body increments a decline counter interpolating
+    one of its own parameters. Names with conflicting indices across
+    modules are dropped (no guessing)."""
+    helpers: dict = {}
+    conflicted: set = set()
+    for src in modules:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name_node = _counter_name_node(sub)
+                if name_node is None:
+                    continue
+                is_decline, _text, names = _is_decline_counter(name_node)
+                if not is_decline:
+                    continue
+                for interp in names:
+                    if interp in params:
+                        idx = params.index(interp)
+                        prior = helpers.get(node.name)
+                        if prior is not None and prior != (idx, interp):
+                            conflicted.add(node.name)
+                        helpers[node.name] = (idx, interp)
+    for name in conflicted:
+        helpers.pop(name, None)
+    return helpers
+
+
+# ---------------------------------------------------------------------------
+# per-module rules (pass 2)
+# ---------------------------------------------------------------------------
+
+
+def _has_call_or_raise(stmts: list) -> bool:
+    for stmt in stmts:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Call, ast.Raise)):
+                return True
+    return False
+
+
+def _qualname_at(tree: ast.Module, target: ast.AST) -> str:
+    """Dotted name of the function enclosing ``target`` (for symbols)."""
+    path: list = []
+
+    def rec(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if child is target:
+                path.extend(chain)
+                return True
+            next_chain = chain
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                next_chain = chain + [child.name]
+            if rec(child, next_chain):
+                return True
+        return False
+
+    rec(tree, [])
+    return ".".join(path) or "<module>"
+
+
+def _threshold_named(test: ast.AST) -> "str | None":
+    for sub in ast.walk(test):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident and _THRESHOLD_SEGMENTS & set(ident.lower().split("_")):
+            return ident
+    return None
+
+
+def _is_broad_catch(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in exprs:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None
+        )
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _records_observability(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name and (name in _OBS_CALL_NAMES or _OBS_CALL_RE.search(name)):
+            return True
+    return False
+
+
+def _is_import_probe(try_node: ast.Try) -> bool:
+    """``try: import X ...`` — the probe idiom LEADS with the import; a
+    lazy import buried mid-body does not turn device work into a probe."""
+    return bool(try_node.body) and isinstance(
+        try_node.body[0], (ast.Import, ast.ImportFrom)
+    )
+
+
+def _check_silent_excepts(src: SourceModule, findings: list) -> None:
+    """Per function: flag broad silent handlers only when the function
+    as a whole records nothing (sentinel-then-count is fine)."""
+
+    def check_scope(scope_body: list, scope_node: ast.AST) -> None:
+        func_records = _records_observability(scope_node)
+        nested_tries: set = set()
+        for node in scope_body:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested_tries.update(
+                        id(t) for t in ast.walk(sub) if isinstance(t, ast.Try)
+                    )
+        for node in scope_body:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Try) or id(sub) in nested_tries:
+                    continue
+                if _is_import_probe(sub):
+                    continue
+                for handler in sub.handlers:
+                    if (
+                        _is_broad_catch(handler)
+                        and not _has_call_or_raise(handler.body)
+                        and not func_records
+                    ):
+                        findings.append(
+                            Finding(
+                                rule="declines/silent-except",
+                                path=src.path,
+                                line=handler.lineno,
+                                symbol=_qualname_at(src.tree, handler),
+                                message=(
+                                    "broad except on a routed module swallows "
+                                    "the error with no counter, journal, or "
+                                    "trace call anywhere in the function — a "
+                                    "silent fallback"
+                                ),
+                                hint=(
+                                    "reach the module's fallback()/decline() "
+                                    "helper (counter + one-shot event + "
+                                    "routing journal), re-raise, or narrow "
+                                    "the catch to the typed exceptions the "
+                                    "caller's decline path expects"
+                                ),
+                            )
+                        )
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            check_scope(node.body, node)
+
+
+def _check_module(src: SourceModule, helpers: dict, doc_text: str, findings: list):
+    routed = _module_is_routed(src.tree)
+    if routed:
+        _check_silent_excepts(src, findings)
+
+    for node in ast.walk(src.tree):
+        if routed and isinstance(node, ast.If):
+            ident = _threshold_named(node.test)
+            has_return = any(isinstance(s, ast.Return) for s in node.body)
+            if ident and has_return and not _has_call_or_raise(node.body):
+                findings.append(
+                    Finding(
+                        rule="declines/silent-threshold-return",
+                        path=src.path,
+                        line=node.lineno,
+                        symbol=f"{_qualname_at(src.tree, node)}/{ident}",
+                        message=(
+                            f"threshold guard on {ident!r} returns without "
+                            "recording the decline — below-threshold routing "
+                            "decisions are part of the documented taxonomy"
+                        ),
+                        hint=(
+                            "call the fallback()/decline() helper with a "
+                            "reason (the below_threshold idiom) before "
+                            "returning"
+                        ),
+                    )
+                )
+
+        # undocumented-reason applies package-wide (helpers are called
+        # cross-module: models/* call ops_vector.fallback)
+        if isinstance(node, ast.Call):
+            reasons = _literal_reasons(node, helpers)
+            for reason in reasons:
+                if _reason_documented(reason, doc_text):
+                    continue
+                findings.append(
+                    Finding(
+                        rule="declines/undocumented-reason",
+                        path=src.path,
+                        line=node.lineno,
+                        symbol=reason,
+                        message=(
+                            f"decline reason {reason!r} is not in the "
+                            "docs/OBSERVABILITY.md taxonomy — bench evidence "
+                            "carrying it cannot be read against the contract"
+                        ),
+                        hint=(
+                            "add the reason to the metric's documented "
+                            "reason list in docs/OBSERVABILITY.md"
+                        ),
+                    )
+                )
+
+
+def _literal_reasons(call: ast.Call, helpers: dict) -> list:
+    """Literal reason strings this call records, if any."""
+    out = []
+    f = call.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if fname in helpers:
+        idx, pname = helpers[fname]
+        arg = None
+        if idx < len(call.args):
+            arg = call.args[idx]
+        for kw in call.keywords:
+            if kw.arg == pname:
+                arg = kw.value
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append(arg.value)
+    name_node = _counter_name_node(call)
+    if name_node is not None and isinstance(name_node, ast.Constant):
+        is_decline, text, _names = _is_decline_counter(name_node)
+        if is_decline:
+            out.append(text.rsplit(".", 1)[1])
+    return out
+
+
+def _reason_documented(reason: str, doc_text: str) -> bool:
+    return f"`{reason}`" in doc_text or re.search(
+        rf"\b{re.escape(reason)}\b", doc_text
+    ) is not None
+
+
+def analyze(paths: list, root: str, doc_path: "str | None" = None) -> list:
+    doc_path = doc_path or os.path.join(root, _DOC_RELPATH)
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc_text = fh.read()
+    except OSError:
+        doc_text = ""
+    modules = [SourceModule.load(p, root) for p in paths]
+    helpers = collect_reason_helpers(modules)
+    findings: list = []
+    for src in modules:
+        if src.path == _SEAM_PATH:
+            continue
+        _check_module(src, helpers, doc_text, findings)
+    return findings

@@ -1,0 +1,500 @@
+"""Device-discipline analyzer: recompile hazards and transfer seams.
+
+The million-validator hot paths only stay hot under two disciplines the
+type system can't hold:
+
+* **Compile once.** Every ``jax.jit`` must be staged where it runs once
+  per process (module level), once per cache key (a
+  ``functools.lru_cache`` factory — the ``parallel/epoch.py`` idiom), or
+  through the one blessed lazy-staging function (``jitted_kernels()``,
+  the ``epoch_vector`` idiom: dict + lock + ``observe_jit``). A jit
+  built inside a plain function recompiles on every call and silently
+  eats the win the kernel bought; inside a loop it is strictly worse.
+* **Every byte crosses at a ledgered seam.** Host↔device transfers go
+  through ``telemetry/device.py``'s ``h2d``/``d2h``/``h2d_put``
+  chokepoints so the observatory attributes them. A raw ``jnp.asarray``
+  on the host side or ``jax.device_put`` anywhere else moves bytes the
+  memory/bandwidth report can't see.
+
+Rules:
+
+* ``device/jit-outside-staging`` — a ``jax.jit`` (call or decorator)
+  inside a plain function body, or inside a ``for``/``while`` loop with
+  no enclosing ``lru_cache``. Blessed contexts: module level; any
+  enclosing function decorated ``functools.lru_cache``/``cache``; any
+  enclosing function named ``jitted_kernels``.
+* ``device/varying-static-jit-arg`` — a value derived from ``len()`` /
+  ``.shape`` / ``.size`` reaching a ``static_argnames``/``static_argnums``
+  position of a module-known jitted callable. Each distinct value is a
+  full recompile; raw sizes vary per call. Passing it through
+  ``.bit_length()`` first clears the taint — log-bounded statics (the
+  ``levels``/``depth`` idiom) compile at most log2(N) variants.
+* ``device/shape-branch-in-kernel`` — Python ``if``/``while`` on
+  ``.shape``/``.ndim``/``.size``/``len()`` (or a local derived from
+  them) inside a kernel body. Trace-time shape branches mint a hidden
+  per-shape kernel family that defeats the pad-and-bucket discipline.
+  A branch whose body only ``raise``s is exempt — that is the standard
+  trace-time shape *guard*, not a specialization.
+* ``device/unledgered-transfer`` — ``jax.device_put`` outside
+  ``telemetry/device.py``; ``jnp.asarray``/``jnp.array`` on host paths
+  outside ``ops/`` (the device-resident math layer stages constants
+  freely — its entry seams are already instrumented) and outside kernel
+  bodies (tracer-to-tracer, free); ``np.asarray`` applied to a value
+  produced by a ``jnp.*`` call or a known jitted callable (a d2h sync
+  the ledger never sees).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Finding, SourceModule
+
+_BLESSED_STAGING_NAMES = {"jitted_kernels"}
+_SHAPE_ATTRS = {"shape", "ndim", "size"}
+_JNP_NAMES = {"jnp"}
+_NP_NAMES = {"np", "numpy", "_np"}
+_TRANSFER_SEAM_PATH = "ethereum_consensus_tpu/telemetry/device.py"
+_OPS_PREFIX = "ethereum_consensus_tpu/ops/"
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """``jax.jit`` / ``_jax.jit`` / bare ``jit`` (however aliased —
+    the attribute name is the signal, not the module binding)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _is_kernel_wrapper_ref(node: ast.AST) -> bool:
+    """jit or the tracing transforms whose first argument is a kernel."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    return name in ("jit", "shard_map", "pmap", "vmap")
+
+
+def _has_lru_cache(node: ast.AST) -> bool:
+    for dec in node.decorator_list:
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Attribute) and sub.attr in ("lru_cache", "cache"):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in ("lru_cache", "cache"):
+                return True
+    return False
+
+
+def _contains(node: ast.AST, pred) -> bool:
+    return any(pred(sub) for sub in ast.walk(node))
+
+
+def _expr_shape_tainted(expr: ast.AST, tainted: set) -> bool:
+    """Does the expression carry a per-call-varying size? ``.bit_length()``
+    anywhere in it clears the taint — the result is log-bounded."""
+    for sub in ast.walk(expr):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "bit_length"
+        ):
+            return False
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
+            return True
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "len"
+        ):
+            return True
+        if isinstance(sub, ast.Name) and sub.id in tainted:
+            return True
+    return False
+
+
+def _assign_targets(node: ast.AST) -> list:
+    """Name targets of an Assign/AnnAssign/AugAssign, tuples flattened."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and getattr(
+        node, "value", None
+    ) is not None:
+        targets = [node.target]
+    out = []
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+    return out
+
+
+class _ModuleFacts:
+    """Module-wide pass: jitted symbols, their static args, kernel names."""
+
+    def __init__(self, tree: ast.Module):
+        # name -> (static_argnames frozenset, static_argnums frozenset)
+        self.static_args: dict = {}
+        # every module symbol bound to a jit/observe_jit result
+        self.jitted_names: set = set()
+        # functions traced as kernels: passed to jit/shard_map/...
+        self.kernel_arg_names: set = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_kernel_wrapper_ref(node.func):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.kernel_arg_names.add(node.args[0].id)
+            if isinstance(node, ast.Assign):
+                statics = self._statics_in(node.value)
+                produces_jit = _contains(node.value, _is_jit_ref)
+                for name in _assign_targets(node):
+                    if produces_jit:
+                        self.jitted_names.add(name)
+                    if statics is not None:
+                        self.static_args[name] = statics
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics = self._statics_in(dec)
+                    if statics is not None:
+                        self.static_args[node.name] = statics
+                        self.jitted_names.add(node.name)
+
+    @staticmethod
+    def _statics_in(expr: ast.AST) -> "tuple | None":
+        """(static_argnames, static_argnums) from any jit call inside
+        ``expr`` (unwraps observe_jit / partial nesting), or None."""
+        for sub in ast.walk(expr):
+            if not (isinstance(sub, ast.Call) and _contains(sub.func, _is_jit_ref)):
+                continue
+            names: set = set()
+            nums: set = set()
+            found = False
+            for kw in sub.keywords:
+                if kw.arg == "static_argnames":
+                    found = True
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                            names.add(c.value)
+                elif kw.arg == "static_argnums":
+                    found = True
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                            nums.add(c.value)
+            if found and (names or nums):
+                return (frozenset(names), frozenset(nums))
+        return None
+
+
+def _is_kernel_def(node, facts: _ModuleFacts) -> bool:
+    if node.name.endswith("_kernel") or node.name in facts.kernel_arg_names:
+        return True
+    return any(_contains(dec, _is_jit_ref) for dec in node.decorator_list)
+
+
+# ---------------------------------------------------------------------------
+# rule walkers
+# ---------------------------------------------------------------------------
+
+
+class _Walker:
+    """One lexical pass carrying the function/loop/kernel context stacks."""
+
+    def __init__(self, src: SourceModule, facts: _ModuleFacts, findings: list):
+        self.src = src
+        self.facts = facts
+        self.findings = findings
+        # stack of (name, blessed_staging, lru_cached)
+        self.funcs: list = []
+        self.loop_depth = 0
+        self.kernel_depth = 0
+        # per innermost function: shape-tainted locals, device-produced locals
+        self.taint_stack: list = []
+        self.device_stack: list = []
+
+    # -- helpers -------------------------------------------------------------
+
+    def _qualname(self) -> str:
+        return ".".join(name for name, _b, _l in self.funcs) or "<module>"
+
+    def _emit(self, rule, line, symbol, message, hint):
+        self.findings.append(
+            Finding(
+                rule=rule, path=self.src.path, line=line, symbol=symbol,
+                message=message, hint=hint,
+            )
+        )
+
+    def _staging_blessed(self) -> bool:
+        return not self.funcs or any(b or l for _n, b, l in self.funcs)
+
+    def _lru_enclosed(self) -> bool:
+        return any(l for _n, _b, l in self.funcs)
+
+    @property
+    def _taint(self) -> set:
+        return self.taint_stack[-1] if self.taint_stack else set()
+
+    @property
+    def _device_locals(self) -> set:
+        return self.device_stack[-1] if self.device_stack else set()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def walk(self, node: ast.AST) -> None:
+        method = getattr(self, f"_visit_{type(node).__name__}", None)
+        if method is not None:
+            method(node)
+        else:
+            self._generic(node)
+
+    def _generic(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.walk(child)
+
+    # -- context frames ------------------------------------------------------
+
+    def _visit_FunctionDef(self, node) -> None:
+        # decorators evaluate in the ENCLOSING context — an @jax.jit on a
+        # nested def inside a plain function is a per-call jit
+        for dec in node.decorator_list:
+            self.walk(dec)
+        is_kernel = _is_kernel_def(node, self.facts)
+        self.funcs.append(
+            (node.name, node.name in _BLESSED_STAGING_NAMES, _has_lru_cache(node))
+        )
+        self.taint_stack.append(set())
+        self.device_stack.append(set())
+        if is_kernel:
+            self.kernel_depth += 1
+        saved_loops = self.loop_depth
+        self.loop_depth = 0
+        for stmt in node.body:
+            self.walk(stmt)
+        self.loop_depth = saved_loops
+        if is_kernel:
+            self.kernel_depth -= 1
+        self.device_stack.pop()
+        self.taint_stack.pop()
+        self.funcs.pop()
+
+    _visit_AsyncFunctionDef = _visit_FunctionDef
+
+    def _visit_For(self, node) -> None:
+        self.loop_depth += 1
+        self._generic(node)
+        self.loop_depth -= 1
+
+    _visit_While_body = None  # (the While handler below also checks rule 3)
+
+    # -- assignments: taint + device-local tracking --------------------------
+
+    def _visit_Assign(self, node) -> None:
+        self._track_assign(node)
+        self._generic(node)
+
+    def _visit_AnnAssign(self, node) -> None:
+        self._track_assign(node)
+        self._generic(node)
+
+    def _visit_AugAssign(self, node) -> None:
+        self._track_assign(node)
+        self._generic(node)
+
+    def _track_assign(self, node) -> None:
+        if not self.taint_stack or getattr(node, "value", None) is None:
+            return
+        names = _assign_targets(node)
+        if not names:
+            return
+        if _expr_shape_tainted(node.value, self._taint):
+            self._taint.update(names)
+        else:
+            self.taint_stack[-1].difference_update(names)
+        if self._is_device_producing(node.value):
+            self._device_locals.update(names)
+        else:
+            self.device_stack[-1].difference_update(names)
+
+    def _is_device_producing(self, expr: ast.AST) -> bool:
+        """Does the expression come off the device? A ``jnp.*`` call or a
+        call of a module symbol bound to a jit."""
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in _JNP_NAMES
+            ):
+                return True
+            if isinstance(f, ast.Name) and f.id in self.facts.jitted_names:
+                return True
+        return False
+
+    # -- branches (rule 3) ---------------------------------------------------
+
+    def _shape_branch(self, node, kind: str) -> None:
+        if self.kernel_depth == 0:
+            return
+        if not _expr_shape_tainted(node.test, self._taint):
+            return
+        if kind == "if" and all(isinstance(s, ast.Raise) for s in node.body):
+            return  # trace-time shape guard, the sanctioned idiom
+        self._emit(
+            "device/shape-branch-in-kernel",
+            node.lineno,
+            self._qualname(),
+            f"python `{kind}` on a shape-derived value inside a kernel "
+            "body — every distinct shape mints another trace-time "
+            "specialization behind the pad-and-bucket discipline",
+            "hoist the branch to the host caller (pick the kernel variant "
+            "before staging), or make it a guard that only raises",
+        )
+
+    def _visit_If(self, node) -> None:
+        self._shape_branch(node, "if")
+        self._generic(node)
+
+    def _visit_While(self, node) -> None:
+        self._shape_branch(node, "while")
+        self.loop_depth += 1
+        self._generic(node)
+        self.loop_depth -= 1
+
+    # -- calls (rules 1, 2, 4) -----------------------------------------------
+
+    def _visit_Call(self, node) -> None:
+        self._check_jit_staging(node)
+        self._check_static_args(node)
+        self._check_transfer(node)
+        self._generic(node)
+
+    def _check_jit_staging(self, node) -> None:
+        if not _is_jit_ref(node.func):
+            return
+        if self.loop_depth and not self._lru_enclosed():
+            self._emit(
+                "device/jit-outside-staging",
+                node.lineno,
+                self._qualname(),
+                "jax.jit inside a loop — a fresh jit (and a fresh "
+                "compile cache) per iteration",
+                "hoist the jit out of the loop, or build the family once "
+                "inside an lru_cache factory keyed on the loop variable",
+            )
+        elif not self._staging_blessed():
+            self._emit(
+                "device/jit-outside-staging",
+                node.lineno,
+                self._qualname(),
+                "jax.jit built inside a plain function — recompiles on "
+                "every call instead of once per process",
+                "stage at module level, inside a functools.lru_cache "
+                "factory (the parallel/epoch.py idiom), or through "
+                "jitted_kernels() (the epoch_vector idiom)",
+            )
+
+    def _check_static_args(self, node) -> None:
+        if not isinstance(node.func, ast.Name):
+            return
+        statics = self.facts.static_args.get(node.func.id)
+        if statics is None:
+            return
+        names, nums = statics
+        suspects = []
+        for kw in node.keywords:
+            if kw.arg in names and _expr_shape_tainted(kw.value, self._taint):
+                suspects.append((kw.value, kw.arg))
+        for idx in nums:
+            if idx < len(node.args) and _expr_shape_tainted(
+                node.args[idx], self._taint
+            ):
+                suspects.append((node.args[idx], f"arg {idx}"))
+        for expr, which in suspects:
+            self._emit(
+                "device/varying-static-jit-arg",
+                node.lineno,
+                f"{self._qualname()}/{node.func.id}",
+                f"per-call-varying size reaches static jit arg {which} of "
+                f"{node.func.id} — every distinct value is a full XLA "
+                "recompile",
+                "bucket the value (the `.bit_length()` levels/depth idiom "
+                "keeps statics log-bounded), or make the argument traced",
+            )
+
+    def _check_transfer(self, node) -> None:
+        if self.src.path == _TRANSFER_SEAM_PATH:
+            return
+        f = node.func
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        base = (
+            f.value.id
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            else None
+        )
+        if attr == "device_put":
+            self._emit(
+                "device/unledgered-transfer",
+                node.lineno,
+                self._qualname(),
+                "raw jax.device_put — an h2d placement the transfer "
+                "ledger never records",
+                "route through telemetry.device h2d_put (sharded) or h2d "
+                "(replicated); the seam records bytes and nanoseconds",
+            )
+            return
+        in_ops = self.src.path.startswith(_OPS_PREFIX)
+        if (
+            attr in ("asarray", "array")
+            and base in _JNP_NAMES
+            and not in_ops
+            and self.kernel_depth == 0
+        ):
+            self._emit(
+                "device/unledgered-transfer",
+                node.lineno,
+                self._qualname(),
+                f"raw jnp.{attr} on a host path — an h2d upload outside "
+                "the instrumented chokepoint",
+                "route through telemetry.device h2d(site, *arrays); "
+                "inside jit-traced bodies it is tracer-to-tracer and free",
+            )
+            return
+        if attr == "asarray" and base in _NP_NAMES and not in_ops:
+            arg = node.args[0] if node.args else None
+            is_d2h = False
+            if isinstance(arg, ast.Name) and arg.id in self._device_locals:
+                is_d2h = True
+            elif arg is not None and not isinstance(arg, ast.Name):
+                is_d2h = self._is_device_producing(arg)
+            if is_d2h:
+                self._emit(
+                    "device/unledgered-transfer",
+                    node.lineno,
+                    self._qualname(),
+                    "np.asarray of a device-produced value — a blocking "
+                    "d2h sync outside the instrumented chokepoint",
+                    "route through telemetry.device d2h(site, array) so "
+                    "the ledger sees the bytes and the stall",
+                )
+
+
+def analyze_file(abspath: str, root: str) -> list:
+    src = SourceModule.load(abspath, root)
+    facts = _ModuleFacts(src.tree)
+    findings: list = []
+    walker = _Walker(src, facts, findings)
+    for node in src.tree.body:
+        walker.walk(node)
+    return findings
+
+
+def analyze(paths: list, root: str) -> list:
+    findings: list = []
+    for path in paths:
+        findings.extend(analyze_file(path, root))
+    return findings

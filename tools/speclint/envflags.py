@@ -1,0 +1,317 @@
+"""speclint analyzer: environment-flag discipline.
+
+Every behavioural knob in the package is an ``EC_*``/``ECT_*``
+environment variable, and all of them are supposed to flow through the
+central readers in ``ethereum_consensus_tpu/_env.py`` — that module
+imports nothing but the stdlib, which is what makes the "plain env
+read before jax import" guarantee auditable (a mesh-off process must
+be able to evaluate its gates without ever paying for jax).  This
+analyzer keeps the funnel honest:
+
+* ``envflags/scattered-env-read`` — a raw ``os.environ.get`` /
+  ``os.getenv`` / ``os.environ[...]`` read anywhere outside
+  ``_env.py``.  Scattered reads are how normalization drifts (one site
+  strips+lowers, the next does not) and how undocumented flags land.
+* ``envflags/unknown-key`` — an ``_env.<reader>(key)`` call whose key
+  resolves to a literal that is not registered in ``_env.KNOWN_KEYS``.
+  The registry is the package's flag inventory; reading an
+  unregistered key bypasses it.
+* ``envflags/undocumented-key`` — a ``KNOWN_KEYS`` entry that never
+  appears in docs/OBSERVABILITY.md (the environment-flags table).
+* ``envflags/eager-jax-import`` — a module-level jax import outside
+  the blessed accelerator dirs (``ops/``, ``parallel/``).  Host
+  modules gate jax behind flags; an eager import defeats the gate for
+  every consumer of that module.
+* ``envflags/env-read-after-jax-import`` — in a host module, a
+  module-level env read placed after a top-level jax import.  The read
+  can no longer gate the import it follows.  (Inside the blessed jax
+  dirs this is moot — jax is the module's purpose — so the rule only
+  fires outside them, where rule 4 should already have fired.)
+
+Key resolution is static: literals, module-level constants, enclosing
+function parameters fed constants at module-local call sites, and
+``module._CONST`` attribute references resolved across the analyzed
+file set.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .base import Finding, SourceModule
+from .obscontract import _ModuleResolver
+
+_ENV_MODULE_SUFFIX = "ethereum_consensus_tpu/_env.py"
+_DOC_PATH = "docs/OBSERVABILITY.md"
+_READER_FUNCS = {
+    "raw",
+    "raw_or_none",
+    "mode",
+    "flag_off",
+    "flag_on",
+    "mesh_requested",
+    "override",
+}
+_JAX_DIR_MARKERS = ("/ops/", "/parallel/")
+_KEY_PREFIXES = ("EC_", "ECT_")
+
+
+def _is_env_module(path: str) -> bool:
+    return path.endswith(_ENV_MODULE_SUFFIX) or path.endswith("/_env.py")
+
+
+def _in_jax_dir(path: str) -> bool:
+    return any(marker in f"/{path}" for marker in _JAX_DIR_MARKERS)
+
+
+def _is_jax_import(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, ast.Import):
+        return any(
+            a.name == "jax" or a.name.startswith("jax.") for a in stmt.names
+        )
+    if isinstance(stmt, ast.ImportFrom):
+        mod = stmt.module or ""
+        return mod == "jax" or mod.startswith("jax.")
+    return False
+
+
+def _is_environ_expr(node: ast.AST) -> bool:
+    """``os.environ`` or a bare ``environ`` (from ``from os import environ``)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _environ_read(node: ast.AST) -> "ast.AST | None":
+    """The key expression when ``node`` reads the environment directly."""
+    if isinstance(node, ast.Call):
+        func = node.func
+        # os.getenv(key) / getenv(key)
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "getenv"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "os"
+        ) or (isinstance(func, ast.Name) and func.id == "getenv"):
+            return node.args[0] if node.args else ast.Constant(value="?")
+        # os.environ.get(key)
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "get"
+            and _is_environ_expr(func.value)
+        ):
+            return node.args[0] if node.args else ast.Constant(value="?")
+    # os.environ[key]
+    if isinstance(node, ast.Subscript) and _is_environ_expr(node.value):
+        return node.slice
+    return None
+
+
+class _PackageConstants:
+    """``module._CONST`` -> string values, across the analyzed set."""
+
+    def __init__(self, modules: "list[SourceModule]"):
+        self._by_name: "dict[str, set[str]]" = {}
+        for mod in modules:
+            for stmt in mod.tree.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    self._by_name.setdefault(stmt.targets[0].id, set()).add(
+                        stmt.value.value
+                    )
+
+    def resolve_attr(self, node: ast.Attribute) -> "list[str] | None":
+        vals = self._by_name.get(node.attr)
+        return sorted(vals) if vals else None
+
+
+def _known_keys(modules: "list[SourceModule]") -> "set[str] | None":
+    """The literal keys of ``_env.KNOWN_KEYS``, read out of the AST."""
+    for mod in modules:
+        if not _is_env_module(mod.path):
+            continue
+        for stmt in mod.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "KNOWN_KEYS"
+                and isinstance(stmt.value, ast.Dict)
+            ):
+                keys = set()
+                for k in stmt.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        keys.add(k.value)
+                return keys
+    return None
+
+
+def _resolve_key(
+    node: ast.AST,
+    resolver: _ModuleResolver,
+    pkg_consts: _PackageConstants,
+    func: "ast.FunctionDef | None",
+) -> "list[str] | None":
+    if isinstance(node, ast.Attribute):
+        return pkg_consts.resolve_attr(node)
+    return resolver.resolve(node, func)
+
+
+def analyze(
+    paths: "list[str]", root: str, doc_path: "str | None" = None
+) -> "list[Finding]":
+    modules = [SourceModule.load(p, root) for p in paths]
+    pkg_consts = _PackageConstants(modules)
+    known = _known_keys(modules)
+    findings: list[Finding] = []
+
+    for mod in modules:
+        is_env = _is_env_module(mod.path)
+        resolver = _ModuleResolver(mod.tree)
+        in_jax_dir = _in_jax_dir(mod.path)
+
+        # --- module-level ordering: jax imports vs env reads ------------
+        first_jax_line = None
+        for stmt in mod.tree.body:
+            if _is_jax_import(stmt):
+                first_jax_line = stmt.lineno
+                break
+        if first_jax_line is not None and not in_jax_dir and not is_env:
+            findings.append(
+                Finding(
+                    rule="envflags/eager-jax-import",
+                    path=mod.path,
+                    line=first_jax_line,
+                    symbol="<module>",
+                    message=(
+                        "module-level jax import outside the blessed "
+                        "accelerator dirs (ops/, parallel/)"
+                    ),
+                    hint="import jax lazily inside the gated function",
+                )
+            )
+
+        func_stack: list = []
+
+        def walk(node, mod=mod, resolver=resolver, func_stack=func_stack,
+                 first_jax_line=first_jax_line, in_jax_dir=in_jax_dir,
+                 is_env=is_env):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                func_stack.pop()
+                return
+            enclosing = func_stack[-1] if func_stack else None
+            symbol = enclosing.name if enclosing else "<module>"
+
+            key_expr = None if is_env else _environ_read(node)
+            if key_expr is not None:
+                keys = _resolve_key(key_expr, resolver, pkg_consts, enclosing)
+                shown = "/".join(keys) if keys else "<dynamic>"
+                findings.append(
+                    Finding(
+                        rule="envflags/scattered-env-read",
+                        path=mod.path,
+                        line=node.lineno,
+                        symbol=symbol,
+                        message=(
+                            f"direct environ read of '{shown}' bypasses the "
+                            "central _env readers"
+                        ),
+                        hint="use _env.raw/_env.mode/_env.flag_off/... instead",
+                    )
+                )
+
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _READER_FUNCS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "_env"
+            ):
+                if not in_jax_dir and not is_env:
+                    if (
+                        enclosing is None
+                        and first_jax_line is not None
+                        and node.lineno > first_jax_line
+                    ):
+                        findings.append(
+                            Finding(
+                                rule="envflags/env-read-after-jax-import",
+                                path=mod.path,
+                                line=node.lineno,
+                                symbol=symbol,
+                                message=(
+                                    "module-level env read placed after a "
+                                    "top-level jax import — it can no longer "
+                                    "gate that import"
+                                ),
+                                hint="read the flag above the jax import",
+                            )
+                        )
+                if node.args and known is not None:
+                    keys = _resolve_key(
+                        node.args[0], resolver, pkg_consts, enclosing
+                    )
+                    for key in keys or ():
+                        if key.startswith(_KEY_PREFIXES) and key not in known:
+                            findings.append(
+                                Finding(
+                                    rule="envflags/unknown-key",
+                                    path=mod.path,
+                                    line=node.lineno,
+                                    symbol=key,
+                                    message=(
+                                        f"env key '{key}' is not registered "
+                                        "in _env.KNOWN_KEYS"
+                                    ),
+                                    hint="add the key + meaning to KNOWN_KEYS",
+                                )
+                            )
+
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(mod.tree)
+
+    # --- registry -> docs ------------------------------------------------
+    doc_abspath = doc_path or os.path.join(root, _DOC_PATH)
+    if known is not None:
+        doc_text = ""
+        if os.path.exists(doc_abspath):
+            with open(doc_abspath, "r", encoding="utf-8") as f:
+                doc_text = f.read()
+        env_line = 1
+        for mod in modules:
+            if _is_env_module(mod.path):
+                env_path = mod.path
+                break
+        else:
+            env_path = _ENV_MODULE_SUFFIX
+        for key in sorted(known):
+            if key not in doc_text:
+                findings.append(
+                    Finding(
+                        rule="envflags/undocumented-key",
+                        path=env_path,
+                        line=env_line,
+                        symbol=key,
+                        message=(
+                            f"registered env key '{key}' has no row in "
+                            f"{_DOC_PATH}'s environment-flags table"
+                        ),
+                        hint="document the flag (values + effect + default)",
+                    )
+                )
+    return findings
+
+
+def analyze_file(abspath: str, root: str) -> "list[Finding]":
+    return analyze([abspath], root)

@@ -1,0 +1,580 @@
+"""speclint analyzer: the observability contract — code vs docs.
+
+docs/OBSERVABILITY.md promises that its metric table is THE inventory:
+every counter/gauge/histogram the package can emit has a row, and every
+row corresponds to a metric the code can actually emit. This analyzer
+machine-checks that promise in both directions, plus the prose contract
+for routing-journal kinds and one-shot trace events:
+
+* ``obscontract/undocumented-metric`` — a ``counter()``/``gauge()``/
+  ``histogram()`` name reachable from package code with no matching row
+  in the metric table.
+* ``obscontract/orphaned-doc-row`` — a metric-table row (after brace
+  expansion) that no code site can emit.  Orphans are how doc rot
+  starts: a renamed metric keeps its stale row forever unless something
+  diffs the two.
+* ``obscontract/undocumented-journal-kind`` — a ``route(kind, ...)``
+  call whose kind literal never appears in the doc.
+* ``obscontract/undocumented-trace-event`` — a ``trace.event(name)``
+  one-shot whose name never appears in the doc.
+
+Everything is plain AST over checked-in source (no imports).  Metric
+names built with f-strings become wildcard patterns; interpolated
+variables are resolved where statically possible (module constants,
+loops/comprehensions over literal tuples, enclosing-function parameters
+fed only literals at module-local call sites) so ``histogram(name)``
+inside a loop over ``(("pipeline.verify_s", ...), ...)`` counts as the
+exact names, not a match-everything ``*``.  Doc rows expand
+``{a,b}`` brace groups into each alternative and ``{placeholder}``
+into a wildcard; matching is symmetric (either side's wildcard may
+cover the other).
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+import os
+import re
+
+from .base import Finding, SourceModule
+
+_DOC_PATH = "docs/OBSERVABILITY.md"
+_DOC_GLOB_DIR = "docs"
+
+_METRIC_FUNCS = ("counter", "gauge", "histogram")
+_METRIC_BASES = {"metrics", "_metrics"}
+_TRACE_BASES = {"trace", "_trace"}
+
+# Emitting chokepoints: the registry itself, the trace/event forwarders,
+# and the routing-journal implementation.  Their *parameterized* calls
+# are the instrument, not an emission site.
+_CHOKEPOINT_SUFFIXES = (
+    "ethereum_consensus_tpu/telemetry/metrics.py",
+    "ethereum_consensus_tpu/utils/trace.py",
+    "ethereum_consensus_tpu/_device_flags.py",
+)
+
+_MAX_EXPANSIONS = 200
+
+
+# ---------------------------------------------------------------------------
+# wildcard patterns
+# ---------------------------------------------------------------------------
+
+
+def _pattern_regex(pattern: str) -> "re.Pattern[str]":
+    parts = [re.escape(p) for p in pattern.split("*")]
+    return re.compile(".+".join(parts) + r"\Z")
+
+
+def patterns_match(a: str, b: str) -> bool:
+    """Symmetric wildcard match: ``a`` covers ``b`` or ``b`` covers
+    ``a`` (``*`` = one-or-more characters)."""
+    return bool(_pattern_regex(a).match(b) or _pattern_regex(b).match(a))
+
+
+def expand_doc_pattern(text: str) -> list[str]:
+    """``device.route.mesh.{epoch,merkle}.{device,host}`` -> the four
+    concrete names; ``{reason}`` (no comma) -> ``*``.  Caps the product
+    at ``_MAX_EXPANSIONS`` by degrading remaining groups to wildcards."""
+    out = [""]
+    pos = 0
+    for m in re.finditer(r"\{([^{}]*)\}", text):
+        literal = text[pos : m.start()]
+        body = m.group(1)
+        options = [o.strip() for o in body.split(",")] if "," in body else ["*"]
+        if len(out) * len(options) > _MAX_EXPANSIONS:
+            options = ["*"]
+        out = [prefix + literal + o for prefix in out for o in options]
+        pos = m.end()
+    tail = text[pos:]
+    return [prefix + tail for prefix in out]
+
+
+# ---------------------------------------------------------------------------
+# doc side: parse the metric tables + the backtick-token corpus
+# ---------------------------------------------------------------------------
+
+
+class DocRow:
+    """One metric-table row: its expanded name patterns, the metric
+    kinds its kind cell admits, and where in which doc it lives."""
+
+    __slots__ = ("raw", "patterns", "kinds", "path", "line")
+
+    def __init__(self, raw, patterns, kinds, path, line):
+        self.raw = raw
+        self.patterns = patterns
+        self.kinds = kinds
+        self.path = path  # repo-relative doc path
+        self.line = line
+
+
+class DocContract:
+    """The union of every metric table across the contract docs, plus a
+    mention corpus (backtick tokens + raw text) for the journal-kind
+    and trace-event prose checks."""
+
+    def __init__(self):
+        self.rows: "list[DocRow]" = []
+        self.tokens: "set[str]" = set()
+        self.text = ""
+
+    def mentions(self, pattern: str) -> bool:
+        if "*" not in pattern:
+            return pattern in self.text or pattern in self.tokens
+        return any(patterns_match(pattern, tok) for tok in self.tokens)
+
+
+def _split_cells(line: str) -> list[str]:
+    return [c.strip() for c in line.strip().strip("|").split("|")]
+
+
+_NAME_HEADERS = ("name", "metric")
+_KIND_HEADERS = ("kind", "type")
+
+
+def _header_index(lowered: "list[str]", candidates) -> "int | None":
+    for cand in candidates:
+        if cand in lowered:
+            return lowered.index(cand)
+    return None
+
+
+def parse_doc(doc_abspath: str, doc_rel: str) -> "tuple[list[DocRow], set[str], str]":
+    """(metric-table rows, brace-expanded backtick tokens, raw text)."""
+    with open(doc_abspath, "r", encoding="utf-8") as f:
+        text = f.read()
+    lines = text.splitlines()
+
+    tokens: set[str] = set()
+    for span in re.findall(r"`([^`\n]+)`", text):
+        tokens.update(expand_doc_pattern(span))
+
+    rows: list[DocRow] = []
+    name_col = kind_col = None
+    for lineno, line in enumerate(lines, start=1):
+        if not line.lstrip().startswith("|"):
+            name_col = kind_col = None
+            continue
+        cells = _split_cells(line)
+        lowered = [c.lower() for c in cells]
+        maybe_name = _header_index(lowered, _NAME_HEADERS)
+        maybe_kind = _header_index(lowered, _KIND_HEADERS)
+        if maybe_name is not None and maybe_kind is not None:
+            name_col, kind_col = maybe_name, maybe_kind
+            continue
+        if name_col is None or set("".join(cells)) <= {"-", ":", ""}:
+            continue
+        if max(name_col, kind_col) >= len(cells):
+            continue
+        name_cell = cells[name_col]
+        kind_cell = cells[kind_col]
+        kinds = {
+            w for w in re.findall(r"[a-z]+", kind_cell.lower()) if w in _METRIC_FUNCS
+        }
+        if not kinds:
+            continue
+        patterns: list[str] = []
+        for span in re.findall(r"`([^`]+)`", name_cell):
+            patterns.extend(expand_doc_pattern(span))
+        if patterns:
+            rows.append(DocRow(name_cell, patterns, kinds, doc_rel, lineno))
+    return rows, tokens, text
+
+
+def load_contract(root: str, doc_paths: "list[str] | None" = None) -> "DocContract | None":
+    """Parse the contract docs: every ``docs/*.md`` that carries a
+    metric table contributes rows and mention text (OBSERVABILITY.md
+    always participates — an empty table there is itself a violation).
+    Returns None when the primary doc is missing entirely."""
+    primary = os.path.join(root, _DOC_PATH)
+    if doc_paths is None:
+        doc_dir = os.path.join(root, _DOC_GLOB_DIR)
+        doc_paths = sorted(
+            os.path.join(doc_dir, n)
+            for n in (os.listdir(doc_dir) if os.path.isdir(doc_dir) else ())
+            if n.endswith(".md")
+        )
+        if primary not in doc_paths and os.path.exists(primary):
+            doc_paths.append(primary)
+    if not any(os.path.exists(p) for p in doc_paths):
+        return None
+    contract = DocContract()
+    for doc_abspath in doc_paths:
+        if not os.path.exists(doc_abspath):
+            continue
+        doc_rel = os.path.relpath(doc_abspath, root).replace(os.sep, "/")
+        rows, tokens, text = parse_doc(doc_abspath, doc_rel)
+        if not rows and os.path.abspath(doc_abspath) != os.path.abspath(primary):
+            continue  # narrative doc, not part of the metric contract
+        contract.rows.extend(rows)
+        contract.tokens.update(tokens)
+        contract.text += "\n" + text
+    return contract
+
+
+# ---------------------------------------------------------------------------
+# code side: metric / route-kind / trace-event extraction
+# ---------------------------------------------------------------------------
+
+
+class MetricSite:
+    __slots__ = ("kind", "pattern", "path", "line", "symbol")
+
+    def __init__(self, kind, pattern, path, line, symbol):
+        self.kind = kind  # "counter" | "gauge" | "histogram" (metrics)
+        self.pattern = pattern
+        self.path = path
+        self.line = line
+        self.symbol = symbol
+
+
+def _call_name(func: ast.AST) -> "tuple[str | None, str | None]":
+    """(base, attr) for ``base.attr(...)`` / (None, name) for ``name(...)``."""
+    if isinstance(func, ast.Attribute):
+        base = func.value.id if isinstance(func.value, ast.Name) else None
+        return base, func.attr
+    if isinstance(func, ast.Name):
+        return None, func.id
+    return None, None
+
+
+class _ModuleResolver:
+    """Static resolution of interpolated Names to literal-string sets.
+
+    Three sources, in order of preference:
+
+    1. module-level ``NAME = "literal"`` / ``NAME = ("a", "b", ...)``;
+    2. any ``for``-loop or comprehension binding the name from a literal
+       tuple/list (tuple targets position-matched, so the loop over
+       ``(("pipeline.verify_s", bound), ...)`` yields the name column);
+    3. an enclosing-function parameter, resolved through the literal
+       arguments of the function's module-local call sites.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self._consts: "dict[str, list[str]]" = {}
+        self._loop_values: "dict[str, set[str]]" = {}
+        self._call_args: "dict[str, list[ast.Call]]" = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    if isinstance(stmt.value, ast.Constant) and isinstance(
+                        stmt.value.value, str
+                    ):
+                        self._consts[target.id] = [stmt.value.value]
+                    else:
+                        seq = _literal_str_seq(stmt.value)
+                        if seq is not None:
+                            self._consts[target.id] = seq
+        for node in ast.walk(tree):
+            iters: list = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append((node.target, node.iter))
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                iters.extend((g.target, g.iter) for g in node.generators)
+            for target, iterable in iters:
+                self._bind_loop(target, iterable)
+            if isinstance(node, ast.Call):
+                _base, attr = _call_name(node.func)
+                if attr:
+                    self._call_args.setdefault(attr, []).append(node)
+
+    def _iter_values(self, iterable: ast.AST) -> "list[ast.AST] | None":
+        if isinstance(iterable, (ast.Tuple, ast.List)):
+            return list(iterable.elts)
+        if isinstance(iterable, ast.Name) and iterable.id in self._consts:
+            return [
+                ast.Constant(value=v) for v in self._consts[iterable.id]
+            ]
+        return None
+
+    def _bind_loop(self, target: ast.AST, iterable: ast.AST) -> None:
+        elts = self._iter_values(iterable)
+        if elts is None:
+            return
+        if isinstance(target, ast.Name):
+            vals = {e.value for e in elts if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+            if vals and len(vals) == len(elts):
+                self._loop_values.setdefault(target.id, set()).update(vals)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for idx, sub in enumerate(target.elts):
+                if not isinstance(sub, ast.Name):
+                    continue
+                vals = set()
+                for e in elts:
+                    if (
+                        isinstance(e, (ast.Tuple, ast.List))
+                        and idx < len(e.elts)
+                        and isinstance(e.elts[idx], ast.Constant)
+                        and isinstance(e.elts[idx].value, str)
+                    ):
+                        vals.add(e.elts[idx].value)
+                    else:
+                        vals = set()
+                        break
+                if vals:
+                    self._loop_values.setdefault(sub.id, set()).update(vals)
+
+    def _param_values(self, func: "ast.FunctionDef | None", name: str) -> "set[str] | None":
+        if func is None:
+            return None
+        a = func.args
+        params = [x.arg for x in a.posonlyargs] + [x.arg for x in a.args]
+        if name not in params:
+            return None
+        index = params.index(name)
+        offset = len(a.posonlyargs)  # positional index in call args
+        values: set[str] = set()
+        for call in self._call_args.get(func.name, ()):  # module-local sites
+            arg: "ast.AST | None" = None
+            # ``self.method(...)`` call sites don't pass ``self``
+            shift = 1 if params and params[0] in ("self", "cls") else 0
+            pos = index - shift
+            if 0 <= pos < len(call.args):
+                arg = call.args[pos]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == name:
+                        arg = kw.value
+            if arg is None and index >= shift and a.defaults:
+                n_required = len(params) - len(a.defaults)
+                if index >= n_required:
+                    arg = a.defaults[index - n_required]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                values.add(arg.value)
+            elif isinstance(arg, ast.Name) and arg.id in self._consts:
+                values.update(self._consts[arg.id])
+            else:
+                return None  # one unresolvable site poisons the set
+        _ = offset
+        return values or None
+
+    def resolve(self, node: ast.AST, func: "ast.FunctionDef | None") -> "list[str] | None":
+        """Literal values a Name can take, or None (-> wildcard)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if not isinstance(node, ast.Name):
+            return None
+        if node.id in self._consts:
+            return list(self._consts[node.id])
+        if node.id in self._loop_values:
+            return sorted(self._loop_values[node.id])
+        vals = self._param_values(func, node.id)
+        if vals is not None:
+            return sorted(vals)
+        return None
+
+
+def _literal_str_seq(node: ast.AST) -> "list[str] | None":
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            out.append(elt.value)
+        else:
+            return None
+    return out
+
+
+def _name_patterns(
+    arg: ast.AST, resolver: _ModuleResolver, func: "ast.FunctionDef | None"
+) -> "list[str]":
+    """The name patterns a metric-name argument can evaluate to."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.JoinedStr):
+        parts: list[list[str]] = []
+        for value in arg.values:
+            if isinstance(value, ast.Constant):
+                parts.append([str(value.value)])
+            elif isinstance(value, ast.FormattedValue):
+                resolved = resolver.resolve(value.value, func)
+                parts.append(resolved if resolved else ["*"])
+            else:
+                parts.append(["*"])
+        total = 1
+        for p in parts:
+            total *= len(p)
+        if total > _MAX_EXPANSIONS:
+            parts = [p if len(p) == 1 else ["*"] for p in parts]
+        return ["".join(combo) for combo in itertools.product(*parts)]
+    resolved = resolver.resolve(arg, func)
+    return resolved if resolved else ["*"]
+
+
+def _is_chokepoint(path: str) -> bool:
+    return any(path.endswith(s) for s in _CHOKEPOINT_SUFFIXES)
+
+
+def extract_sites(modules: "list[SourceModule]"):
+    """(metric sites, route-kind sites, trace-event sites) package-wide."""
+    metric_sites: list[MetricSite] = []
+    route_sites: list[MetricSite] = []
+    event_sites: list[MetricSite] = []
+    for mod in modules:
+        if _is_chokepoint(mod.path):
+            continue
+        resolver = _ModuleResolver(mod.tree)
+        func_stack: list = []
+
+        def walk(node, mod=mod, resolver=resolver, func_stack=func_stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                func_stack.pop()
+                return
+            if isinstance(node, ast.Call) and node.args:
+                base, attr = _call_name(node.func)
+                enclosing = func_stack[-1] if func_stack else None
+                symbol = enclosing.name if enclosing else "<module>"
+                if attr in _METRIC_FUNCS and (base is None or base in _METRIC_BASES):
+                    for pat in _name_patterns(node.args[0], resolver, enclosing):
+                        metric_sites.append(
+                            MetricSite(attr, pat, mod.path, node.lineno, symbol)
+                        )
+                elif attr == "route":
+                    for pat in _name_patterns(node.args[0], resolver, enclosing):
+                        if pat != "*":
+                            route_sites.append(
+                                MetricSite("route", pat, mod.path, node.lineno, symbol)
+                            )
+                elif attr == "event" and (base in _TRACE_BASES):
+                    for pat in _name_patterns(node.args[0], resolver, enclosing):
+                        if pat != "*":
+                            event_sites.append(
+                                MetricSite("event", pat, mod.path, node.lineno, symbol)
+                            )
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(mod.tree)
+    return metric_sites, route_sites, event_sites
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+
+
+def analyze(
+    paths: "list[str]", root: str, doc_paths: "list[str] | None" = None
+) -> "list[Finding]":
+    doc = load_contract(root, doc_paths)
+    if doc is None:
+        return [
+            Finding(
+                rule="obscontract/orphaned-doc-row",
+                path=_DOC_PATH,
+                line=1,
+                symbol="<missing>",
+                message="observability contract doc is missing",
+                hint=f"create {_DOC_PATH} with the metric table",
+            )
+        ]
+    doc_rel = _DOC_PATH
+    modules = [SourceModule.load(p, root) for p in paths]
+    metric_sites, route_sites, event_sites = extract_sites(modules)
+
+    findings: list[Finding] = []
+
+    # code -> doc: every emittable metric needs a matching row
+    reported: set = set()
+    for site in metric_sites:
+        documented = any(
+            site.kind in row.kinds
+            and any(patterns_match(site.pattern, p) for p in row.patterns)
+            for row in doc.rows
+        )
+        if documented:
+            continue
+        key = (site.kind, site.pattern)
+        if key in reported:
+            continue
+        reported.add(key)
+        findings.append(
+            Finding(
+                rule="obscontract/undocumented-metric",
+                path=site.path,
+                line=site.line,
+                symbol=site.pattern,
+                message=(
+                    f"{site.kind} '{site.pattern}' has no matching row in "
+                    "any metric table across the contract docs"
+                ),
+                hint="add a `name | kind | meaning` row (or fix the name)",
+            )
+        )
+
+    # doc -> code: every row expansion needs an emitting site
+    for row in doc.rows:
+        for pattern in row.patterns:
+            emitted = any(
+                site.kind in row.kinds and patterns_match(pattern, site.pattern)
+                for site in metric_sites
+            )
+            if not emitted:
+                findings.append(
+                    Finding(
+                        rule="obscontract/orphaned-doc-row",
+                        path=row.path,
+                        line=row.line,
+                        symbol=pattern,
+                        message=(
+                            f"doc row '{pattern}' ({'/'.join(sorted(row.kinds))}) "
+                            "matches no metric the package can emit"
+                        ),
+                        hint="delete the stale row or restore the emitting code",
+                    )
+                )
+
+    # routing-journal kinds and one-shot trace events must appear in the doc
+    seen_kinds: set = set()
+    for site in route_sites:
+        if site.pattern in seen_kinds:
+            continue
+        seen_kinds.add(site.pattern)
+        if not doc.mentions(site.pattern):
+            findings.append(
+                Finding(
+                    rule="obscontract/undocumented-journal-kind",
+                    path=site.path,
+                    line=site.line,
+                    symbol=site.pattern,
+                    message=(
+                        f"routing-journal kind '{site.pattern}' is never "
+                        f"mentioned in {doc_rel}"
+                    ),
+                    hint="name the kind in the routing-journal section",
+                )
+            )
+    seen_events: set = set()
+    for site in event_sites:
+        if site.pattern in seen_events:
+            continue
+        seen_events.add(site.pattern)
+        if not doc.mentions(site.pattern):
+            findings.append(
+                Finding(
+                    rule="obscontract/undocumented-trace-event",
+                    path=site.path,
+                    line=site.line,
+                    symbol=site.pattern,
+                    message=(
+                        f"trace event '{site.pattern}' is never mentioned "
+                        f"in {doc_rel}"
+                    ),
+                    hint="document the one-shot event (what arms/re-arms it)",
+                )
+            )
+    return findings
+
+
+def analyze_file(abspath: str, root: str) -> "list[Finding]":
+    return analyze([abspath], root)
