@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: help test test-all speclint speclint-json speclint-sarif speclint-changed speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke proofs-smoke soak-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
+.PHONY: help test test-all speclint speclint-json speclint-sarif speclint-changed speclint-all forkdiff bench bench-smoke bench-diff bench-trend chaos mesh-smoke mem-smoke pool-smoke proofs-smoke soak-smoke trace-smoke pipeline-selfcheck trace metrics profile serve serve-data server-smoke serving-smoke
 
 PROFILE_DIR ?= profile_artifacts
 
@@ -38,8 +38,8 @@ forkdiff:  ## regenerate docs/FORKDIFF.md from the fork-diff machinery
 bench:  ## full benchmark battery (bench.py; TPU-aware, CPU fallback)
 	$(PY) bench.py
 
-bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke + the memory-observatory smoke + the proof-plane smoke
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py tests/test_memory_observatory.py tests/test_proofs.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke or mem_smoke or proofs_smoke'
+bench-smoke:  ## tier-1-adjacent: one warm 2^14 deneb block (columnar engine engaged) + a 2^18 columnar-primary epoch engagement check + the 2^18 phase0 committee-mask engagement check + the scenario smoke + the serving smoke + the pool smoke + the mesh smoke + the soak smoke + the memory-observatory smoke + the proof-plane smoke + the trace-plane smoke
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_ops_vector.py tests/test_epoch_vector.py tests/test_committee_masks.py tests/test_scenarios.py tests/test_serving.py tests/test_pool.py tests/test_mesh_runtime.py tests/test_soak.py tests/test_memory_observatory.py tests/test_proofs.py tests/test_trace_plane.py -q -m 'bench_smoke or chaos_smoke or serving_smoke or pool_smoke or mesh_smoke or soak_smoke or mem_smoke or proofs_smoke or trace_smoke'
 	$(PY) -m tools.speclint --changed
 
 mesh-smoke:  ## 2-device virtual mesh: one sharded epoch pass + one sharded RLC flush window, bit-identical to host
@@ -59,6 +59,9 @@ pool-smoke:  ## operation-pool write plane: client round-trips, block publicatio
 
 soak-smoke:  ## short deterministic production soak: storm + faults + readers + SSE + pool traffic, all three gates asserted
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_soak.py -q -m soak_smoke
+
+trace-smoke:  ## causal trace plane: one end-to-end linked trace on a 2-lane pipelined replay, zero dropped spans
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_trace_plane.py -q -m trace_smoke
 
 bench-diff:  ## per-phase diff of two bench evidence files: make bench-diff A=old.json B=new.json
 	$(PY) bench_compare.py $(A) $(B)

@@ -830,6 +830,73 @@ def _mem_evidence(baseline_mb: float, phases_before: dict,
     }
 
 
+def _trace_evidence(run, exemplar_hists=()):
+    """Run ``run()`` under an active span recording and return
+    ``(result, evidence)`` — the causal-trace evidence block the
+    pipeline/pool/soak configs fold into ``ok``: settled windows that
+    actually linked (``trace.windows_linked`` moved), zero orphan spans
+    among the run's records, zero silent drops, plus the exemplar
+    trace_ids the named histograms retained. When a recording is
+    already live (``bench --trace``) the run rides it via a watermark;
+    drops then reflect battery-wide ring pressure and are reported but
+    not gated (a fresh recording gates them at zero)."""
+    from ethereum_consensus_tpu.telemetry import metrics as tel_metrics
+    from ethereum_consensus_tpu.telemetry import spans as tel_spans
+
+    rec = tel_spans.RECORDER
+    linked_before = tel_metrics.counter("trace.windows_linked").value()
+    dropped_before = tel_metrics.counter("spans.dropped").value()
+    riding = rec.enabled
+    if riding:
+        mark = rec.mark()
+        result = run()
+        records = rec.records_since(mark)
+    else:
+        with tel_spans.recording(capacity=1 << 18):
+            result = run()
+            records = rec.records()
+    ids = {r.span_id for r in records}
+    orphans = sum(
+        1 for r in records if r.parent_id and r.parent_id not in ids
+    )
+    windows_linked = (
+        tel_metrics.counter("trace.windows_linked").value() - linked_before
+    )
+    dropped = (
+        tel_metrics.counter("spans.dropped").value() - dropped_before
+    )
+    exemplars = {
+        name: [
+            e["trace_id"]
+            for e in tel_metrics.histogram(name).exemplars()
+        ]
+        for name in exemplar_hists
+    }
+    evidence = {
+        "spans": len(records),
+        "traces": len({r.trace_id for r in records}),
+        "windows_linked": windows_linked,
+        "orphans": orphans,
+        "dropped": dropped,
+        "exemplars": exemplars,
+        # numeric twin for bench_compare --trend (lists are skipped by
+        # its leaf walk): the fraction of the named histograms whose
+        # worst-N table names at least one tail trace
+        "exemplar_coverage": (
+            sum(1 for ids in exemplars.values() if ids)
+            / len(exemplars)
+            if exemplars
+            else 0.0
+        ),
+        "ok": bool(
+            windows_linked > 0
+            and orphans == 0
+            and (riding or dropped == 0)
+        ),
+    }
+    return result, evidence
+
+
 _EPOCH_SWEEP_SPANS = (
     "helpers.active_indices_sweep",
     "helpers.total_balance_sweep",
@@ -1804,11 +1871,21 @@ def bench_pipeline_blocks(validators: int = 1 << 20, n_blocks: int = 32,
         for name, count in hot_sweeps["per_block"].items()
     )
     hot_sweeps["per_block_within_budget"] = sweeps_ok
+    # causal-trace evidence: one pipelined replay under recording —
+    # every settled window must link into a connected tree (zero
+    # orphans, zero silent drops) and the verify/settle histograms
+    # must name their tail windows by trace_id
+    _, trace_evidence = _trace_evidence(
+        replay_pipelined,
+        exemplar_hists=("pipeline.verify_s", "pipeline.settle_s"),
+    )
     sn = stats.snapshot()
     cores = os.cpu_count() or 1
     return {
-        "ok": bool(ok) and sn["rollbacks"] == 0 and sweeps_ok,
+        "ok": bool(ok) and sn["rollbacks"] == 0 and sweeps_ok
+        and trace_evidence["ok"],
         "hot_sweeps": hot_sweeps,
+        "trace": trace_evidence,
         "fork": "deneb",
         "validators": validators,
         "blocks": n_blocks,
@@ -2856,6 +2933,15 @@ def bench_pool_ingest(validators: int = 1 << 17, n_blocks: int = 16,
         replay_future.result(timeout=600)
         pool_exec.shutdown(wait=True)
 
+    # causal-trace evidence: one more RLC ingest under recording (the
+    # contending replay is gone — this measures linkage, not speed):
+    # every dispatched window must settle into a connected
+    # admission→settle tree, and pool.flush_verify_s must name its
+    # tail windows by trace_id
+    _, trace_evidence = _trace_evidence(
+        run_rlc, exemplar_hists=("pool.flush_verify_s",)
+    )
+
     rlc_pool, rlc_engine = rlc_best["pool"], rlc_best["engine"]
     rlc_tickets, rlc_s = rlc_best["tickets"], rlc_best["total_s"]
     scalar_pool = scalar_best["pool"]
@@ -2900,7 +2986,9 @@ def bench_pool_ingest(validators: int = 1 << 17, n_blocks: int = 16,
             and verdicts_identical
             and views_identical
             and selection_identical
+            and trace_evidence["ok"]
         ),
+        "trace": trace_evidence,
         "validators": validators,
         "messages": messages,
         "groups": groups,
@@ -3074,8 +3162,11 @@ def bench_soak(cycles: int = 150, deadline_s: float = 210.0,
     /healthz pinned to ``ok``, flat RSS via the leak sentinel, and
     end-of-run bit-identity (cycle roots vs the scalar oracle, exact
     blame, equivocation-ledger refeed identity, surfaced slashings —
-    surround included — executing in soak-produced blocks). A second
-    segment proves fault injection under the MESH route:
+    surround included — executing in soak-produced blocks). The run
+    executes with the causal trace plane active, so the report's
+    ``gates.trace`` block (folded into ``ok`` by the runner) proves
+    every SLO histogram's exemplars resolve to connected trees. A
+    second segment proves fault injection under the MESH route:
     differential-identical to the host-route run of the same schedule.
 
     Headline: the sustained blocks/s + queries/s pair."""
@@ -3125,8 +3216,10 @@ def bench_soak(cycles: int = 150, deadline_s: float = 210.0,
             "chain: every cycle replays the storm-corrupted chain "
             "through the pipeline with recovery while readers, SSE "
             "subscribers, and pool spam run concurrently; ok folds the "
-            "three soak gates (SLO/healthz, flat RSS, bit-identity) "
-            "AND the mesh-route fault-injection differential"
+            "three soak gates (SLO/healthz, flat RSS, bit-identity), "
+            "the causal-trace gate (every SLO exemplar resolves to a "
+            "connected admission->settle tree), AND the mesh-route "
+            "fault-injection differential"
         ),
     }
 

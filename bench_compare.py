@@ -113,6 +113,17 @@ _TREND_HEADLINE = (
     "scalar_ingest_s",
     "flushes",
     "fused_groups",
+    # the causal trace plane's axes (ISSUE 19): settled windows that
+    # linked into connected trees, ring evictions (must stay zero on a
+    # fresh recording), and the exemplar coverage of the p99 SLO
+    # histograms (1.0 = every gated histogram names its tail trace);
+    # the soak's trace gate rides its gates.* block
+    "trace.windows_linked",
+    "trace.orphans",
+    "trace.dropped",
+    "trace.exemplar_coverage",
+    "gates.trace.windows_linked",
+    "gates.trace.audit.dropped",
     # the mesh scale-out axes (ISSUE 12): blocks/s and epoch seconds per
     # virtual device count, scaling efficiency vs the 1-device run, and
     # the lane occupancy the cores convert into throughput

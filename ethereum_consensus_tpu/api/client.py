@@ -486,6 +486,16 @@ class Client:
             503: HealthStatus.NOT_INITIALIZED,
         }.get(response.status_code, HealthStatus.UNKNOWN)
 
+    # -- introspection (telemetry/server.py, outside the Beacon API) ---------
+    def get_trace(self, trace_id: "int | None" = None) -> dict:
+        """The introspection server's ``/trace`` document: the slow-trace
+        index when ``trace_id`` is None, else one trace assembled into
+        its causal tree (spans + flight lineage + device evidence).
+        Raises ``ApiError`` (404) for a trace id the span ring no longer
+        holds — the error path tests/test_trace_plane.py exercises."""
+        params = {"id": str(trace_id)} if trace_id is not None else None
+        return self.http_get("trace", params=params).json()
+
     # -- validator (api_client.rs:683-871) -----------------------------------
     def get_attester_duties(
         self, epoch: int, indices: list[int]

@@ -946,7 +946,7 @@ def _verify_pool(lane: int = 0):
 
 def verify_signature_sets_async(
     sets: list[SignatureSet], dst: bytes = ETH_DST, timer=None, pre=None,
-    route_sink=None, lane: int = 0,
+    route_sink=None, lane: int = 0, trace_ctx=None,
 ):
     """Dispatch one batched verification to the background verifier thread;
     returns a ``concurrent.futures.Future[list[bool]]``.
@@ -965,7 +965,11 @@ def verify_signature_sets_async(
     flight recorder's per-window verify_route feed. ``lane`` picks the
     single-thread verifier worker (default 0 — the historical shared
     worker); batches dispatched to different lanes verify CONCURRENTLY,
-    batches on one lane stay FIFO."""
+    batches on one lane stay FIFO. ``trace_ctx``, if given, is the
+    caller's causal handoff token (utils/trace TraceContext): the worker
+    adopts it so the verify span parents under the dispatching window's
+    trace across the thread seam (a cross-lane flow arrow in the Chrome
+    trace) instead of rooting its own tree."""
     sets = list(sets)
 
     def run() -> list[bool]:
@@ -976,9 +980,11 @@ def verify_signature_sets_async(
             if pre is not None:
                 pre()
             # the span lands on the verifier thread's lane, so a recorded
-            # pipeline run shows stage B as its own Perfetto track
-            with trace.span("pipeline.flush.verify", sets=len(sets)):
-                verdicts = verify_signature_sets(sets, dst)
+            # pipeline run shows stage B as its own Perfetto track —
+            # linked under trace_ctx's trace when the caller passed one
+            with trace.adopt(trace_ctx):
+                with trace.span("pipeline.flush.verify", sets=len(sets)):
+                    verdicts = verify_signature_sets(sets, dst)
             if route_sink is not None:
                 route_sink(last_batch_route())
             return verdicts

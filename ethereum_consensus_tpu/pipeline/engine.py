@@ -167,6 +167,10 @@ class ChainPipeline:
             self.policy, self.stats, fault_injector=fault_injector
         )
         self._pending: list[_Entry] = []
+        # the causal trace the current (accumulating) window records
+        # under: anchored at the window's FIRST stage-A span, handed to
+        # the scheduler at dispatch, None while tracing is off
+        self._window_ctx = None
         # committed position = checkpoint + proven blocks since it
         self._checkpoint = executor.state.copy()
         self._since_checkpoint: list = []
@@ -218,18 +222,25 @@ class ChainPipeline:
         sink = SignatureBatch()
         slot = int(signed_block.message.slot)
         try:
-            with trace.span("pipeline.stage_a", slot=slot):
-                with defer_flushes(sink):
-                    self._executor.apply_block_with_validation(
-                        signed_block, self._validation
-                    )
+            # later blocks of an accumulating window adopt the context
+            # anchored at the window's first stage-A span, so the whole
+            # window records as ONE causal tree; the first block roots it
+            with trace.adopt(self._window_ctx if self._pending else None):
+                with trace.span("pipeline.stage_a", slot=slot):
+                    if not self._pending:
+                        self._window_ctx = trace.context()
+                    with defer_flushes(sink):
+                        self._executor.apply_block_with_validation(
+                            signed_block, self._validation
+                        )
         except Error as exc:
             t1 = time.perf_counter()
             self.stats.block_submitted(t1 - t0)
             if hooked:
                 failed = self._make_entry(signed_block, slot, sink, t0, t1,
                                           mark)
-                self._emit_block(failed, "rolled-back", blame=exc)
+                self._emit_block(failed, "rolled-back", blame=exc,
+                                 trace_ctx=self._window_ctx)
                 _flight.HOOK.emit(
                     "rollback",
                     {
@@ -307,11 +318,16 @@ class ChainPipeline:
         return entry
 
     def _emit_block(self, entry: _Entry, outcome: str, window=None,
-                    blame=None, degraded=None) -> None:
+                    blame=None, degraded=None, trace_ctx=None) -> None:
         """Assemble one ``BlockLineage`` from the entry's stage-A stamps
         and its window's stage-B stamps, and publish it on the commit
-        hook. Callers guard with ``_flight.HOOK.active``."""
+        hook. Callers guard with ``_flight.HOOK.active``. The lineage
+        names the causal trace the block recorded under (the window's
+        context, or ``trace_ctx`` on windowless paths), so a lineage
+        record resolves via ``/trace`` into its span tree."""
         now = time.perf_counter()
+        if trace_ctx is None and window is not None:
+            trace_ctx = window.trace_ctx
         queue_wait = 0.0
         settle_s = None
         if window is not None and window.t_dispatch is not None:
@@ -358,6 +374,9 @@ class ChainPipeline:
                     if blame is not None
                     else None
                 ),
+                trace_id=(
+                    trace_ctx.trace_id if trace_ctx is not None else None
+                ),
             ),
         )
 
@@ -398,7 +417,8 @@ class ChainPipeline:
             }
         )
 
-    def _emit_head(self, entry: _Entry, blocks: int, seq=None) -> None:
+    def _emit_head(self, entry: _Entry, blocks: int, seq=None,
+                   trace_ctx=None) -> None:
         _flight.HOOK.emit(
             "head",
             {
@@ -407,6 +427,11 @@ class ChainPipeline:
                 "block_root": _block_root_hex(entry.signed_block),
                 "blocks": blocks,
                 "seq": seq,
+                # the causal trace the head-advancing window recorded
+                # under — SSE consumers can resolve it via /trace
+                "trace_id": (
+                    trace_ctx.trace_id if trace_ctx is not None else None
+                ),
             },
         )
 
@@ -422,6 +447,7 @@ class ChainPipeline:
 
     def _dispatch_pending(self) -> None:
         entries, self._pending = self._pending, []
+        trace_ctx, self._window_ctx = self._window_ctx, None
         merged = SignatureBatch()
         for entry in entries:
             merged.merge(entry.batch)
@@ -435,9 +461,11 @@ class ChainPipeline:
             self.stats.checkpoint()
         if not len(merged) and not self.policy.flush_empty:
             # a window that deferred zero sets has nothing to prove
-            self._commit(entries, candidate, window=None)
+            self._commit(entries, candidate, window=None,
+                         trace_ctx=trace_ctx)
             return
         window = Window(entries, merged, candidate, self._seq)
+        window.trace_ctx = trace_ctx
         if _flight.HOOK.state_active:
             # serving data plane attached (telemetry/flight.py state
             # channel): copy the post-window state NOW, while the live
@@ -487,7 +515,10 @@ class ChainPipeline:
             return
         self._rollback(window, verdicts)  # raises
 
-    def _commit(self, entries, checkpoint, window=None) -> None:
+    def _commit(self, entries, checkpoint, window=None,
+                trace_ctx=None) -> None:
+        if trace_ctx is None and window is not None:
+            trace_ctx = window.trace_ctx
         if checkpoint is not None:
             self._checkpoint = checkpoint
             self._since_checkpoint = []
@@ -510,10 +541,12 @@ class ChainPipeline:
             # skip — the next dispatched window publishes the new head
         if _flight.HOOK.active and entries:
             for entry in entries:
-                self._emit_block(entry, "committed", window=window)
+                self._emit_block(entry, "committed", window=window,
+                                 trace_ctx=trace_ctx)
             self._emit_head(
                 entries[-1], len(entries),
                 seq=window.seq if window is not None else None,
+                trace_ctx=trace_ctx,
             )
             _flight.HOOK.emit(
                 "commit",
@@ -524,6 +557,11 @@ class ChainPipeline:
                     "checkpoint": checkpoint is not None,
                     "degraded": (
                         bool(window.degraded) if window is not None else False
+                    ),
+                    "trace_id": (
+                        trace_ctx.trace_id
+                        if trace_ctx is not None
+                        else None
                     ),
                 },
             )
@@ -624,7 +662,8 @@ class ChainPipeline:
                     proven, self._executor.state.copy(), seq=window.seq
                 )
             if hooked:
-                self._emit_head(proven[-1], fail_block, seq=window.seq)
+                self._emit_head(proven[-1], fail_block, seq=window.seq,
+                                trace_ctx=window.trace_ctx)
         self._broken = error
         self.stats.stop()
         raise error

@@ -187,7 +187,7 @@ class Window:
     __slots__ = (
         "entries", "batch", "post_state", "snap_state", "future", "seq",
         "attempts", "t_dispatch", "t_settled", "verify_s", "degraded",
-        "verify_route",
+        "verify_route", "trace_ctx",
     )
 
     def __init__(self, entries, batch, post_state, seq: int):
@@ -211,6 +211,12 @@ class Window:
         # via the verify route_sink (same happens-before edge as the
         # timer), folded into BlockLineage.verify_route
         self.verify_route = None
+        # the causal trace the window's blocks recorded under (a
+        # utils/trace TraceContext anchored at the window's first
+        # stage-A span; None when tracing is off) — the handoff token
+        # the verify lane and settle path adopt, and the trace_id the
+        # SLO histograms exemplar against
+        self.trace_ctx = None
 
 
 class VerifyScheduler:
@@ -271,6 +277,9 @@ class VerifyScheduler:
                 # window stay on its lane (FIFO with its successors),
                 # consecutive windows round-robin over the lanes
                 lane=window.seq % self.policy.verify_lanes,
+                # causal handoff: the verify lane adopts the window's
+                # trace, so its span parents across the thread seam
+                trace_ctx=window.trace_ctx,
             )
         except RuntimeError:
             _metrics.counter("pipeline.fault.dispatch_failure").inc()
@@ -314,8 +323,11 @@ class VerifyScheduler:
         )
         t0 = time.perf_counter()
         try:
-            with trace.span("pipeline.flush.verify_inline", seq=window.seq):
-                verdicts = bls.verify_signature_sets(window.batch.sets)
+            with trace.adopt(window.trace_ctx):
+                with trace.span(
+                    "pipeline.flush.verify_inline", seq=window.seq
+                ):
+                    verdicts = bls.verify_signature_sets(window.batch.sets)
             window.verify_route = bls.last_batch_route()
             return verdicts
         finally:
@@ -327,12 +339,39 @@ class VerifyScheduler:
         histograms (bounded reservoirs, telemetry/metrics.py) — the
         production soak's p99 gates read these directly, so they observe
         unconditionally (two reservoir inserts per WINDOW, not per
-        block; noise against a multi-pairing)."""
-        _metrics.histogram("pipeline.verify_s").observe(window.verify_s)
+        block; noise against a multi-pairing). Under tracing each
+        observation carries the window's trace_id, so the histogram's
+        worst-N exemplar table can name which window was the tail; the
+        settled window also feeds the slow-trace ring and counts
+        ``trace.windows_linked``."""
+        ctx = window.trace_ctx
+        tid = ctx.trace_id if ctx is not None else None
+        fields = {"seq": window.seq} if tid is not None else None
+        _metrics.histogram("pipeline.verify_s").observe(
+            window.verify_s, trace_id=tid, fields=fields
+        )
         if window.t_dispatch is not None and window.t_settled is not None:
             _metrics.histogram("pipeline.settle_s").observe(
-                max(0.0, window.t_settled - window.t_dispatch)
+                max(0.0, window.t_settled - window.t_dispatch),
+                trace_id=tid, fields=fields,
             )
+        if tid is not None:
+            _metrics.counter("trace.windows_linked").inc()
+            starts = [
+                e.t_start
+                for e in window.entries
+                if getattr(e, "t_start", None) is not None
+            ]
+            t_begin = min(starts) if starts else window.t_dispatch
+            if window.t_settled is not None and t_begin is not None:
+                trace.note_trace(
+                    ctx,
+                    "pipeline.window",
+                    max(0.0, window.t_settled - t_begin),
+                    seq=window.seq,
+                    blocks=len(window.entries),
+                    sets=len(window.batch),
+                )
 
     def settle_oldest(self) -> "tuple[Window, list[bool]]":
         """Block until the oldest in-flight window's verdicts are in;
@@ -348,7 +387,10 @@ class VerifyScheduler:
             raise RuntimeError("settle_oldest with nothing in flight")
         window = self._in_flight.pop(0)
         policy = self.policy
-        with trace.span("pipeline.flush.settle", seq=window.seq):
+        # the settle span joins the window's causal tree: the submitting
+        # thread adopts the same context the verify lane did
+        with trace.adopt(window.trace_ctx), \
+                trace.span("pipeline.flush.settle", seq=window.seq):
             while True:
                 try:
                     verdicts = window.future.result(

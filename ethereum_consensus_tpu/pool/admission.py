@@ -123,13 +123,16 @@ class Admission:
     __slots__ = ("kind", "status", "reason", "order",
                  "key", "bits", "indices", "committee_ref", "msg_root",
                  "sig_bytes", "sig_raw", "container", "snap", "sets",
-                 "set_verdicts", "sig_ok")
+                 "set_verdicts", "sig_ok", "trace_id")
 
     def __init__(self, kind: str, order: int):
         self.kind = kind
         self.status = "pending"
         self.reason = None
         self.order = order
+        # the causal trace of the flush window this ticket rode
+        # (stamped at dispatch; None while tracing is off or pending)
+        self.trace_id = None
         self.key = None
         self.bits = None
         self.indices = None
@@ -601,6 +604,14 @@ class AdmissionEngine:
 
     def _dispatch(self, entries: list) -> None:
         with trace.span("pool.flush.dispatch", messages=len(entries)):
+            # the window's causal handoff token: anchored here (under
+            # the admitting span when the dispatch rode an admit call),
+            # stamped onto every ticket, adopted by the verify lane and
+            # the settle path — admission→settle is one connected tree
+            ctx = trace.context()
+            tid = ctx.trace_id if ctx is not None else None
+            for e in entries:
+                e.trace_id = tid
             entries = self._membership_cull(entries)
             sets, attribution = self._build_sets(entries)
             if sets:
@@ -608,7 +619,8 @@ class AdmissionEngine:
                     sets,
                     timer=lambda s: _metrics.histogram(
                         "pool.flush_verify_s"
-                    ).observe(s),
+                    ).observe(s, trace_id=tid),
+                    trace_ctx=ctx,
                 )
             else:
                 future = None
@@ -617,7 +629,7 @@ class AdmissionEngine:
         _metrics.histogram("pool.flush_sets").observe(len(sets))
         settle_now = None
         with self._lock:
-            self._inflight.append((future, sets, attribution, entries))
+            self._inflight.append((future, sets, attribution, entries, ctx))
             _metrics.gauge("pool.window_pending").set(len(self._window))
             if len(self._inflight) > self.max_inflight:
                 settle_now = self._inflight.pop(0)
@@ -785,9 +797,12 @@ class AdmissionEngine:
             self._settle_one(item)
 
     def _settle_one(self, item) -> None:
-        future, sets, attribution, entries = item
+        future, sets, attribution, entries, ctx = item
         verdicts = future.result() if future is not None else []
-        with trace.span("pool.flush.settle", messages=len(entries)):
+        # the settle span joins the window's causal tree (adopting the
+        # context anchored at its dispatch span)
+        with trace.adopt(ctx), \
+                trace.span("pool.flush.settle", messages=len(entries)):
             # sig_ok writes are settle-private: a window settles exactly
             # once (popped under the engine lock), so its entries have
             # one writer here; callers read only the status field
@@ -837,6 +852,15 @@ class AdmissionEngine:
                         self._finalize_op(entry)
                     else:
                         self._reject(entry, "signature")
+        if ctx is not None:
+            # one settled pool window = one linked trace: count it and
+            # feed the slow-trace ring (dispatch capture → settle done)
+            _metrics.counter("trace.windows_linked").inc()
+            trace.note_trace(
+                ctx, "pool.window",
+                max(0.0, time.perf_counter() - ctx.ts),
+                messages=len(entries), sets=len(sets),
+            )
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> dict:

@@ -23,6 +23,7 @@ import time
 from ..models import ops_vector
 from ..primitives import FAR_FUTURE_EPOCH
 from ..telemetry import metrics as _metrics
+from ..utils import trace
 
 __all__ = [
     "STATUS_NAMES",
@@ -135,11 +136,18 @@ def gather(bundle: dict, indices, fields):
     """The data plane's one-columnar-gather-per-batch unit: a single
     ``ops_vector.gather_rows`` pass over the requested fields, counted
     (``serving.gathers``) and timed (``serving.gather_s``) so the bench
-    can assert exactly one per batched read."""
+    can assert exactly one per batched read. Under tracing the gather
+    runs in its own span and the observation carries its trace_id, so
+    the p99 ``serving.gather_s`` gate can exemplar the tail request."""
     t0 = time.perf_counter()
-    out = ops_vector.gather_rows(bundle, indices, fields)
+    with trace.span("serving.gather", rows=len(indices)):
+        out = ops_vector.gather_rows(bundle, indices, fields)
+        ctx = trace.context()
     _metrics.counter("serving.gathers").inc()
-    _metrics.histogram("serving.gather_s").observe(time.perf_counter() - t0)
+    _metrics.histogram("serving.gather_s").observe(
+        time.perf_counter() - t0,
+        trace_id=ctx.trace_id if ctx is not None else None,
+    )
     return out
 
 

@@ -42,6 +42,14 @@ Three hard gates fold into ``report["ok"]`` (docs/SOAK.md):
    oracle's root, every corruption blamed exactly, and the equivocation
    ledger + surfaced slashings of the live run identical to a clean
    refeed of the recorded admission schedule.
+
+The run additionally executes with the causal trace plane ACTIVE
+(telemetry/spans.py): a fourth ``trace`` gate folds into ``ok`` —
+every SLO histogram's worst-N exemplar table must name at least one
+trace_id that resolves into a connected admission→settle span tree,
+settled windows must actually have linked (``trace.windows_linked``),
+and an SLO breach or sentinel trip names its exemplar/slow trace ids
+so the tail is a ``/trace`` lookup away, not a re-run.
 """
 
 from __future__ import annotations
@@ -67,6 +75,7 @@ from ..scenarios.mutators import MUTATORS, MutationEnv, by_name, plan_storm
 from ..telemetry import flight as _flight
 from ..telemetry import memory as _memory
 from ..telemetry import metrics as _metrics
+from ..telemetry import spans as _spans
 from ..utils import trace
 from .sentinel import LeakSentinel
 
@@ -80,6 +89,11 @@ __all__ = ["SoakConfig", "SoakRunner", "run_soak", "load_profile",
 DEFAULT_PROFILE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "profiles", "default.json"
 )
+
+# the p99 SLO histograms (gate 1) — also the histograms whose exemplar
+# tables the trace gate resolves into connected causal trees
+_SLO_HISTOGRAMS = ("pipeline.verify_s", "pipeline.settle_s",
+                   "serving.gather_s")
 
 
 def _parse_flat_toml(text: str) -> dict:
@@ -499,6 +513,18 @@ class SoakRunner:
 
             mesh_on = _mesh_requested()
 
+        # causal tracing is ON for the whole soak: the trace gate below
+        # must resolve every SLO histogram's exemplars into connected
+        # admission→settle trees. A fresh recording clears the span
+        # ring, and resetting the SLO exemplar tables drops any ids
+        # minted by earlier runs in this process — every exemplar this
+        # run reports resolves against this run's recording.
+        trace_started = not _spans.is_recording()
+        if trace_started:
+            _spans.start_recording()
+        for hist_name in _SLO_HISTOGRAMS:
+            _metrics.histogram(hist_name).reset_exemplars()
+
         sentinel = LeakSentinel()
         store = HeadStore().attach()
         server = IntrospectionServer(port=0, sse_keepalive_s=1.0).start()
@@ -652,6 +678,18 @@ class SoakRunner:
         rss = sentinel.gate(config.rss_budget_mb,
                             warmup=config.rss_warmup_cycles,
                             ceiling_mb=config.rss_ceiling_mb)
+        if not rss["ok"]:
+            # a sentinel trip names the run's worst traces: the windows
+            # most likely to have been live while memory ratcheted
+            rss["slow_trace_ids"] = [
+                entry["trace_id"]
+                for entry in _spans.RECORDER.slow_traces()[:8]
+            ]
+
+        # -- trace gate: exemplars resolve into connected causal trees --------
+        trace_gate = self._trace_gate(delta)
+        if trace_started:
+            _spans.stop_recording()
 
         windows = delta.get("pipeline.flushes", 0)
         blocks_committed = delta.get("pipeline.blocks_committed", 0)
@@ -671,7 +709,8 @@ class SoakRunner:
             queries_per_s=round(queries / wall_s, 2) if wall_s else 0,
             storm_failures=failures,
             faults_injected=faults,
-            gates={"slo": slo, "rss": rss, "identity": identity},
+            gates={"slo": slo, "rss": rss, "identity": identity,
+                   "trace": trace_gate},
             pool_spam=spam_summary,
             pool_spam_ok=spam_ok,
             readers={"samples": reader_samples, "roots": reader_roots,
@@ -681,7 +720,8 @@ class SoakRunner:
                      "ok": readers_ok, "error": reader_error},
             sse_events=sse_counts,
             ok=bool(
-                slo["ok"] and rss["ok"] and identity["ok"] and spam_ok
+                slo["ok"] and rss["ok"] and identity["ok"]
+                and trace_gate["ok"] and spam_ok
                 and readers_ok and windows >= config.min_windows
                 and cycles_run > 0
             ),
@@ -785,17 +825,21 @@ class SoakRunner:
         config = self.config
         quantiles = {}
         verdicts = {}
-        for name, bound in (
-            ("pipeline.verify_s", config.slo_verify_p99_s),
-            ("pipeline.settle_s", config.slo_settle_p99_s),
-            ("serving.gather_s", config.slo_gather_p99_s),
-        ):
+        for name, bound in zip(_SLO_HISTOGRAMS,
+                               (config.slo_verify_p99_s,
+                                config.slo_settle_p99_s,
+                                config.slo_gather_p99_s)):
             hist = _metrics.histogram(name)
             qs = hist.quantiles((0.5, 0.9, 0.99))
             p99 = qs.get(0.99)
             quantiles[name] = {
                 "p50": qs.get(0.5), "p90": qs.get(0.9), "p99": p99,
                 "count": hist.summary()["count"], "bound_p99": bound,
+                # the causal trace plane: which windows WERE the tail —
+                # a breach names the traces to pull from /trace
+                "exemplar_trace_ids": [
+                    e["trace_id"] for e in hist.exemplars()
+                ],
             }
             verdicts[name] = p99 is not None and p99 <= bound
         return {
@@ -805,6 +849,44 @@ class SoakRunner:
             "healthz_last": last_health,
             "ok": bool(all(verdicts.values()) and healthz_ok
                        and healthz_samples > 0),
+        }
+
+    def _trace_gate(self, delta: dict) -> dict:
+        """The causal-trace verdict: every SLO histogram's exemplar
+        table must hold at least one trace_id that resolves — against
+        the run's own span recording — into a CONNECTED causal tree
+        (one root, zero orphans), and the pipeline/pool settle paths
+        must have linked windows (``trace.windows_linked`` moved).
+        Whole-buffer orphans gate only while nothing was evicted — a
+        ring that dropped its oldest spans can legitimately strand
+        children, and that loss is already counted, not silent."""
+        recorder = _spans.RECORDER
+        audit = recorder.audit()
+        exemplars = {}
+        resolved_ok = True
+        for name in _SLO_HISTOGRAMS:
+            ids = [
+                e["trace_id"]
+                for e in _metrics.histogram(name).exemplars()
+            ]
+            connected = [
+                t for t in ids if recorder.trace_tree(t)["connected"]
+            ]
+            exemplars[name] = {
+                "trace_ids": ids,
+                "connected": len(connected),
+            }
+            resolved_ok = resolved_ok and bool(connected)
+        windows_linked = delta.get("trace.windows_linked", 0)
+        orphans_ok = audit["dropped"] > 0 or audit["orphans"] == 0
+        return {
+            "windows_linked": windows_linked,
+            "audit": audit,
+            "exemplars": exemplars,
+            "slow_traces": recorder.slow_traces()[:8],
+            "ok": bool(
+                resolved_ok and orphans_ok and windows_linked > 0
+            ),
         }
 
     def _ledger_identity(self, cu, ctx, eq_pool, eq_schedule,

@@ -102,6 +102,7 @@ class BlockLineage:
         "degraded",
         "blame",
         "recovery_s",
+        "trace_id",
         "finished_at",
     )
 
@@ -126,6 +127,7 @@ class BlockLineage:
         degraded: bool = False,
         blame: "dict | None" = None,
         recovery_s: "float | None" = None,
+        trace_id: "int | None" = None,
         finished_at: "float | None" = None,
     ):
         if outcome not in _OUTCOMES:
@@ -152,6 +154,9 @@ class BlockLineage:
         self.degraded = degraded
         self.blame = blame
         self.recovery_s = recovery_s
+        # the causal trace this block's flush window recorded under
+        # (telemetry/spans.py TraceContext), None when tracing was off
+        self.trace_id = trace_id
         self.finished_at = time.time() if finished_at is None else finished_at
 
     @property
@@ -280,8 +285,15 @@ class FlightRecorder:
     # -- hook subscriber -----------------------------------------------------
     def handle(self, kind: str, payload) -> None:
         if kind == "block":
+            dropped = False
             with self._lock:
+                if len(self._records) == self._records.maxlen:
+                    dropped = True  # ring full: oldest lineage evicted
                 self._records.append(payload)
+            if dropped:
+                from . import metrics as _metrics
+
+                _metrics.counter("flight.ring_dropped").inc()
         elif kind == "broken":
             with self._lock:
                 self._last_broken = dict(payload)
@@ -330,6 +342,11 @@ class FlightRecorder:
 
     def for_slot(self, slot: int) -> "list[BlockLineage]":
         return [r for r in self.records() if r.slot == slot]
+
+    def by_trace(self, trace_id: int) -> "list[BlockLineage]":
+        """Records settled under the causal trace ``trace_id`` (the
+        ``/trace`` endpoint's lineage join), oldest first."""
+        return [r for r in self.records() if r.trace_id == trace_id]
 
     def worst(self, n: int = 5, field: str = "total_s") -> "list[BlockLineage]":
         """The ``n`` records with the largest ``field`` (any

@@ -108,23 +108,41 @@ class Histogram:
     are never sampled — ``summary()``/``snapshot()``/``delta()`` keep
     their semantics; only ``values()``/``quantiles()`` read the
     reservoir. The per-histogram RNG is seeded from the metric name, so
-    a replay's reservoir is reproducible."""
+    a replay's reservoir is reproducible.
+
+    **Exemplars** (the causal trace plane, docs/OBSERVABILITY.md):
+    ``observe(v, trace_id=..., fields=...)`` additionally retains the
+    observation in a bounded worst-N ``(value, trace_id, fields)``
+    exemplar table beside the reservoir, so a p99 SLO gate can name
+    *which* trace was the tail, not just how slow it was. The table is
+    value-ordered and deterministic — insertion never touches the RNG,
+    so the seeded-reservoir reproducibility contract is unchanged
+    whether or not call sites pass trace ids. Observations carrying a
+    trace_id that don't displace a retained exemplar are counted (the
+    no-silent-caps rule; ``exemplar_dropped`` per table, summed
+    process-wide into the ``metrics.exemplars_dropped`` counter)."""
 
     __slots__ = ("name", "_lock", "_count", "_sum", "_min", "_max", "_values",
-                 "_rng", "sample_limit")
+                 "_rng", "sample_limit", "_exemplars", "_exemplar_dropped",
+                 "exemplar_limit")
 
-    def __init__(self, name: str, sample_limit: int = 1 << 12):
+    def __init__(self, name: str, sample_limit: int = 1 << 12,
+                 exemplar_limit: int = 8):
         self.name = name
         self.sample_limit = sample_limit
+        self.exemplar_limit = exemplar_limit
         self._lock = threading.Lock()
         self._count = 0
         self._sum = 0
         self._min = None
         self._max = None
         self._values: list = []
+        self._exemplars: list = []   # (value, trace_id, fields), ascending
+        self._exemplar_dropped = 0
         self._rng = random.Random(zlib.crc32(name.encode("utf-8")))
 
-    def observe(self, v) -> None:
+    def observe(self, v, trace_id=None, fields=None) -> None:
+        dropped = False
         with self._lock:
             self._count += 1
             self._sum += v
@@ -138,6 +156,52 @@ class Histogram:
                 j = self._rng.randrange(self._count)
                 if j < self.sample_limit:
                     self._values[j] = v
+            if trace_id is not None:
+                ex = self._exemplars
+                if len(ex) < self.exemplar_limit:
+                    ex.append((v, trace_id, fields))
+                    ex.sort(key=lambda e: e[0])
+                elif v > ex[0][0]:
+                    dropped = True  # the displaced smallest
+                    ex[0] = (v, trace_id, fields)
+                    ex.sort(key=lambda e: e[0])
+                else:
+                    dropped = True
+                if dropped:
+                    self._exemplar_dropped += 1
+        # mirror into the process-wide drop counter outside self._lock
+        # (never nest the registry lock under a metric lock)
+        if dropped:
+            counter("metrics.exemplars_dropped").inc()
+
+    def exemplars(self) -> "list[dict]":
+        """The worst-N exemplar table, largest value first: JSON-ready
+        ``{"value", "trace_id", "fields"}`` dicts (``fields`` omitted
+        when the call site passed none)."""
+        with self._lock:
+            ex = list(self._exemplars)
+        out = []
+        for v, trace_id, fields in reversed(ex):
+            d = {"value": v, "trace_id": trace_id}
+            if fields:
+                d["fields"] = dict(fields)
+            out.append(d)
+        return out
+
+    @property
+    def exemplar_dropped(self) -> int:
+        """Trace-carrying observations not retained in the bounded
+        exemplar table (evicted smallest, or arrived below the current
+        floor)."""
+        return self._exemplar_dropped
+
+    def reset_exemplars(self) -> None:
+        """Clear the exemplar table (the drop tally survives — it is an
+        accounting total, not a window statistic). The soak calls this
+        at run start so every exemplar it reports resolves against the
+        span recording it just began; the reservoir is untouched."""
+        with self._lock:
+            self._exemplars = []
 
     def summary(self) -> dict:
         with self._lock:

@@ -33,6 +33,15 @@ on instrumented ground:
   attribution table, the phase RSS ledger, and the per-site bulk-copy
   byte counters. ``?n=`` caps the worst table. The census probes run
   at request time — a scrape IS a census.
+* ``/trace``    — the causal trace plane's read side. ``?id=<trace_id>``
+  assembles one trace into its causal tree: the span tree (with
+  cross-lane flow edges and a ``connected`` verdict), the flight
+  lineage records settled under that trace, and the device span-plane
+  evidence (``device.*`` route/transfer events) that landed inside the
+  trace's time window. Bare ``/trace`` returns the worst-N slow-trace
+  ring plus the span recorder's audit (span/trace/orphan/drop counts).
+  Trace ids come from histogram exemplars on ``/metrics``, lineage
+  records on ``/blocks``/``/events``, and the soak report's SLO gates.
 
 ``/metrics`` additionally carries a standard ``build_info`` gauge (git
 sha, jax/numpy versions, x64 flag, backend platform as labels, value 1)
@@ -66,6 +75,7 @@ from . import device as _device
 from . import flight as _flight
 from . import memory as _memory
 from . import metrics as _metrics
+from . import spans as _spans
 
 __all__ = [
     "IntrospectionServer",
@@ -203,7 +213,10 @@ def render_prometheus(metric_objects=None) -> str:
     Counters/gauges render verbatim; a ``Histogram`` renders as a
     summary — reservoir-derived ``{quantile="0.5|0.9|0.99"}`` samples
     plus exact ``_sum``/``_count`` — with ``_min``/``_max`` companion
-    gauges."""
+    gauges. A histogram holding worst-N exemplars renders its worst
+    exemplar on the highest quantile line in OpenMetrics exemplar
+    syntax (``... # {trace_id="<id>"} <value>``) so the p99 a scrape
+    reports names the trace that produced the tail."""
     lines: list = []
     if metric_objects is None:
         metric_objects = _metrics.registered_metrics()
@@ -224,9 +237,18 @@ def render_prometheus(metric_objects=None) -> str:
         elif isinstance(metric, _metrics.Histogram):
             summary = metric.summary()
             lines.append(f"# TYPE {name} summary")
-            for q, value in sorted(metric.quantiles(_QUANTILES).items()):
+            exemplars = metric.exemplars()
+            quantile_items = sorted(metric.quantiles(_QUANTILES).items())
+            for q, value in quantile_items:
                 label = escape_label_value(f"{q:g}")
-                lines.append(f'{name}{{quantile="{label}"}} {_fmt(value)}')
+                line = f'{name}{{quantile="{label}"}} {_fmt(value)}'
+                if exemplars and q == quantile_items[-1][0]:
+                    worst = exemplars[0]
+                    line += (
+                        f' # {{trace_id="{worst["trace_id"]}"}}'
+                        f' {_fmt(worst["value"])}'
+                    )
+                lines.append(line)
             lines.append(f"{name}_sum {_fmt(summary['sum'])}")
             lines.append(f"{name}_count {_fmt(summary['count'])}")
             for bound in ("min", "max"):
@@ -388,6 +410,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": "?n= must be an int"}, 400)
                     return
                 self._send_json(_memory.OBSERVATORY.snapshot(worst_n=n))
+            elif route == "/trace":
+                self._serve_trace()
             elif route == "/events":
                 self._serve_events()
             elif route == "/":
@@ -402,6 +426,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "/events",
                             "/device",
                             "/memory",
+                            "/trace",
                         ]
                         + [app.prefix + "..." for app in apps],
                         "apps": [type(app).__name__ for app in apps],
@@ -454,6 +479,69 @@ class _Handler(BaseHTTPRequestHandler):
                 "blocks": [r.to_dict() for r in records],
             }
         )
+
+    def _serve_trace(self) -> None:
+        """The causal-trace read side: bare → the slow-trace ring +
+        recorder audit; ``?id=`` → one trace assembled across the three
+        evidence planes (span tree, flight lineage, device events)."""
+        params = self._query()
+        recorder = _spans.RECORDER
+        raw_id = self._param(params, "id")
+        if raw_id is None:
+            self._send_json(
+                {
+                    "recording": recorder.enabled,
+                    "slow_traces": recorder.slow_traces(),
+                    "audit": recorder.audit(),
+                }
+            )
+            return
+        try:
+            trace_id = int(raw_id, 0)
+        except ValueError:
+            # the standard error envelope (code+message), so api/client.py
+            # surfaces the status instead of a code-0 ApiError
+            self._send_json(
+                {"code": 400, "message": "?id= must be an int"}, 400
+            )
+            return
+        tree = recorder.trace_tree(trace_id)
+        if not tree["spans"]:
+            self._send_json(
+                {
+                    "code": 404,
+                    "message": f"no spans recorded for trace {trace_id}",
+                    "trace_id": trace_id,
+                },
+                status=404,
+            )
+            return
+        # flight lineage settled under this trace (admission→settle
+        # outcome records), then the device span-plane evidence that
+        # landed inside the trace's time window — routing decisions and
+        # transfers share the span clock, so the join is a range scan
+        tree["lineage"] = [
+            r.to_dict() for r in _flight.RECORDER.by_trace(trace_id)
+        ]
+        t_lo = tree["t0_s"]
+        t_hi = t_lo + tree["duration_s"]
+        device_events: list = []
+        for rec in recorder.records():
+            if not rec.name.startswith("device."):
+                continue
+            if rec.t0 < t_lo or rec.t0 > t_hi:
+                continue
+            device_events.append(
+                {
+                    "name": rec.name,
+                    "t0_s": rec.t0,
+                    "fields": rec.fields,
+                }
+            )
+            if len(device_events) >= 256:
+                break
+        tree["device"] = device_events
+        self._send_json(tree)
 
     def _serve_events(self) -> None:
         params = self._query()

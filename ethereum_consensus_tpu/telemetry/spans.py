@@ -35,6 +35,25 @@ thread's span stack).
 Lock discipline (speclint-checked): every write to the recorder's shared
 structures holds ``self._lock``; the hot ``enabled`` read and the
 per-thread span stack (``threading.local``) stay lock-free.
+
+**Causal trace plane.** Spans only parent within a thread (the TLS
+stack), so causality used to die at every cross-thread handoff — pool
+admission → flush-window dispatch → verify lane → settle. A
+``TraceContext`` is the explicit handoff token across those seams:
+``SpanRecorder.context()`` captures the current span as
+``(trace_id, span_id, lane, ts)``, the receiving thread brackets its
+work in ``adopt(ctx)``, and every top-of-stack span begun under an
+adopted context parents to ``ctx.span_id`` and inherits
+``ctx.trace_id`` — one flush window becomes one connected tree no
+matter how many threads it crossed. A span with no parent and no
+adopted context roots its own trace (``trace_id == span_id``).
+Cross-lane adoptions additionally record a flow source, rendered by
+``chrome_trace()`` as Chrome flow events (``ph:"s"``/``"f"`` arrows
+across ``tid`` lanes in Perfetto). The ring drops oldest records when
+full as before, but no longer silently: ``dropped`` counts evictions
+and mirrors to the ``spans.dropped`` counter. Completed traces noted
+via ``note_trace`` feed a bounded worst-N slow-trace ring — the
+``/trace`` endpoint's index (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -50,8 +69,10 @@ from contextlib import contextmanager
 __all__ = [
     "SpanRecord",
     "SpanRecorder",
+    "TraceContext",
     "RECORDER",
     "DEFAULT_CAPACITY",
+    "SLOW_TRACE_RING",
     "is_recording",
     "start_recording",
     "stop_recording",
@@ -60,6 +81,33 @@ __all__ = [
 ]
 
 DEFAULT_CAPACITY = 1 << 16
+
+# worst-N slow-trace ring size (completed traces, by duration)
+SLOW_TRACE_RING = 32
+
+
+class TraceContext:
+    """Immutable cross-thread handoff token: ``trace_id`` names the
+    causal tree, ``span_id`` the parent span the receiving side should
+    link under, ``lane``/``ts`` the handoff origin (the flow-arrow
+    source in the Chrome trace). Captured with ``context()`` on the
+    sending thread, passed explicitly (a ticket field, a closure arg —
+    never ambient), adopted with ``adopt(ctx)`` on the receiving
+    thread."""
+
+    __slots__ = ("trace_id", "span_id", "lane", "ts")
+
+    def __init__(self, trace_id: int, span_id: int, lane: int, ts: float):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.lane = lane
+        self.ts = ts
+
+    def __repr__(self) -> str:
+        return (
+            f"TraceContext(trace={self.trace_id}, span={self.span_id}, "
+            f"lane={self.lane})"
+        )
 
 
 class SpanRecord:
@@ -71,24 +119,32 @@ class SpanRecord:
     __slots__ = (
         "span_id",
         "parent_id",
+        "trace_id",
         "name",
         "lane",
         "t0",
         "t1",
         "fields",
         "error",
+        "flow_src",
     )
 
     def __init__(self, span_id: int, parent_id: int, name: str, lane: int,
-                 t0: float, fields: dict):
+                 t0: float, fields: dict, trace_id: int = 0):
         self.span_id = span_id
         self.parent_id = parent_id
+        # the causal tree this span belongs to: its own span_id when it
+        # roots a fresh trace, the adopted/inherited trace_id otherwise
+        self.trace_id = trace_id or span_id
         self.name = name
         self.lane = lane
         self.t0 = t0
         self.t1 = t0
         self.fields = fields
         self.error = None
+        # (src_span_id, src_lane, src_ts) when this span was begun under
+        # a context adopted from another lane — the flow-arrow source
+        self.flow_src = None
 
     @property
     def duration_s(self) -> float:
@@ -126,6 +182,8 @@ class SpanRecorder:
         self._ids = itertools.count(1)
         self._t0 = 0.0                # perf_counter origin of the recording
         self._wall0 = 0.0             # wall-clock at start (metadata only)
+        self._slow: list = []         # worst-N completed traces, ascending
+        self.dropped = 0              # ring evictions (spans + events)
         self.enabled = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -141,6 +199,8 @@ class SpanRecorder:
                 self._events.clear()
             self._lanes.clear()
             self._lane_names.clear()
+            self._slow = []
+            self.dropped = 0
             self._t0 = time.perf_counter()
             self._wall0 = time.time()
             self.enabled = True
@@ -172,14 +232,33 @@ class SpanRecorder:
 
     def begin(self, name: str, fields: dict) -> SpanRecord:
         stack = self._stack()
+        lane = self._lane()
+        flow_src = None
+        if stack:
+            # in-thread nesting wins: parent is the enclosing span
+            parent_id = stack[-1].span_id
+            trace_id = stack[-1].trace_id
+        else:
+            ctx = getattr(self._tls, "adopted", None)
+            if ctx is not None:
+                # cross-seam handoff: link under the sender's span
+                parent_id = ctx.span_id
+                trace_id = ctx.trace_id
+                if ctx.lane != lane:
+                    flow_src = (ctx.span_id, ctx.lane, ctx.ts)
+            else:
+                parent_id = 0
+                trace_id = 0  # self-rooted: SpanRecord uses its span_id
         rec = SpanRecord(
             span_id=next(self._ids),
-            parent_id=stack[-1].span_id if stack else 0,
+            parent_id=parent_id,
             name=name,
-            lane=self._lane(),
+            lane=lane,
             t0=time.perf_counter(),
             fields=fields,
+            trace_id=trace_id,
         )
+        rec.flow_src = flow_src
         stack.append(rec)
         return rec
 
@@ -196,13 +275,164 @@ class SpanRecorder:
                 stack.remove(rec)
             except ValueError:
                 pass
+        self._append_span(rec)
+
+    def _append_span(self, rec: SpanRecord) -> None:
+        dropped = False
         with self._lock:
+            if len(self._spans) == self._capacity:
+                self.dropped += 1
+                dropped = True
             self._spans.append(rec)
+        if dropped:
+            from . import metrics as _metrics
+
+            _metrics.counter("spans.dropped").inc()
 
     def event(self, name: str, fields: dict) -> None:
         rec = _EventRecord(name, self._lane(), time.perf_counter(), fields)
+        self._append_event(rec)
+
+    def _append_event(self, rec: _EventRecord) -> None:
+        dropped = False
         with self._lock:
+            if len(self._events) == self._capacity:
+                self.dropped += 1
+                dropped = True
             self._events.append(rec)
+        if dropped:
+            from . import metrics as _metrics
+
+            _metrics.counter("spans.dropped").inc()
+
+    # -- causal trace plane --------------------------------------------------
+    def context(self) -> "TraceContext | None":
+        """The current causal position as a handoff token: the top of
+        this thread's span stack if one is open (the common case — call
+        inside the span that should parent the downstream work), else
+        the context this thread itself adopted, else None."""
+        stack = getattr(self._tls, "stack", None)
+        if stack:
+            top = stack[-1]
+            return TraceContext(
+                top.trace_id, top.span_id, top.lane, time.perf_counter()
+            )
+        return getattr(self._tls, "adopted", None)
+
+    @contextmanager
+    def adopt(self, ctx: "TraceContext | None"):
+        """Bracket the receiving side of a handoff: top-of-stack spans
+        begun inside the block parent to ``ctx.span_id`` and inherit its
+        trace. Nests (the previous adoption is restored on exit); TLS
+        only, so it is lock-free."""
+        prev = getattr(self._tls, "adopted", None)
+        self._tls.adopted = ctx
+        try:
+            yield ctx
+        finally:
+            self._tls.adopted = prev
+
+    def note_trace(self, trace_id: int, name: str, duration_s: float,
+                   fields: "dict | None" = None) -> None:
+        """Feed the worst-N slow-trace ring: called once per completed
+        trace (the pipeline notes each settled window, the pool each
+        settled flush) with its end-to-end duration."""
+        entry = {
+            "trace_id": trace_id,
+            "name": name,
+            "duration_s": duration_s,
+        }
+        if fields:
+            entry.update({k: _json_safe(v) for k, v in fields.items()})
+        with self._lock:
+            slow = self._slow
+            if len(slow) < SLOW_TRACE_RING:
+                slow.append(entry)
+                slow.sort(key=lambda e: e["duration_s"])
+            elif duration_s > slow[0]["duration_s"]:
+                slow[0] = entry
+                slow.sort(key=lambda e: e["duration_s"])
+
+    def slow_traces(self) -> "list[dict]":
+        """The worst-N completed traces, slowest first (consistent
+        copy)."""
+        with self._lock:
+            return [dict(e) for e in reversed(self._slow)]
+
+    def trace_records(self, trace_id: int) -> "list[SpanRecord]":
+        """Completed spans belonging to ``trace_id`` (consistent copy,
+        sorted by start time)."""
+        with self._lock:
+            spans = [r for r in self._spans if r.trace_id == trace_id]
+        spans.sort(key=lambda r: r.t0)
+        return spans
+
+    def trace_tree(self, trace_id: int) -> dict:
+        """One trace assembled as a JSON-ready causal tree: its spans
+        (start-ordered), root/orphan accounting, and the wall window it
+        covered. ``connected`` is the gate the tests and the ``/trace``
+        endpoint assert: at least one span, exactly one root, zero
+        orphans (an orphan parents to a span id absent from the
+        trace)."""
+        spans = self.trace_records(trace_id)
+        ids = {r.span_id for r in spans}
+        roots = sum(1 for r in spans if r.parent_id == 0)
+        orphans = sum(
+            1 for r in spans if r.parent_id and r.parent_id not in ids
+        )
+        t0 = self._t0
+        out_spans = []
+        for rec in spans:
+            d = {
+                "span_id": rec.span_id,
+                "parent_id": rec.parent_id,
+                "name": rec.name,
+                "lane": rec.lane,
+                "t0_s": max(0.0, rec.t0 - t0),
+                "duration_s": rec.duration_s,
+                "fields": {k: _json_safe(v) for k, v in rec.fields.items()},
+            }
+            if rec.error is not None:
+                d["error"] = rec.error
+            if rec.flow_src is not None:
+                d["flow_from"] = {
+                    "span_id": rec.flow_src[0],
+                    "lane": rec.flow_src[1],
+                }
+            out_spans.append(d)
+        return {
+            "trace_id": trace_id,
+            "spans": out_spans,
+            "span_count": len(spans),
+            "roots": roots,
+            "orphans": orphans,
+            "connected": bool(spans) and roots == 1 and orphans == 0,
+            "t0_s": out_spans[0]["t0_s"] if out_spans else None,
+            "duration_s": (
+                max(r.t1 for r in spans) - min(r.t0 for r in spans)
+                if spans
+                else None
+            ),
+            "lanes": sorted({r.lane for r in spans}),
+        }
+
+    def audit(self) -> dict:
+        """Whole-buffer trace health (the bench's evidence block):
+        distinct traces, spans that parent to an id absent from the
+        buffer (orphans), and ring evictions."""
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self.dropped
+        ids = {r.span_id for r in spans}
+        orphans = sum(
+            1 for r in spans if r.parent_id and r.parent_id not in ids
+        )
+        return {
+            "spans": len(spans),
+            "traces": len({r.trace_id for r in spans}),
+            "orphans": orphans,
+            "dropped": dropped,
+        }
 
     # -- named virtual lanes (non-thread tid tracks) -------------------------
     def named_lane(self, name: str) -> int:
@@ -235,8 +465,7 @@ class SpanRecorder:
             fields=fields,
         )
         rec.t1 = t1
-        with self._lock:
-            self._spans.append(rec)
+        self._append_span(rec)
         return rec
 
     def add_instant(self, name: str, ts: float, fields: dict,
@@ -246,8 +475,7 @@ class SpanRecorder:
         rec = _EventRecord(
             name, self._lane() if lane is None else lane, ts, fields
         )
-        with self._lock:
-            self._events.append(rec)
+        self._append_event(rec)
 
     # -- reading -------------------------------------------------------------
     def records(self) -> "list[SpanRecord]":
@@ -299,6 +527,7 @@ class SpanRecorder:
         for rec in spans:
             args = {k: _json_safe(v) for k, v in rec.fields.items()}
             args["span_id"] = rec.span_id
+            args["trace_id"] = rec.trace_id
             if rec.parent_id:
                 args["parent_id"] = rec.parent_id
             if rec.error is not None:
@@ -315,6 +544,36 @@ class SpanRecorder:
                     "args": args,
                 }
             )
+            if rec.flow_src is not None:
+                # cross-lane handoff: a flow-start at the sender's
+                # capture point, a binding flow-finish at this span's
+                # start — Perfetto draws the arrow between tid lanes
+                src_span, src_lane, src_ts = rec.flow_src
+                flow = {
+                    "pid": pid,
+                    "name": "trace.flow",
+                    "cat": "flow",
+                    "id": rec.span_id,
+                }
+                out.append(
+                    dict(
+                        flow,
+                        ph="s",
+                        tid=src_lane,
+                        ts=max(0.0, (src_ts - t0) * 1e6),
+                        args={"from_span": src_span},
+                    )
+                )
+                out.append(
+                    dict(
+                        flow,
+                        ph="f",
+                        bp="e",
+                        tid=rec.lane,
+                        ts=max(0.0, (rec.t0 - t0) * 1e6),
+                        args={"to_span": rec.span_id},
+                    )
+                )
         for rec in events:
             out.append(
                 {

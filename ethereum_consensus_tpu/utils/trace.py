@@ -35,7 +35,18 @@ from contextlib import contextmanager
 from ..telemetry import memory as _memory
 from ..telemetry import spans as _spans
 
-__all__ = ["logger", "span", "event", "basic_setup"]
+__all__ = [
+    "logger",
+    "span",
+    "event",
+    "basic_setup",
+    "TraceContext",
+    "context",
+    "adopt",
+    "note_trace",
+]
+
+TraceContext = _spans.TraceContext
 
 logger = logging.getLogger("ethereum_consensus_tpu")
 logger.addHandler(logging.NullHandler())
@@ -111,6 +122,51 @@ def event(name: str, **fields) -> None:
         _RECORDER.event(name, fields)
     if logger.isEnabledFor(logging.INFO):
         logger.info("%s %s", name, _fmt_fields(fields))
+
+
+# -- causal trace plane (telemetry/spans.py TraceContext) ---------------------
+
+class _NullAdopt:
+    """Shared no-op context manager: the ``adopt`` off path allocates
+    nothing (one ``enabled`` read, one shared instance)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_ADOPT = _NullAdopt()
+
+
+def context() -> "TraceContext | None":
+    """Capture the current causal position as a cross-thread handoff
+    token (None when recording is off — callers pass it through
+    unconditionally; the off path is one attribute read)."""
+    if not _RECORDER.enabled:
+        return None
+    return _RECORDER.context()
+
+
+def adopt(ctx: "TraceContext | None"):
+    """Bracket the receiving side of a handoff: top-level spans opened
+    inside the block link under ``ctx`` (same trace, cross-lane flow
+    arrow in the Chrome trace). With ``ctx=None`` or recording off this
+    is a shared no-op context manager."""
+    if ctx is None or not _RECORDER.enabled:
+        return _NULL_ADOPT
+    return _RECORDER.adopt(ctx)
+
+
+def note_trace(ctx: "TraceContext | None", name: str, duration_s: float,
+               **fields) -> None:
+    """Note a completed trace into the worst-N slow-trace ring (no-op
+    when ``ctx`` is None or recording is off)."""
+    if ctx is not None and _RECORDER.enabled:
+        _RECORDER.note_trace(ctx.trace_id, name, duration_s, fields)
 
 
 _BASIC_HANDLER: "logging.Handler | None" = None
