@@ -174,7 +174,7 @@ class AdmissionEngine:
         self.rlc = bool(rlc)
         self.max_inflight = max(1, int(max_inflight))
         self._window: list = []
-        self._inflight: list = []  # (future|None, sets, attribution, entries)
+        self._inflight: list = []  # (future|None, sets, attribution, entries, trace ctx)
         self._committees: dict = {}  # (root, slot, ckey) -> [committee, objs, raws|None]
         self._builders: dict = {}  # fork name -> container namespace
         self._scratches: dict = {}  # snapshot root -> mutable op scratch

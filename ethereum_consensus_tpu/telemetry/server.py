@@ -9,7 +9,9 @@ on instrumented ground:
 * ``/metrics``  — the WHOLE metrics registry in Prometheus text
   exposition format 0.0.4: counters and gauges verbatim, histograms as
   summaries (``{quantile="..."}`` gauges from the bounded reservoir +
-  ``_sum``/``_count``) with ``_min``/``_max`` companion gauges.
+  ``_sum``/``_count``) with ``_min``/``_max`` companion gauges. Strict
+  format 0.0.4 — no OpenMetrics constructs, so any classic scraper
+  parses every line.
 * ``/healthz``  — pipeline liveness: ``ok`` / ``degraded`` (the latched
   ``pipeline.degraded`` gauge) / ``broken`` (the latched
   ``pipeline.broken`` gauge, with the stuck window's seq + slots from
@@ -39,9 +41,15 @@ on instrumented ground:
   lineage records settled under that trace, and the device span-plane
   evidence (``device.*`` route/transfer events) that landed inside the
   trace's time window. Bare ``/trace`` returns the worst-N slow-trace
-  ring plus the span recorder's audit (span/trace/orphan/drop counts).
-  Trace ids come from histogram exemplars on ``/metrics``, lineage
-  records on ``/blocks``/``/events``, and the soak report's SLO gates.
+  ring, the span recorder's audit (span/trace/orphan/drop counts), and
+  every histogram's worst-N exemplar table — the JSON home of exemplar
+  evidence (``/metrics`` stays pure text format 0.0.4: the OpenMetrics
+  ``# {...}`` exemplar appendage would read as a malformed timestamp to
+  classic parsers and fail the whole scrape, and even OpenMetrics only
+  allows exemplars on counters/histogram buckets, not the summary
+  quantiles we render). Trace ids come from the ``/trace`` exemplar
+  tables, lineage records on ``/blocks``/``/events``, and the soak
+  report's SLO gates.
 
 ``/metrics`` additionally carries a standard ``build_info`` gauge (git
 sha, jax/numpy versions, x64 flag, backend platform as labels, value 1)
@@ -213,10 +221,16 @@ def render_prometheus(metric_objects=None) -> str:
     Counters/gauges render verbatim; a ``Histogram`` renders as a
     summary — reservoir-derived ``{quantile="0.5|0.9|0.99"}`` samples
     plus exact ``_sum``/``_count`` — with ``_min``/``_max`` companion
-    gauges. A histogram holding worst-N exemplars renders its worst
-    exemplar on the highest quantile line in OpenMetrics exemplar
-    syntax (``... # {trace_id="<id>"} <value>``) so the p99 a scrape
-    reports names the trace that produced the tail."""
+    gauges.
+
+    Deliberately NO exemplars here: the document is served as
+    ``text/plain; version=0.0.4``, whose parser reads the OpenMetrics
+    ``# {...}`` appendage as a malformed timestamp and rejects the
+    line — failing the ENTIRE scrape whenever any histogram holds an
+    exemplar. Even under negotiated OpenMetrics, exemplars are only
+    legal on counters and histogram buckets, never on the summary
+    quantiles rendered here. Exemplar evidence lives on the JSON side:
+    bare ``/trace`` serves every histogram's worst-N table."""
     lines: list = []
     if metric_objects is None:
         metric_objects = _metrics.registered_metrics()
@@ -237,18 +251,11 @@ def render_prometheus(metric_objects=None) -> str:
         elif isinstance(metric, _metrics.Histogram):
             summary = metric.summary()
             lines.append(f"# TYPE {name} summary")
-            exemplars = metric.exemplars()
-            quantile_items = sorted(metric.quantiles(_QUANTILES).items())
-            for q, value in quantile_items:
+            for q, value in sorted(metric.quantiles(_QUANTILES).items()):
                 label = escape_label_value(f"{q:g}")
-                line = f'{name}{{quantile="{label}"}} {_fmt(value)}'
-                if exemplars and q == quantile_items[-1][0]:
-                    worst = exemplars[0]
-                    line += (
-                        f' # {{trace_id="{worst["trace_id"]}"}}'
-                        f' {_fmt(worst["value"])}'
-                    )
-                lines.append(line)
+                lines.append(
+                    f'{name}{{quantile="{label}"}} {_fmt(value)}'
+                )
             lines.append(f"{name}_sum {_fmt(summary['sum'])}")
             lines.append(f"{name}_count {_fmt(summary['count'])}")
             for bound in ("min", "max"):
@@ -482,17 +489,26 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_trace(self) -> None:
         """The causal-trace read side: bare → the slow-trace ring +
-        recorder audit; ``?id=`` → one trace assembled across the three
-        evidence planes (span tree, flight lineage, device events)."""
+        recorder audit + per-histogram exemplar tables (the JSON home
+        of exemplar evidence — /metrics stays strict text 0.0.4);
+        ``?id=`` → one trace assembled across the three evidence planes
+        (span tree, flight lineage, device events)."""
         params = self._query()
         recorder = _spans.RECORDER
         raw_id = self._param(params, "id")
         if raw_id is None:
+            exemplars = {}
+            for metric in _metrics.registered_metrics():
+                if isinstance(metric, _metrics.Histogram):
+                    table = metric.exemplars()
+                    if table:
+                        exemplars[metric.name] = table
             self._send_json(
                 {
                     "recording": recorder.enabled,
                     "slow_traces": recorder.slow_traces(),
                     "audit": recorder.audit(),
+                    "exemplars": exemplars,
                 }
             )
             return
@@ -519,28 +535,44 @@ class _Handler(BaseHTTPRequestHandler):
         # flight lineage settled under this trace (admission→settle
         # outcome records), then the device span-plane evidence that
         # landed inside the trace's time window — routing decisions and
-        # transfers share the span clock, so the join is a range scan
+        # transfers share the span clock, so the join is a range scan.
+        # Span t0_s values are recorder-relative, so absolute
+        # perf_counter stamps rebase onto recorder.origin first; route
+        # decisions are instants in the EVENTS ring, so both rings scan.
         tree["lineage"] = [
             r.to_dict() for r in _flight.RECORDER.by_trace(trace_id)
         ]
+        origin = recorder.origin
         t_lo = tree["t0_s"]
         t_hi = t_lo + tree["duration_s"]
         device_events: list = []
         for rec in recorder.records():
             if not rec.name.startswith("device."):
                 continue
-            if rec.t0 < t_lo or rec.t0 > t_hi:
+            t0_s = rec.t0 - origin
+            if t0_s < t_lo or t0_s > t_hi:
                 continue
             device_events.append(
                 {
                     "name": rec.name,
-                    "t0_s": rec.t0,
+                    "t0_s": t0_s,
+                    "duration_s": rec.duration_s,
                     "fields": rec.fields,
                 }
             )
-            if len(device_events) >= 256:
-                break
-        tree["device"] = device_events
+        for rec in recorder.event_records():
+            if not rec.name.startswith("device."):
+                continue
+            t0_s = rec.ts - origin
+            if t0_s < t_lo or t0_s > t_hi:
+                continue
+            device_events.append(
+                {"name": rec.name, "t0_s": t0_s, "fields": rec.fields}
+            )
+        device_events.sort(key=lambda e: e["t0_s"])
+        # bounded response, never a silent cap: the count survives
+        tree["device_count"] = len(device_events)
+        tree["device"] = device_events[:256]
         self._send_json(tree)
 
     def _serve_events(self) -> None:

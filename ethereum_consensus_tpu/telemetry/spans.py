@@ -478,10 +478,25 @@ class SpanRecorder:
         self._append_event(rec)
 
     # -- reading -------------------------------------------------------------
+    @property
+    def origin(self) -> float:
+        """``perf_counter`` stamp of the recording start — the zero
+        point of every relative ``t0_s`` this recorder emits
+        (``trace_tree``, ``chrome_trace``). Readers holding absolute
+        ``perf_counter`` stamps (``records()``/``event_records()``)
+        rebase with ``t - origin`` before comparing against them."""
+        return self._t0
+
     def records(self) -> "list[SpanRecord]":
         """Completed spans, consistent copy (any order; sort by ``t0``)."""
         with self._lock:
             return list(self._spans)
+
+    def event_records(self) -> "list[_EventRecord]":
+        """Instant events (the ``event``/``add_instant`` ring),
+        consistent copy (any order; sort by ``ts``)."""
+        with self._lock:
+            return list(self._events)
 
     def mark(self) -> int:
         """A watermark for ``records_since``: consumes one span id, so

@@ -11,7 +11,11 @@ Covers the trace-plane contract end to end:
   value-ordered and reproducible;
 * the ``/trace`` endpoint round trip through ``api/client.py``
   (``get_trace``), including the 404 unknown-id and 400 bad-id error
-  paths;
+  paths, the device-evidence join (both recorder rings, rebased onto
+  the recording origin), and the exemplar tables on bare ``/trace``;
+* the classic-scrape guard: ``/metrics`` stays strict text format
+  0.0.4 — no OpenMetrics exemplar appendage — even while histograms
+  hold exemplars;
 * the sub-µs inactive-path guard: tracing off, ``trace.context()``
   costs one attribute read;
 * the ``trace_smoke`` tier-1 gate (``make trace-smoke``): one
@@ -238,6 +242,70 @@ def test_trace_endpoint_round_trip(live_server):
             assert bad.value.code == 400
     finally:
         flight.stop()
+
+
+def test_trace_endpoint_joins_device_evidence_from_both_rings(live_server):
+    """The ?id= device join: pre-timed device spans (completed ring)
+    AND device.route instants (events ring) land in ``device``, with
+    stamps rebased onto the recording origin so they sit inside the
+    trace's relative window."""
+    client = _client(live_server)
+    with spans.recording(capacity=spans.DEFAULT_CAPACITY):
+        recorder = spans.RECORDER
+        lane = recorder.named_lane("device")
+        with trace.span("pool.admit", source="devjoin"):
+            ctx = trace.context()
+            now = time.perf_counter()
+            recorder.add_complete(
+                "device.h2d",
+                now,
+                now + 1e-4,
+                {"site": "devjoin", "bytes": 8, "count": 1},
+                lane=lane,
+            )
+            recorder.add_instant(
+                "device.route",
+                time.perf_counter(),
+                {"kind": "verify", "choice": "device", "reason": "fits"},
+                lane=lane,
+            )
+        tree = client.get_trace(ctx.trace_id)
+        names = [e["name"] for e in tree["device"]]
+        assert names == ["device.h2d", "device.route"]
+        assert tree["device_count"] == 2
+        t_lo = tree["t0_s"]
+        t_hi = t_lo + tree["duration_s"]
+        for event in tree["device"]:
+            assert t_lo <= event["t0_s"] <= t_hi
+        assert tree["device"][0]["duration_s"] == pytest.approx(1e-4)
+
+
+def test_metrics_scrape_stays_classic_while_exemplars_live_on_trace(
+    live_server,
+):
+    """The high-severity regression guard: an exemplar-holding
+    histogram must NOT leak OpenMetrics ``# {...}`` syntax into the
+    0.0.4 text exposition (a classic parser reads it as a malformed
+    timestamp and fails the whole scrape); the table is served as JSON
+    on bare ``/trace`` instead."""
+    from ethereum_consensus_tpu.telemetry import server as tel_server
+
+    hist = metrics.histogram("tracetest.scrape_guard_s")
+    hist.reset_exemplars()
+    hist.observe(0.5, trace_id=77, fields={"slot": 9})
+
+    text = tel_server.render_prometheus([hist])
+    assert "# {" not in text
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        float(value)  # classic sample lines: `name[{labels}] value`
+
+    index = _client(live_server).get_trace()
+    table = index["exemplars"]["tracetest.scrape_guard_s"]
+    assert table[0]["trace_id"] == 77
+    assert table[0]["value"] == 0.5
 
 
 # ---------------------------------------------------------------------------
